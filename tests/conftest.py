@@ -1,0 +1,24 @@
+"""Test bootstrap: force JAX onto a virtual 8-device CPU mesh so sharding and
+model tests run in CI without TPU hardware (multi-chip paths are validated on
+host devices; the driver's dryrun does the same)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+from vtpu.device.registry import reset_registry  # noqa: E402
+from vtpu.util import nodelock  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    reset_registry()
+    nodelock.reset_for_test()
+    yield
+    reset_registry()
+    nodelock.reset_for_test()

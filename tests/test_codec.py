@@ -1,0 +1,92 @@
+"""Round-trip tests for the annotation wire codec (reference devices_test.go)."""
+
+import pytest
+
+from vtpu.device import codec
+from vtpu.device.types import ContainerDevice, DeviceInfo, IciCoord
+
+
+def _sample_devices():
+    return [
+        DeviceInfo(id="tpu-v5e-0", count=4, devmem=16384, devcore=100,
+                   type="TPU-v5e", numa=0, health=True, ici=IciCoord(0, 0, 0)),
+        DeviceInfo(id="tpu-v5e-1", count=4, devmem=16384, devcore=100,
+                   type="TPU-v5e", numa=0, health=False, ici=IciCoord(1, 0, 0),
+                   mode="exclusive", index=1),
+    ]
+
+
+def test_node_devices_roundtrip():
+    devs = _sample_devices()
+    s = codec.encode_node_devices(devs)
+    out = codec.decode_node_devices(s)
+    assert len(out) == 2
+    assert out[0].id == "tpu-v5e-0"
+    assert out[0].devmem == 16384
+    assert out[0].ici == IciCoord(0, 0, 0)
+    assert out[1].health is False
+    assert out[1].mode == "exclusive"
+    assert out[1].index == 1
+    assert out[1].ici.distance(out[0].ici) == 1
+
+
+def test_node_devices_bad_segment():
+    with pytest.raises(codec.CodecError):
+        codec.decode_node_devices("garbage,1")
+
+
+def test_container_devices_roundtrip():
+    devs = [
+        ContainerDevice(uuid="tpu-v5e-0", type="TPU-v5e", usedmem=4096, usedcores=25),
+        ContainerDevice(uuid="tpu-v5e-3", type="TPU-v5e", usedmem=8192, usedcores=50),
+    ]
+    s = codec.encode_container_devices(devs)
+    assert s.endswith(":")
+    out = codec.decode_container_devices(s)
+    assert [d.uuid for d in out] == ["tpu-v5e-0", "tpu-v5e-3"]
+    assert out[1].usedmem == 8192
+    assert out[0].idx == 0 and out[1].idx == 1
+
+
+def test_pod_single_device_roundtrip_with_empty_container():
+    pd = [
+        [ContainerDevice(uuid="a", type="T", usedmem=1, usedcores=2)],
+        [],  # sidecar with no devices keeps its slot
+        [ContainerDevice(uuid="b", type="T", usedmem=3, usedcores=4),
+         ContainerDevice(uuid="c", type="T", usedmem=5, usedcores=6)],
+    ]
+    s = codec.encode_pod_single_device(pd)
+    out = codec.decode_pod_single_device(s)
+    assert len(out) == 3
+    assert out[0][0].uuid == "a"
+    assert out[1] == []
+    assert [d.uuid for d in out[2]] == ["b", "c"]
+
+
+def test_handshake():
+    v = codec.handshake_request_value(now=1000000.0)
+    state, ts = codec.parse_handshake(v)
+    assert state == "Requesting"
+    assert ts == pytest.approx(1000000.0, abs=1)
+    assert not codec.handshake_is_stale(v, now=1000030.0)
+    assert codec.handshake_is_stale(v, now=1000090.0)
+    assert not codec.handshake_is_stale("Reported_whatever", now=0)
+
+
+def test_trailing_empty_container_survives_roundtrip():
+    """Regression: a device-less FINAL container must keep its slot."""
+    pd = [[ContainerDevice(uuid="a", type="T", usedmem=1, usedcores=2)], []]
+    out = codec.decode_pod_single_device(codec.encode_pod_single_device(pd))
+    assert len(out) == 2
+    assert out[1] == []
+    # all-empty pod too
+    out = codec.decode_pod_single_device(codec.encode_pod_single_device([[], []]))
+    assert out == [[], []]
+
+
+def test_handshake_is_utc_safe():
+    """Regression: timestamps carry an explicit offset and parse offset-aware."""
+    v = codec.handshake_request_value(now=1700000000.0)
+    assert v.endswith("+0000")
+    _, ts = codec.parse_handshake(v)
+    assert ts == 1700000000.0

@@ -1,0 +1,96 @@
+"""Node-lock semantics: contention, expiry steal, dangling-owner steal, release
+(reference pkg/util/nodelock/nodelock_test.go)."""
+
+import threading
+import time
+
+import pytest
+
+from vtpu.util import nodelock
+from vtpu.util import types as t
+from vtpu.util.k8sclient import FakeKubeClient, annotations
+
+
+def _pod(name, ns="default"):
+    return {"metadata": {"name": name, "namespace": ns, "uid": f"uid-{name}"}}
+
+
+@pytest.fixture
+def client():
+    c = FakeKubeClient()
+    c.put_node({"metadata": {"name": "n1"}})
+    return c
+
+
+def test_lock_release(client):
+    pod = client.put_pod(_pod("p1"))
+    nodelock.lock_node(client, "n1", pod)
+    node = client.get_node("n1")
+    ts, ns, name = nodelock.parse_node_lock(annotations(node)[t.NODE_LOCK_ANNO])
+    assert (ns, name) == ("default", "p1")
+    assert ts is not None
+    nodelock.release_node_lock(client, "n1", pod)
+    assert t.NODE_LOCK_ANNO not in annotations(client.get_node("n1"))
+
+
+def test_contention(client):
+    p1 = client.put_pod(_pod("p1"))
+    p2 = client.put_pod(_pod("p2"))
+    nodelock.lock_node(client, "n1", p1)
+    with pytest.raises(nodelock.NodeLockContention):
+        nodelock.lock_node(client, "n1", p2)
+    # releasing with the wrong owner is a no-op
+    nodelock.release_node_lock(client, "n1", p2)
+    assert t.NODE_LOCK_ANNO in annotations(client.get_node("n1"))
+
+
+def test_reentrant_same_pod(client):
+    p1 = client.put_pod(_pod("p1"))
+    nodelock.lock_node(client, "n1", p1)
+    nodelock.lock_node(client, "n1", p1)  # same owner re-locks fine
+
+
+def test_expired_lock_stolen(client, monkeypatch):
+    p1 = client.put_pod(_pod("p1"))
+    p2 = client.put_pod(_pod("p2"))
+    monkeypatch.setenv("VTPU_NODELOCK_EXPIRE", "60")
+    nodelock.lock_node(client, "n1", p1, now=time.time() - 120)
+    nodelock.lock_node(client, "n1", p2)  # steals
+    _, ns, name = nodelock.parse_node_lock(
+        annotations(client.get_node("n1"))[t.NODE_LOCK_ANNO]
+    )
+    assert name == "p2"
+
+
+def test_dangling_owner_stolen(client):
+    p1 = client.put_pod(_pod("p1"))
+    p2 = client.put_pod(_pod("p2"))
+    nodelock.lock_node(client, "n1", p1)
+    client.delete_pod("default", "p1")  # owner vanishes
+    nodelock.lock_node(client, "n1", p2)
+    _, _, name = nodelock.parse_node_lock(
+        annotations(client.get_node("n1"))[t.NODE_LOCK_ANNO]
+    )
+    assert name == "p2"
+
+
+def test_concurrent_lockers_one_winner(client):
+    """Race N threads for the lock; exactly one must win (reference
+    register_race_test.go pattern)."""
+    pods = [client.put_pod(_pod(f"p{i}")) for i in range(8)]
+    wins, errs = [], []
+
+    def worker(pod):
+        try:
+            nodelock.lock_node(client, "n1", pod)
+            wins.append(pod["metadata"]["name"])
+        except nodelock.NodeLockContention as e:
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(p,)) for p in pods]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert len(wins) == 1
+    assert len(errs) == 7

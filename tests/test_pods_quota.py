@@ -1,0 +1,88 @@
+"""PodManager + QuotaManager behavior (reference pods_test.go / quota_test.go)."""
+
+from vtpu.device.pods import PodManager
+from vtpu.device.quota import QuotaManager
+from vtpu.device.types import ContainerDevice
+
+
+def _pod(name, uid=None, ns="default"):
+    return {"metadata": {"name": name, "namespace": ns, "uid": uid or f"uid-{name}"}}
+
+
+def _devices(mem=4096, cores=25, n=1):
+    return {"TPU": [[ContainerDevice(uuid=f"d{i}", type="TPU-v5e", usedmem=mem,
+                                     usedcores=cores) for i in range(n)]]}
+
+
+def test_pod_manager_lifecycle():
+    pm = PodManager()
+    pod = _pod("a")
+    pm.add_pod(pod, "n1", _devices())
+    assert pm.has_pod("uid-a")
+    assert pm.get_pod("uid-a").node_id == "n1"
+    assert len(pm.pods_on_node("n1")) == 1
+    assert pm.pods_on_node("n2") == []
+    info = pm.take_and_delete_pod("uid-a")
+    assert info is not None and info.key == "default/a"
+    assert not pm.has_pod("uid-a")
+    assert pm.take_and_delete_pod("uid-a") is None
+
+
+class _FakeTpu:
+    def resource_names(self):
+        return {"count": "google.com/tpu", "mem": "google.com/tpumem",
+                "cores": "google.com/tpucores"}
+
+
+def _quota_mgr():
+    qm = QuotaManager()
+    qm._managed = {
+        "google.com/tpu": ("TPU", "count"),
+        "google.com/tpumem": ("TPU", "mem"),
+        "google.com/tpucores": ("TPU", "cores"),
+    }
+    return qm
+
+
+def test_quota_fit_and_usage():
+    qm = _quota_mgr()
+    qm.add_quota({
+        "metadata": {"name": "q", "namespace": "team-a"},
+        "spec": {"hard": {"limits.google.com/tpumem": "8192",
+                          "limits.cpu": "4"}},  # unmanaged entry ignored
+    })
+    assert qm.fit_quota("team-a", "TPU", memreq=8192, coresreq=0)
+    assert not qm.fit_quota("team-a", "TPU", memreq=8193, coresreq=0)
+    assert qm.fit_quota("other-ns", "TPU", memreq=10**9, coresreq=0)  # no quota
+
+    pod = _pod("a", ns="team-a")
+    qm.add_usage(pod, _devices(mem=6000))
+    assert not qm.fit_quota("team-a", "TPU", memreq=4096, coresreq=0)
+    assert qm.fit_quota("team-a", "TPU", memreq=2000, coresreq=0)
+    qm.rm_usage(pod, _devices(mem=6000))
+    assert qm.fit_quota("team-a", "TPU", memreq=8192, coresreq=0)
+
+
+def test_quota_managed_detection():
+    qm = _quota_mgr()
+    assert qm.is_managed_quota("limits.google.com/tpumem")
+    assert not qm.is_managed_quota("limits.cpu")
+    assert not qm.is_managed_quota("google.com/tpumem")
+
+
+def test_quota_snapshot():
+    qm = _quota_mgr()
+    qm.add_quota({"metadata": {"name": "q", "namespace": "ns"},
+                  "spec": {"hard": {"limits.google.com/tpu": 2}}})
+    qm.add_usage(_pod("a", ns="ns"), _devices(n=1))
+    snap = qm.snapshot()
+    assert snap["ns"]["google.com/tpu"] == {"limit": 2, "used": 1}
+
+
+def test_quota_byte_suffix_normalizes_to_mib():
+    """Regression: 16Gi on a mem-role resource means 16384 MiB, not 17e9."""
+    qm = _quota_mgr()
+    qm.add_quota({"metadata": {"name": "q", "namespace": "ns"},
+                  "spec": {"hard": {"limits.google.com/tpumem": "16Gi"}}})
+    assert qm.fit_quota("ns", "TPU", memreq=16384, coresreq=0)
+    assert not qm.fit_quota("ns", "TPU", memreq=16385, coresreq=0)

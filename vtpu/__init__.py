@@ -1,0 +1,18 @@
+"""vTPU: TPU-native device virtualization and scheduling middleware for Kubernetes.
+
+A ground-up, TPU-first rebuild of the capabilities of HAMi (k8s-vGPU-scheduler):
+
+- ``vtpu.scheduler``  -- mutating webhook + scheduler-extender (Filter/Score/Bind)
+- ``vtpu.device``     -- device abstraction, TPU backend, ICI-topology placement
+- ``vtpu.plugin``     -- kubelet device plugin (gRPC) for google.com/tpu resources
+- ``vtpu.monitor``    -- node monitor: shared-region lister, metrics, QoS feedback
+- ``libvtpu/`` (C++)  -- in-container PJRT/libtpu intercept enforcing HBM/core limits
+- ``vtpu.models/ops/parallel`` -- JAX/Pallas inference workload + sharding used by the
+  TTFT benchmark harness (the data plane the middleware schedules and isolates)
+
+The control plane communicates exclusively through Kubernetes objects (node and pod
+annotations), mirroring the reference architecture (docs/develop/protocol.md in the
+reference); the data plane (ICI/DCN collectives) is owned by XLA, not the middleware.
+"""
+
+__version__ = "0.1.0"

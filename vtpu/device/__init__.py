@@ -1,0 +1,15 @@
+"""Device abstraction layer: vendor-neutral types, codec, registry, managers.
+
+Parity: reference pkg/device (devices.go, pods.go, quota.go, common/). Every
+backend implements the :class:`vtpu.device.base.Devices` interface and is held in
+the process-wide registry (reference devices.go:199-210 DevicesMap).
+"""
+
+from vtpu.device.registry import (  # noqa: F401
+    DEVICES_MAP,
+    IN_REQUEST_DEVICES,
+    SUPPORT_DEVICES,
+    get_devices,
+    register_backend,
+    reset_registry,
+)

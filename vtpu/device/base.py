@@ -1,0 +1,189 @@
+"""The vendor backend contract every device family implements.
+
+Parity: reference pkg/device/devices.go:36-50 ``Devices`` interface
+(CommonWord, MutateAdmission, CheckHealth, NodeCleanUp, GetResourceNames,
+GetNodeDevices, LockNode, ReleaseNodeLock, GenerateResourceRequests,
+PatchAnnotations, ScoreNode, AddResourceUsage, Fit).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import TYPE_CHECKING, Optional
+
+from vtpu.device import codec
+from vtpu.device.types import (
+    ContainerDevice,
+    ContainerDeviceRequest,
+    ContainerDevices,
+    DeviceInfo,
+    DeviceUsage,
+    NodeInfo,
+    PodDevices,
+)
+from vtpu.util import types as t
+
+if TYPE_CHECKING:
+    from vtpu.util.k8sclient import KubeClient
+
+
+class Devices(abc.ABC):
+    """One accelerator family's scheduling logic, registered in the device registry."""
+
+    # ------------------------------------------------------------------ identity
+
+    @abc.abstractmethod
+    def common_word(self) -> str:
+        """Registry key, e.g. 'TPU' (reference CommonWord)."""
+
+    @abc.abstractmethod
+    def resource_names(self) -> dict[str, str]:
+        """Resource-name roles: keys 'count', 'mem', 'memPercentage', 'cores'
+        (any may be missing) -> k8s resource names like 'google.com/tpu'."""
+
+    def in_request_annotation(self) -> str:
+        """Pod annotation carrying the pending assignment the plugin consumes."""
+        return f"vtpu.io/{self.common_word().lower()}-devices-to-allocate"
+
+    def supported_annotation(self) -> str:
+        """Pod annotation recording the final allocation (kept for replay)."""
+        return f"vtpu.io/{self.common_word().lower()}-devices-allocated"
+
+    def register_annotation(self) -> str:
+        return f"vtpu.io/node-{self.common_word().lower()}{t.NODE_REGISTER_SUFFIX}"
+
+    def handshake_annotation(self) -> str:
+        return f"{t.NODE_HANDSHAKE_PREFIX}{self.common_word().lower()}"
+
+    # ------------------------------------------------------------------ admission
+
+    @abc.abstractmethod
+    def mutate_admission(self, container: dict, pod: dict) -> bool:
+        """Normalize one container at admission time; True if it requests this
+        vendor (reference MutateAdmission, nvidia/device.go:359-462)."""
+
+    # ------------------------------------------------------------------ node state
+
+    def get_node_devices(self, node: dict) -> list[DeviceInfo]:
+        """Decode this vendor's registered devices from node annotations
+        (reference GetNodeDevices, nvidia/device.go:295-357)."""
+        anno = (node.get("metadata", {}).get("annotations") or {}).get(
+            self.register_annotation(), ""
+        )
+        if not anno:
+            return []
+        return codec.decode_node_devices(anno)
+
+    def check_health(self, node: dict, client: "KubeClient", now: Optional[float] = None) -> tuple[bool, bool]:
+        """Handshake liveness: returns (healthy, refreshed-request-written).
+
+        The scheduler stamps ``Requesting_<ts>`` on the handshake annotation; a
+        live plugin overwrites it each register tick. If a Requesting mark goes
+        stale past the timeout, the vendor is withdrawn from the node (reference
+        devices.go CheckHealth:538-577).
+        """
+        annos = node.get("metadata", {}).get("annotations") or {}
+        hs = annos.get(self.handshake_annotation(), "")
+        if not hs:
+            # Never-reported vendor: stamp a request so a dead agent can't stay
+            # schedulable forever (reference devices.go:559-575).
+            client.patch_node_annotations(
+                node["metadata"]["name"],
+                {self.handshake_annotation(): codec.handshake_request_value(now)},
+            )
+            return True, True
+        state, _ = codec.parse_handshake(hs)
+        if state == t.HANDSHAKE_DELETED:
+            return False, False
+        if state == t.HANDSHAKE_REQUESTING:
+            if codec.handshake_is_stale(hs, now=now):
+                return False, False
+            return True, False
+        # Fresh plugin report: stamp a new request so staleness is measurable.
+        client.patch_node_annotations(
+            node["metadata"]["name"],
+            {self.handshake_annotation(): codec.handshake_request_value(now)},
+        )
+        return True, True
+
+    def node_cleanup(self, node_name: str, client: "KubeClient") -> None:
+        """Withdraw this vendor from a node (reference NodeCleanUp)."""
+        client.patch_node_annotations(
+            node_name,
+            {
+                self.register_annotation(): None,
+                self.handshake_annotation(): codec.handshake_deleted_value(),
+            },
+        )
+
+    # ------------------------------------------------------------------ locking
+
+    def lock_node(self, node: dict, pod: dict, client: "KubeClient") -> None:
+        """Take the per-node mutex iff the pod requests this vendor (reference
+        LockNode). Default: lock when any container has a non-empty request."""
+        from vtpu.util import nodelock
+
+        if not any(
+            not self.generate_resource_requests(c).empty()
+            for c in pod.get("spec", {}).get("containers", [])
+        ):
+            return
+        nodelock.lock_node(client, node["metadata"]["name"], pod)
+
+    def release_node_lock(self, node: dict, pod: dict, client: "KubeClient") -> None:
+        from vtpu.util import nodelock
+
+        if not any(
+            not self.generate_resource_requests(c).empty()
+            for c in pod.get("spec", {}).get("containers", [])
+        ):
+            return
+        nodelock.release_node_lock(client, node["metadata"]["name"], pod)
+
+    # ------------------------------------------------------------------ requests
+
+    @abc.abstractmethod
+    def generate_resource_requests(self, container: dict) -> ContainerDeviceRequest:
+        """Translate container resource limits/requests into a device ask
+        (reference GenerateResourceRequests, nvidia/device.go:529-599)."""
+
+    # ------------------------------------------------------------------ scheduling
+
+    @abc.abstractmethod
+    def fit(
+        self,
+        devices: list[DeviceUsage],
+        request: ContainerDeviceRequest,
+        pod: dict,
+        node_info: NodeInfo,
+        allocated: PodDevices,
+    ) -> tuple[bool, dict[str, ContainerDevices], str]:
+        """Try to place one container's request onto a node's device snapshot.
+
+        Returns (fit, {vendor: devices}, failure-reason). Must NOT mutate
+        *devices* (the score engine applies usage itself). Parity: reference
+        Fit (nvidia/device.go:746-889).
+        """
+
+    def score_node(self, node: dict, pod_devices: list[ContainerDevices], previous: list[DeviceUsage], policy: str) -> float:
+        """Optional vendor-specific node score added on top of the node policy
+        (reference ScoreNode; default 0)."""
+        return 0.0
+
+    def add_resource_usage(self, pod: dict, usage: DeviceUsage, ctr_dev: ContainerDevice) -> None:
+        """Apply one assignment onto the snapshot (reference AddResourceUsage)."""
+        pod_key = f"{pod['metadata'].get('namespace', 'default')}/{pod['metadata'].get('name', '')}"
+        usage.add(ctr_dev, pod_key)
+
+    # ------------------------------------------------------------------ decisions
+
+    def patch_annotations(self, pod: dict, annotations: dict[str, str], pod_devices: PodDevices) -> list[ContainerDevices]:
+        """Render this vendor's share of a schedule decision into pod annotations
+        (reference PatchAnnotations, nvidia/device.go:504-527)."""
+        single = pod_devices.get(self.common_word())
+        if not single:
+            return []
+        enc = codec.encode_pod_single_device(single)
+        annotations[self.in_request_annotation()] = enc
+        annotations[self.supported_annotation()] = enc
+        return single

@@ -1,0 +1,190 @@
+"""Annotation wire codec: the control-plane protocol.
+
+Everything the scheduler knows about nodes, and everything the device plugin
+learns about scheduling decisions, travels as compact annotation strings
+(parity: reference pkg/device/devices.go:272-508 and docs/develop/protocol.md).
+
+Node registration (``vtpu.io/node-tpu-register``), one device per ``:`` segment::
+
+    {id},{count},{devmem},{devcore},{type},{numa},{health},{x-y-z}[,{mode}]
+
+Pod assignment (``vtpu.io/tpu-devices-to-allocate`` etc.): containers joined by
+``;``, devices of one container joined by ``:``, device fields by ``,``::
+
+    {id},{type},{usedmem},{usedcores}
+
+Trailing separators are emitted (and tolerated on decode) so empty container
+slots survive the round trip, matching the reference encoding.
+"""
+
+from __future__ import annotations
+
+from vtpu.device.types import (
+    ContainerDevice,
+    ContainerDevices,
+    DeviceInfo,
+    IciCoord,
+    PodDevices,
+    PodSingleDevice,
+)
+from vtpu.util import timeutil
+from vtpu.util import types as t
+
+ONE_CONTAINER_MULTI_DEVICE_SPLIT = ":"
+ONE_POD_MULTI_CONTAINER_SPLIT = ";"
+FIELD_SPLIT = ","
+
+
+class CodecError(ValueError):
+    pass
+
+
+# --------------------------------------------------------------------------
+# Node device list  (reference devices.go EncodeNodeDevices/DecodeNodeDevices
+# :272-336, :346-372)
+# --------------------------------------------------------------------------
+
+
+def encode_node_devices(devices: list[DeviceInfo]) -> str:
+    segs = []
+    for d in devices:
+        fields = [
+            d.id,
+            str(d.count),
+            str(d.devmem),
+            str(d.devcore),
+            d.type,
+            str(d.numa),
+            str(d.health).lower(),
+            (d.ici or IciCoord()).encode(),
+        ]
+        if d.mode:
+            fields.append(d.mode)
+        segs.append(FIELD_SPLIT.join(fields))
+    return ONE_CONTAINER_MULTI_DEVICE_SPLIT.join(segs)
+
+
+def decode_node_devices(anno: str) -> list[DeviceInfo]:
+    out: list[DeviceInfo] = []
+    for index, seg in enumerate(s for s in anno.split(ONE_CONTAINER_MULTI_DEVICE_SPLIT) if s):
+        fields = seg.split(FIELD_SPLIT)
+        if len(fields) < 8:
+            raise CodecError(f"bad node device segment {seg!r}")
+        out.append(
+            DeviceInfo(
+                id=fields[0],
+                count=int(fields[1]),
+                devmem=int(fields[2]),
+                devcore=int(fields[3]),
+                type=fields[4],
+                numa=int(fields[5]),
+                health=fields[6] == "true",
+                ici=IciCoord.decode(fields[7]),
+                mode=fields[8] if len(fields) > 8 else "",
+                index=index,
+            )
+        )
+    return out
+
+
+# --------------------------------------------------------------------------
+# Pod device assignment  (reference devices.go EncodePodSingleDevice/
+# DecodePodSingleDevice :403-508)
+# --------------------------------------------------------------------------
+
+
+def encode_container_devices(devs: ContainerDevices) -> str:
+    segs = [
+        FIELD_SPLIT.join([d.uuid, d.type, str(d.usedmem), str(d.usedcores)]) for d in devs
+    ]
+    s = ONE_CONTAINER_MULTI_DEVICE_SPLIT.join(segs)
+    return s + ONE_CONTAINER_MULTI_DEVICE_SPLIT if s else s
+
+
+def decode_container_devices(s: str) -> ContainerDevices:
+    out: ContainerDevices = []
+    for idx, seg in enumerate(x for x in s.split(ONE_CONTAINER_MULTI_DEVICE_SPLIT) if x):
+        fields = seg.split(FIELD_SPLIT)
+        if len(fields) != 4:
+            raise CodecError(f"bad container device segment {seg!r}")
+        out.append(
+            ContainerDevice(
+                idx=idx,
+                uuid=fields[0],
+                type=fields[1],
+                usedmem=int(fields[2]),
+                usedcores=int(fields[3]),
+            )
+        )
+    return out
+
+
+def encode_pod_single_device(pd: PodSingleDevice) -> str:
+    # A ';' terminates EVERY container slot (the decoder drops exactly one
+    # trailing phantom), so an empty final container survives the round trip
+    # (reference devices.go EncodePodSingleDevice:428-436).
+    return "".join(encode_container_devices(c) + ONE_POD_MULTI_CONTAINER_SPLIT for c in pd)
+
+
+def decode_pod_single_device(s: str) -> PodSingleDevice:
+    # Every ';'-separated slot is one container, including empty ones.
+    segs = s.split(ONE_POD_MULTI_CONTAINER_SPLIT)
+    # A trailing ';' produces one phantom empty slot; drop it.
+    if segs and segs[-1] == "":
+        segs = segs[:-1]
+    return [decode_container_devices(seg) for seg in segs]
+
+
+def encode_pod_devices(pd: PodDevices, annotation_of: dict[str, str]) -> dict[str, str]:
+    """Render one annotation per vendor: vendor common-word -> annotation key."""
+    return {
+        annotation_of[vendor]: encode_pod_single_device(single)
+        for vendor, single in pd.items()
+        if vendor in annotation_of
+    }
+
+
+def decode_pod_devices(annos: dict[str, str], vendor_of: dict[str, str]) -> PodDevices:
+    """Inverse of :func:`encode_pod_devices`; vendor_of maps annotation key -> vendor."""
+    out: PodDevices = {}
+    for key, vendor in vendor_of.items():
+        if key in annos and annos[key]:
+            out[vendor] = decode_pod_single_device(annos[key])
+    return out
+
+
+# --------------------------------------------------------------------------
+# Handshake  (reference devices.go CheckHealth:538-577; protocol.md:29-37)
+# --------------------------------------------------------------------------
+
+
+def handshake_request_value(now: float | None = None) -> str:
+    return f"{t.HANDSHAKE_REQUESTING}_{timeutil.format_ts(now)}"
+
+
+def handshake_deleted_value(now: float | None = None) -> str:
+    return f"{t.HANDSHAKE_DELETED}_{timeutil.format_ts(now)}"
+
+
+def parse_handshake(value: str) -> tuple[str, float | None]:
+    """Return (state, timestamp). Unparseable timestamps yield None."""
+    state, _, ts = value.partition("_")
+    if not ts:
+        return state, None
+    return state, timeutil.parse_ts(ts)
+
+
+def handshake_is_stale(value: str, now: float | None = None, timeout: float = t.HANDSHAKE_TIMEOUT_SECONDS) -> bool:
+    """True when the plugin has not refreshed a Requesting_<ts> mark in time.
+
+    The scheduler writes ``Requesting_<ts>``; a live plugin overwrites it with a
+    fresh ``Reported_<ts>``-style value on its next register tick. A Requesting
+    mark older than *timeout* means the node agent is gone (reference
+    devices.go:556-571).
+    """
+    state, ts = parse_handshake(value)
+    if state != t.HANDSHAKE_REQUESTING:
+        return False
+    if ts is None:
+        return True
+    return (now if now is not None else time.time()) - ts > timeout

@@ -1,0 +1,40 @@
+"""Shared fit-failure reasons and the aggregated reason summarizer.
+
+Parity: reference pkg/device/common/common.go:1-116 (reason strings +
+GenReason/ParseReason). The score engine counts per-device failure reasons and
+folds them into one human-readable event message.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+
+# Device-level reasons (reference common.go)
+CARD_TYPE_MISMATCH = "CardTypeMismatch"
+CARD_UUID_MISMATCH = "CardUuidMismatch"
+CARD_TIME_SLICING_EXHAUSTED = "CardTimeSlicingExhausted"
+CARD_INSUFFICIENT_MEMORY = "CardInsufficientMemory"
+CARD_INSUFFICIENT_CORE = "CardInsufficientCore"
+CARD_COMPUTE_UNITS_EXHAUSTED = "CardComputeUnitsExhausted"
+EXCLUSIVE_DEVICE_ALLOCATE_CONFLICT = "ExclusiveDeviceAllocateConflict"
+CARD_NOT_FOUND_ON_NODE = "CardNotFoundOnNode"
+CARD_UNHEALTHY = "CardUnhealthy"
+NUMA_NOT_FIT = "NumaNotFit"
+TOPOLOGY_NOT_FIT = "TopologyNotFit"  # no contiguous ICI sub-slice available
+ALLOCATED_POD_OVERQUOTA = "AllocatedPodOverQuota"
+
+# Node-level reasons
+NODE_INSUFFICIENT_DEVICE = "NodeInsufficientDevice"
+NODE_UNFIT_POD = "NodeUnfitPod"
+
+
+def gen_reason(reasons: Counter, device_total: int) -> str:
+    """Summarize per-device failure counts, e.g.
+    '3/8 CardInsufficientMemory, 2/8 CardTimeSlicingExhausted'.
+
+    Parity: reference common.go GenReason.
+    """
+    if not reasons:
+        return ""
+    parts = [f"{n}/{device_total} {reason}" for reason, n in sorted(reasons.items())]
+    return ", ".join(parts)

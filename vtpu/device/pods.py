@@ -1,0 +1,74 @@
+"""PodManager: UID-keyed cache of scheduled pods and their device assignments.
+
+Parity: reference pkg/device/pods.go:41-243. The scheduler replays every
+scheduled pod's PodDevices onto the per-node usage snapshot during Filter, and
+the informer keeps this cache in sync with the cluster (annotations are the
+database — reference scheduler.go onAddPod:138-168).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+from vtpu.device.types import PodDevices
+
+
+@dataclass
+class PodInfo:
+    namespace: str = ""
+    name: str = ""
+    uid: str = ""
+    node_id: str = ""
+    devices: PodDevices = field(default_factory=dict)
+    ctr_ids: list[str] = field(default_factory=list)
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+class PodManager:
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._pods: dict[str, PodInfo] = {}
+
+    def add_pod(self, pod: dict, node_id: str, devices: PodDevices) -> None:
+        meta = pod["metadata"]
+        with self._lock:
+            self._pods[meta["uid"]] = PodInfo(
+                namespace=meta.get("namespace", "default"),
+                name=meta.get("name", ""),
+                uid=meta["uid"],
+                node_id=node_id,
+                devices=devices,
+            )
+
+    def del_pod(self, pod: dict) -> None:
+        with self._lock:
+            self._pods.pop(pod["metadata"]["uid"], None)
+
+    def take_and_delete_pod(self, uid: str) -> PodInfo | None:
+        """Atomically remove and return a pod (reference TakeAndDeletePod)."""
+        with self._lock:
+            return self._pods.pop(uid, None)
+
+    def get_pod(self, uid: str) -> PodInfo | None:
+        with self._lock:
+            return self._pods.get(uid)
+
+    def has_pod(self, uid: str) -> bool:
+        with self._lock:
+            return uid in self._pods
+
+    def list_pods_info(self) -> list[PodInfo]:
+        with self._lock:
+            return list(self._pods.values())
+
+    def get_scheduled_pods(self) -> dict[str, PodInfo]:
+        with self._lock:
+            return dict(self._pods)
+
+    def pods_on_node(self, node_id: str) -> list[PodInfo]:
+        with self._lock:
+            return [p for p in self._pods.values() if p.node_id == node_id]

@@ -1,0 +1,168 @@
+"""QuotaManager: namespace device-quota cache mirroring ResourceQuota objects.
+
+Parity: reference pkg/device/quota.go:27-271. Quotas are expressed as
+``limits.<device-resource>`` entries in a namespace ResourceQuota (e.g.
+``limits.google.com/tpumem: 32000``); admission and Fit both consult this cache
+so an over-quota pod fails fast with a clear reason instead of landing and being
+evicted.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+from vtpu.device.types import ContainerDevice, PodDevices
+
+QUOTA_PREFIX = "limits."
+
+
+def _parse_quantity(v, role: str = "") -> int:
+    """Parse a k8s quantity into the resource's native unit.
+
+    Bare numbers pass through unchanged (device resources are denominated in
+    MiB / percent / count). Byte suffixes (k/M/G/Ki/Mi/Gi) are normalized to
+    **MiB** for mem-role resources so ``limits.google.com/tpumem: 16Gi`` means
+    16384, not 17179869184.
+    """
+    if isinstance(v, (int, float)):
+        return int(v)
+    s = str(v).strip()
+    mult = 1
+    suffixed = False
+    for suffix, m in (("Ki", 1024), ("Mi", 1024**2), ("Gi", 1024**3),
+                      ("k", 1000), ("M", 1000**2), ("G", 1000**3)):
+        if s.endswith(suffix):
+            s = s[: -len(suffix)]
+            mult = m
+            suffixed = True
+            break
+    n = float(s) * mult
+    if suffixed and role in ("mem", "memPercentage"):
+        n /= 1024**2
+    return int(n)
+
+
+@dataclass
+class _NsQuota:
+    # resource name (without "limits." prefix) -> hard limit
+    limits: dict[str, int] = field(default_factory=dict)
+    # resource name -> usage accounted by the scheduler
+    used: dict[str, int] = field(default_factory=dict)
+
+
+class QuotaManager:
+    """Tracks per-namespace device-resource quotas and scheduler-side usage."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._ns: dict[str, _NsQuota] = {}
+        # resource name -> (vendor, role) so usage can be attributed; populated
+        # from the registry by refresh_managed_resources().
+        self._managed: dict[str, tuple[str, str]] = {}
+
+    # ---------------------------------------------------------------- registry
+
+    def refresh_managed_resources(self) -> None:
+        from vtpu.device.registry import DEVICES_MAP
+
+        with self._lock:
+            self._managed.clear()
+            for word, dev in DEVICES_MAP.items():
+                for role, res in dev.resource_names().items():
+                    self._managed[res] = (word, role)
+
+    def is_managed_quota(self, quota_resource: str) -> bool:
+        """True for 'limits.<res>' entries over device resources we schedule
+        (reference IsManagedQuota)."""
+        if not quota_resource.startswith(QUOTA_PREFIX):
+            return False
+        return quota_resource[len(QUOTA_PREFIX):] in self._managed
+
+    # ---------------------------------------------------------------- informer
+
+    def add_quota(self, quota: dict) -> None:
+        """Mirror a ResourceQuota object (create/update)."""
+        ns = quota["metadata"].get("namespace", "default")
+        hard = quota.get("spec", {}).get("hard", {}) or {}
+        with self._lock:
+            entry = self._ns.setdefault(ns, _NsQuota())
+            entry.limits = {
+                name[len(QUOTA_PREFIX):]: _parse_quantity(
+                    v, self._managed[name[len(QUOTA_PREFIX):]][1]
+                )
+                for name, v in hard.items()
+                if self.is_managed_quota(name)
+            }
+
+    def del_quota(self, quota: dict) -> None:
+        ns = quota["metadata"].get("namespace", "default")
+        with self._lock:
+            entry = self._ns.get(ns)
+            if entry:
+                entry.limits = {}
+
+    # ---------------------------------------------------------------- checks
+
+    def fit_quota(self, namespace: str, vendor: str, memreq: int, coresreq: int) -> bool:
+        """Would this additional usage stay within the namespace quota?
+        (reference FitQuota; called from vendor Fit paths)."""
+        with self._lock:
+            entry = self._ns.get(namespace)
+            if not entry or not entry.limits:
+                return True
+            for res, (word, role) in self._managed.items():
+                if word != vendor or res not in entry.limits:
+                    continue
+                add = memreq if role in ("mem", "memPercentage") else (
+                    coresreq if role == "cores" else 0
+                )
+                if add and entry.used.get(res, 0) + add > entry.limits[res]:
+                    return False
+            return True
+
+    # ---------------------------------------------------------------- usage
+
+    def _usage_of(self, devices: PodDevices) -> dict[str, int]:
+        usage: dict[str, int] = {}
+        for vendor, single in devices.items():
+            for ctr in single:
+                for dev in ctr:
+                    for res, (word, role) in self._managed.items():
+                        if word != vendor:
+                            continue
+                        if role == "mem":
+                            usage[res] = usage.get(res, 0) + dev.usedmem
+                        elif role == "cores":
+                            usage[res] = usage.get(res, 0) + dev.usedcores
+                        elif role == "count":
+                            usage[res] = usage.get(res, 0) + 1
+        return usage
+
+    def add_usage(self, pod: dict, devices: PodDevices) -> None:
+        ns = pod["metadata"].get("namespace", "default")
+        with self._lock:
+            entry = self._ns.setdefault(ns, _NsQuota())
+            for res, n in self._usage_of(devices).items():
+                entry.used[res] = entry.used.get(res, 0) + n
+
+    def rm_usage(self, pod: dict, devices: PodDevices) -> None:
+        ns = pod["metadata"].get("namespace", "default")
+        with self._lock:
+            entry = self._ns.get(ns)
+            if not entry:
+                return
+            for res, n in self._usage_of(devices).items():
+                entry.used[res] = max(0, entry.used.get(res, 0) - n)
+
+    def snapshot(self) -> dict[str, dict[str, dict[str, int]]]:
+        """{namespace: {resource: {'limit': x, 'used': y}}} for metrics."""
+        with self._lock:
+            return {
+                ns: {
+                    res: {"limit": lim, "used": entry.used.get(res, 0)}
+                    for res, lim in entry.limits.items()
+                }
+                for ns, entry in self._ns.items()
+                if entry.limits
+            }

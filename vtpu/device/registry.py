@@ -1,0 +1,37 @@
+"""Process-wide vendor backend registry.
+
+Parity: reference pkg/device/devices.go:199-210 (DevicesMap, InRequestDevices,
+SupportDevices) populated by InitDevicesWithConfig (config/config.go:107-251).
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:
+    from vtpu.device.base import Devices
+
+# vendor common-word -> backend instance
+DEVICES_MAP: dict[str, "Devices"] = {}
+# vendor common-word -> pod annotation key carrying the pending assignment
+IN_REQUEST_DEVICES: dict[str, str] = {}
+# vendor common-word -> pod annotation key recording the final allocation
+SUPPORT_DEVICES: dict[str, str] = {}
+
+
+def register_backend(dev: "Devices") -> None:
+    word = dev.common_word()
+    DEVICES_MAP[word] = dev
+    IN_REQUEST_DEVICES[word] = dev.in_request_annotation()
+    SUPPORT_DEVICES[word] = dev.supported_annotation()
+
+
+def get_devices() -> dict[str, "Devices"]:
+    return DEVICES_MAP
+
+
+def reset_registry() -> None:
+    """Test hook: clear all registered backends."""
+    DEVICES_MAP.clear()
+    IN_REQUEST_DEVICES.clear()
+    SUPPORT_DEVICES.clear()

@@ -1,0 +1,177 @@
+"""Vendor-neutral device datatypes shared by scheduler, plugin and backends.
+
+Parity: reference pkg/device/devices.go:52-197 (DeviceInfo, DeviceUsage,
+ContainerDeviceRequest, ContainerDevice, PodDevices et al.). TPU-specific twist:
+every device carries optional ICI torus coordinates so topology-aware placement
+(reference nvidia/links.go + kunlun/topo.go) can select contiguous sub-slices.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Optional
+
+
+@dataclass
+class IciCoord:
+    """Chip coordinates in the ICI torus of a TPU pod slice (e.g. 2x4 for v5e-8)."""
+
+    x: int = 0
+    y: int = 0
+    z: int = 0
+
+    def encode(self) -> str:
+        return f"{self.x}-{self.y}-{self.z}"
+
+    @classmethod
+    def decode(cls, s: str) -> "IciCoord":
+        parts = s.split("-")
+        if len(parts) != 3:
+            raise ValueError(f"bad ICI coord {s!r}")
+        return cls(int(parts[0]), int(parts[1]), int(parts[2]))
+
+    def distance(self, other: "IciCoord") -> int:
+        """Manhattan hop count across the mesh (ICI link hops)."""
+        return abs(self.x - other.x) + abs(self.y - other.y) + abs(self.z - other.z)
+
+
+@dataclass
+class DeviceInfo:
+    """A physical device as registered by the node agent.
+
+    Wire form (node annotation, see codec.py):
+    ``{id},{count},{devmem},{devcore},{type},{numa},{health},{ici}[,{mode}]``.
+    """
+
+    id: str
+    count: int  # time-slice split count: max concurrent sharers
+    devmem: int  # total HBM, MiB
+    devcore: int  # total core budget, percent (100 per physical chip)
+    type: str  # e.g. "TPU-v5e"
+    numa: int = 0
+    health: bool = True
+    ici: Optional[IciCoord] = None
+    mode: str = ""  # "" | "exclusive" | future partition modes
+    index: int = 0  # stable device index on the node
+
+    def clone(self) -> "DeviceInfo":
+        return replace(self, ici=replace(self.ici) if self.ici else None)
+
+
+@dataclass
+class ContainerDeviceRequest:
+    """One container's ask for one vendor, derived from resource limits.
+
+    Parity: reference devices.go ContainerDeviceRequest {Nums, Type, Memreq,
+    MemPercentagereq, Coresreq}.
+    """
+
+    nums: int = 0
+    type: str = ""
+    memreq: int = 0  # MiB
+    mem_percentage_req: int = 0  # percent of a device's HBM (alternative to memreq)
+    coresreq: int = 0  # percent of a device's core budget
+
+    def empty(self) -> bool:
+        return self.nums == 0
+
+
+@dataclass
+class ContainerDevice:
+    """One device assigned to one container (scheduler decision unit).
+
+    Wire form (pod annotation): ``{id},{type},{usedmem},{usedcores}``.
+    """
+
+    idx: int = 0
+    uuid: str = ""
+    type: str = ""
+    usedmem: int = 0  # MiB
+    usedcores: int = 0  # percent
+
+
+# One container's devices for one vendor.
+ContainerDevices = list[ContainerDevice]
+# All containers of a pod for one vendor: PodSingleDevice[i] == devices of container i.
+PodSingleDevice = list[ContainerDevices]
+# vendor common-word -> PodSingleDevice (reference devices.go PodDevices).
+PodDevices = dict[str, PodSingleDevice]
+
+
+@dataclass
+class DeviceUsage:
+    """Mutable per-device usage snapshot the score engine fits requests into.
+
+    Parity: reference pkg/util DeviceUsage; built fresh per Filter from the node's
+    registered DeviceInfo plus a replay of every scheduled pod's PodDevices
+    (reference scheduler.go getNodesUsage:623-707).
+    """
+
+    id: str = ""
+    index: int = 0
+    used: int = 0  # containers currently sharing the device
+    count: int = 0  # split count (max sharers)
+    usedmem: int = 0
+    totalmem: int = 0
+    usedcores: int = 0
+    totalcore: int = 0
+    numa: int = 0
+    type: str = ""
+    health: bool = True
+    mode: str = ""
+    ici: Optional[IciCoord] = None
+    pods_on_device: list[str] = field(default_factory=list)  # "<ns>/<name>" sharers
+
+    @classmethod
+    def from_info(cls, info: DeviceInfo) -> "DeviceUsage":
+        return cls(
+            id=info.id,
+            index=info.index,
+            used=0,
+            count=info.count,
+            usedmem=0,
+            totalmem=info.devmem,
+            usedcores=0,
+            totalcore=info.devcore,
+            numa=info.numa,
+            type=info.type,
+            health=info.health,
+            mode=info.mode,
+            ici=replace(info.ici) if info.ici else None,
+        )
+
+    def free_mem(self) -> int:
+        return self.totalmem - self.usedmem
+
+    def free_cores(self) -> int:
+        return self.totalcore - self.usedcores
+
+    def add(self, dev: ContainerDevice, pod_key: str = "") -> None:
+        """Account one container assignment onto this device snapshot.
+
+        Parity: reference nvidia/device.go AddResourceUsage:674-723.
+        """
+        self.used += 1
+        self.usedmem += dev.usedmem
+        self.usedcores += dev.usedcores
+        if pod_key:
+            self.pods_on_device.append(pod_key)
+
+    def sub(self, dev: ContainerDevice, pod_key: str = "") -> None:
+        self.used -= 1
+        self.usedmem -= dev.usedmem
+        self.usedcores -= dev.usedcores
+        if pod_key and pod_key in self.pods_on_device:
+            self.pods_on_device.remove(pod_key)
+
+
+@dataclass
+class NodeInfo:
+    """Per-node registered devices, one entry per vendor.
+
+    Parity: reference pkg/util NodeInfo + scheduler/nodes.go nodeManager payload.
+    """
+
+    node_name: str = ""
+    # vendor common-word -> list[DeviceInfo]
+    devices: dict[str, list[DeviceInfo]] = field(default_factory=dict)

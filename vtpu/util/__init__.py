@@ -1,0 +1,5 @@
+"""Shared infrastructure: protocol constants, k8s client, node lock, helpers.
+
+Parity target: reference pkg/util (types.go, util.go, client/, nodelock/,
+leaderelection/).
+"""

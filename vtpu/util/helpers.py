@@ -1,0 +1,114 @@
+"""Pod-state predicates, pending-pod resolution, and annotation patch helpers.
+
+Parity: reference pkg/util/util.go (GetPendingPod:75-117, patch helpers
+:138-217, pod-state predicates :272-287).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Optional
+
+from vtpu.util import types as t
+from vtpu.util.k8sclient import KubeClient
+
+log = logging.getLogger(__name__)
+
+
+def pod_key(pod: dict) -> str:
+    m = pod.get("metadata", {})
+    return f"{m.get('namespace', 'default')}/{m.get('name', '')}"
+
+
+def pod_annotations(pod: dict) -> dict:
+    return pod.get("metadata", {}).get("annotations") or {}
+
+
+def all_containers(pod: dict) -> list[dict]:
+    spec = pod.get("spec", {})
+    return list(spec.get("containers") or [])
+
+
+def init_containers(pod: dict) -> list[dict]:
+    return list(pod.get("spec", {}).get("initContainers") or [])
+
+
+def resource_limits(container: dict) -> dict:
+    res = container.get("resources") or {}
+    # limits win; requests fill gaps (k8s defaulting is the other direction, but
+    # device resources must appear in limits; reference resourcereqs semantics)
+    merged = dict(res.get("requests") or {})
+    merged.update(res.get("limits") or {})
+    return merged
+
+
+def is_pod_deleted(pod: dict) -> bool:
+    return bool(pod.get("metadata", {}).get("deletionTimestamp"))
+
+
+def pod_phase(pod: dict) -> str:
+    return pod.get("status", {}).get("phase", "")
+
+
+def is_pod_finished(pod: dict) -> bool:
+    return pod_phase(pod) in ("Succeeded", "Failed")
+
+
+def is_pod_assigned(pod: dict) -> bool:
+    """Scheduled by us: carries the assigned-node annotation."""
+    return t.ASSIGNED_NODE in pod_annotations(pod)
+
+
+def is_pod_in_flight(pod: dict) -> bool:
+    """Mid-bind: assigned to a node, bind-phase=allocating, not yet consumed."""
+    annos = pod_annotations(pod)
+    return annos.get(t.BIND_PHASE) == t.BIND_PHASE_ALLOCATING
+
+
+def get_pending_pod(client: KubeClient, node_name: str) -> Optional[dict]:
+    """Find THE pod mid-bind onto *node_name* (reference GetPendingPod:75-117).
+
+    The node lock guarantees at most one; if several are visible (stale
+    annotations), pick the most recent bind-time.
+    """
+    candidates = []
+    for pod in client.list_pods():
+        annos = pod_annotations(pod)
+        if annos.get(t.ASSIGNED_NODE) != node_name:
+            continue
+        if annos.get(t.BIND_PHASE) != t.BIND_PHASE_ALLOCATING:
+            continue
+        if is_pod_deleted(pod) or is_pod_finished(pod):
+            continue
+        candidates.append(pod)
+    if not candidates:
+        return None
+    candidates.sort(key=lambda p: int(pod_annotations(p).get(t.BIND_TIME, "0") or "0"))
+    if len(candidates) > 1:
+        log.warning(
+            "%d pods pending on node %s; choosing newest", len(candidates), node_name
+        )
+    return candidates[-1]
+
+
+def pod_allocation_try_success(client: KubeClient, pod: dict) -> None:
+    """Mark bind success after Allocate consumed all assignments (reference
+    plugin/util.go PodAllocationTrySuccess)."""
+    client.patch_pod_annotations(
+        pod["metadata"].get("namespace", "default"),
+        pod["metadata"]["name"],
+        {t.BIND_PHASE: t.BIND_PHASE_SUCCESS},
+    )
+
+
+def pod_allocation_failed(client: KubeClient, pod: dict) -> None:
+    client.patch_pod_annotations(
+        pod["metadata"].get("namespace", "default"),
+        pod["metadata"]["name"],
+        {t.BIND_PHASE: t.BIND_PHASE_FAILED},
+    )
+
+
+def now_str() -> str:
+    return str(int(time.time()))

@@ -1,0 +1,517 @@
+"""Kubernetes client: one small interface, a real REST implementation, and an
+in-memory fake.
+
+Parity: reference pkg/util/client/client.go (singleton clientset) plus the
+testing strategy of SURVEY §4 — the entire scheduler is deterministic over
+annotation strings, so tests run against :class:`FakeKubeClient` exactly like
+the reference uses ``k8s.io/client-go/kubernetes/fake``.
+
+Objects are plain dicts in k8s JSON shape; only the verbs the middleware needs
+are exposed (get/list/patch nodes+pods, bind, events, quotas, leases, watch).
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import os
+import threading
+import time
+from typing import Callable, Iterable, Optional
+
+
+class ApiError(Exception):
+    def __init__(self, status: int, message: str = ""):
+        super().__init__(f"{status}: {message}")
+        self.status = status
+
+
+class ConflictError(ApiError):
+    def __init__(self, message: str = "conflict"):
+        super().__init__(409, message)
+
+
+class NotFoundError(ApiError):
+    def __init__(self, message: str = "not found"):
+        super().__init__(404, message)
+
+
+def meta(obj: dict) -> dict:
+    return obj.setdefault("metadata", {})
+
+
+def annotations(obj: dict) -> dict:
+    return meta(obj).setdefault("annotations", {})
+
+
+def labels(obj: dict) -> dict:
+    return meta(obj).setdefault("labels", {})
+
+
+def _apply_anno_patch(obj: dict, patch: dict[str, Optional[str]]) -> None:
+    annos = annotations(obj)
+    for k, v in patch.items():
+        if v is None:
+            annos.pop(k, None)
+        else:
+            annos[k] = v
+
+
+class KubeClient:
+    """Abstract verb surface. All methods raise ApiError subclasses on failure."""
+
+    # nodes
+    def get_node(self, name: str) -> dict:
+        raise NotImplementedError
+
+    def list_nodes(self) -> list[dict]:
+        raise NotImplementedError
+
+    def update_node(self, node: dict) -> dict:
+        """Full update with resourceVersion CAS (raises ConflictError)."""
+        raise NotImplementedError
+
+    def patch_node_annotations(self, name: str, annos: dict[str, Optional[str]]) -> dict:
+        raise NotImplementedError
+
+    def patch_node_labels(self, name: str, lbls: dict[str, Optional[str]]) -> dict:
+        raise NotImplementedError
+
+    # pods
+    def get_pod(self, namespace: str, name: str) -> dict:
+        raise NotImplementedError
+
+    def list_pods(self, field_selector: str = "", namespace: str = "") -> list[dict]:
+        raise NotImplementedError
+
+    def patch_pod_annotations(self, namespace: str, name: str, annos: dict[str, Optional[str]]) -> dict:
+        raise NotImplementedError
+
+    def bind_pod(self, namespace: str, name: str, node: str) -> None:
+        raise NotImplementedError
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        raise NotImplementedError
+
+    # events / quotas / leases
+    def create_event(self, namespace: str, event: dict) -> None:
+        raise NotImplementedError
+
+    def list_resource_quotas(self) -> list[dict]:
+        raise NotImplementedError
+
+    def get_lease(self, namespace: str, name: str) -> Optional[dict]:
+        raise NotImplementedError
+
+    # change notification: handler(kind, event_type, obj); returns unsubscribe fn
+    def subscribe(self, handler: Callable[[str, str, dict], None]) -> Callable[[], None]:
+        raise NotImplementedError
+
+
+class FakeKubeClient(KubeClient):
+    """In-memory cluster. Mutations notify subscribers synchronously, which makes
+    informer-driven scheduler tests deterministic without sleeps."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._rv = 0
+        self.nodes: dict[str, dict] = {}
+        self.pods: dict[tuple[str, str], dict] = {}
+        self.quotas: dict[tuple[str, str], dict] = {}
+        self.leases: dict[tuple[str, str], dict] = {}
+        self.events: list[dict] = []
+        self.bindings: list[tuple[str, str, str]] = []  # (ns, pod, node)
+        self._subs: list[Callable[[str, str, dict], None]] = []
+
+    # ------------------------------------------------------------- internals
+
+    def _next_rv(self) -> str:
+        self._rv += 1
+        return str(self._rv)
+
+    def _notify(self, kind: str, event_type: str, obj: dict) -> None:
+        for h in list(self._subs):
+            h(kind, event_type, copy.deepcopy(obj))
+
+    def subscribe(self, handler: Callable[[str, str, dict], None]) -> Callable[[], None]:
+        with self._lock:
+            self._subs.append(handler)
+
+        def unsub() -> None:
+            with self._lock:
+                if handler in self._subs:
+                    self._subs.remove(handler)
+
+        return unsub
+
+    # ------------------------------------------------------------- seeding
+
+    def put_node(self, node: dict) -> dict:
+        with self._lock:
+            name = node["metadata"]["name"]
+            is_new = name not in self.nodes
+            meta(node)["resourceVersion"] = self._next_rv()
+            self.nodes[name] = copy.deepcopy(node)
+            self._notify("Node", "ADDED" if is_new else "MODIFIED", self.nodes[name])
+            return copy.deepcopy(self.nodes[name])
+
+    def put_pod(self, pod: dict) -> dict:
+        with self._lock:
+            m = meta(pod)
+            m.setdefault("namespace", "default")
+            m.setdefault("uid", f"uid-{m['name']}-{self._rv}")
+            key = (m["namespace"], m["name"])
+            is_new = key not in self.pods
+            m["resourceVersion"] = self._next_rv()
+            self.pods[key] = copy.deepcopy(pod)
+            self._notify("Pod", "ADDED" if is_new else "MODIFIED", self.pods[key])
+            return copy.deepcopy(self.pods[key])
+
+    def put_quota(self, quota: dict) -> dict:
+        with self._lock:
+            m = meta(quota)
+            m.setdefault("namespace", "default")
+            key = (m["namespace"], m.get("name", "quota"))
+            self.quotas[key] = copy.deepcopy(quota)
+            self._notify("ResourceQuota", "MODIFIED", self.quotas[key])
+            return copy.deepcopy(quota)
+
+    def put_lease(self, lease: dict) -> dict:
+        with self._lock:
+            m = meta(lease)
+            m.setdefault("namespace", "kube-system")
+            self.leases[(m["namespace"], m["name"])] = copy.deepcopy(lease)
+            return copy.deepcopy(lease)
+
+    def remove_node(self, name: str) -> None:
+        with self._lock:
+            node = self.nodes.pop(name, None)
+            if node:
+                self._notify("Node", "DELETED", node)
+
+    def remove_pod(self, namespace: str, name: str) -> None:
+        self.delete_pod(namespace, name)
+
+    # ------------------------------------------------------------- nodes
+
+    def get_node(self, name: str) -> dict:
+        with self._lock:
+            if name not in self.nodes:
+                raise NotFoundError(f"node {name}")
+            return copy.deepcopy(self.nodes[name])
+
+    def list_nodes(self) -> list[dict]:
+        with self._lock:
+            return [copy.deepcopy(n) for n in self.nodes.values()]
+
+    def update_node(self, node: dict) -> dict:
+        with self._lock:
+            name = node["metadata"]["name"]
+            cur = self.nodes.get(name)
+            if cur is None:
+                raise NotFoundError(f"node {name}")
+            if node["metadata"].get("resourceVersion") != cur["metadata"].get("resourceVersion"):
+                raise ConflictError(f"node {name} resourceVersion mismatch")
+            meta(node)["resourceVersion"] = self._next_rv()
+            self.nodes[name] = copy.deepcopy(node)
+            self._notify("Node", "MODIFIED", self.nodes[name])
+            return copy.deepcopy(self.nodes[name])
+
+    def patch_node_annotations(self, name: str, annos: dict[str, Optional[str]]) -> dict:
+        with self._lock:
+            if name not in self.nodes:
+                raise NotFoundError(f"node {name}")
+            node = self.nodes[name]
+            _apply_anno_patch(node, annos)
+            meta(node)["resourceVersion"] = self._next_rv()
+            self._notify("Node", "MODIFIED", node)
+            return copy.deepcopy(node)
+
+    def patch_node_labels(self, name: str, lbls: dict[str, Optional[str]]) -> dict:
+        with self._lock:
+            if name not in self.nodes:
+                raise NotFoundError(f"node {name}")
+            node = self.nodes[name]
+            cur = labels(node)
+            for k, v in lbls.items():
+                if v is None:
+                    cur.pop(k, None)
+                else:
+                    cur[k] = v
+            meta(node)["resourceVersion"] = self._next_rv()
+            self._notify("Node", "MODIFIED", node)
+            return copy.deepcopy(node)
+
+    # ------------------------------------------------------------- pods
+
+    def get_pod(self, namespace: str, name: str) -> dict:
+        with self._lock:
+            key = (namespace, name)
+            if key not in self.pods:
+                raise NotFoundError(f"pod {namespace}/{name}")
+            return copy.deepcopy(self.pods[key])
+
+    def list_pods(self, field_selector: str = "", namespace: str = "") -> list[dict]:
+        with self._lock:
+            out = []
+            for (ns, _), pod in self.pods.items():
+                if namespace and ns != namespace:
+                    continue
+                if field_selector and not _match_field_selector(pod, field_selector):
+                    continue
+                out.append(copy.deepcopy(pod))
+            return out
+
+    def patch_pod_annotations(self, namespace: str, name: str, annos: dict[str, Optional[str]]) -> dict:
+        with self._lock:
+            key = (namespace, name)
+            if key not in self.pods:
+                raise NotFoundError(f"pod {namespace}/{name}")
+            pod = self.pods[key]
+            _apply_anno_patch(pod, annos)
+            meta(pod)["resourceVersion"] = self._next_rv()
+            self._notify("Pod", "MODIFIED", pod)
+            return copy.deepcopy(pod)
+
+    def bind_pod(self, namespace: str, name: str, node: str) -> None:
+        with self._lock:
+            key = (namespace, name)
+            if key not in self.pods:
+                raise NotFoundError(f"pod {namespace}/{name}")
+            pod = self.pods[key]
+            pod.setdefault("spec", {})["nodeName"] = node
+            meta(pod)["resourceVersion"] = self._next_rv()
+            self.bindings.append((namespace, name, node))
+            self._notify("Pod", "MODIFIED", pod)
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        with self._lock:
+            pod = self.pods.pop((namespace, name), None)
+            if pod:
+                self._notify("Pod", "DELETED", pod)
+
+    # ------------------------------------------------------------- misc
+
+    def create_event(self, namespace: str, event: dict) -> None:
+        with self._lock:
+            self.events.append(copy.deepcopy(event))
+
+    def list_resource_quotas(self) -> list[dict]:
+        with self._lock:
+            return [copy.deepcopy(q) for q in self.quotas.values()]
+
+    def get_lease(self, namespace: str, name: str) -> Optional[dict]:
+        with self._lock:
+            lease = self.leases.get((namespace, name))
+            return copy.deepcopy(lease) if lease else None
+
+
+def _match_field_selector(pod: dict, selector: str) -> bool:
+    for clause in selector.split(","):
+        if not clause:
+            continue
+        neg = "!=" in clause
+        field_name, _, want = clause.partition("!=" if neg else "=")
+        if not neg and want.startswith("="):  # '==' form
+            want = want[1:]
+        got = _field_value(pod, field_name.strip())
+        if neg:
+            if got == want:
+                return False
+        elif got != want:
+            return False
+    return True
+
+
+def _field_value(pod: dict, path: str) -> str:
+    if path == "spec.nodeName":
+        return pod.get("spec", {}).get("nodeName", "") or ""
+    if path == "status.phase":
+        return pod.get("status", {}).get("phase", "") or ""
+    if path == "metadata.name":
+        return pod.get("metadata", {}).get("name", "") or ""
+    if path == "metadata.namespace":
+        return pod.get("metadata", {}).get("namespace", "") or ""
+    return ""
+
+
+class RealKubeClient(KubeClient):
+    """Minimal REST client. In-cluster (service account) or kubeconfig-based.
+
+    Only the verbs the middleware uses; JSON merge-patch for annotations/labels,
+    POST /bind subresource for binding, HTTP watch streaming for subscribers.
+    """
+
+    def __init__(self, base_url: str = "", token: str = "", ca_cert: str | bool = True, timeout: float = 30.0):
+        import requests  # local import: tests never need it
+
+        self._requests = requests
+        self._timeout = timeout
+        self._session = requests.Session()
+        if not base_url:
+            host = os.environ.get("KUBERNETES_SERVICE_HOST", "kubernetes.default.svc")
+            port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+            base_url = f"https://{host}:{port}"
+            token_path = "/var/run/secrets/kubernetes.io/serviceaccount/token"
+            ca_path = "/var/run/secrets/kubernetes.io/serviceaccount/ca.crt"
+            if not token and os.path.exists(token_path):
+                token = open(token_path).read().strip()
+            if ca_cert is True and os.path.exists(ca_path):
+                ca_cert = ca_path
+        self._base = base_url.rstrip("/")
+        if token:
+            self._session.headers["Authorization"] = f"Bearer {token}"
+        self._session.verify = ca_cert
+        self._watch_threads: list[threading.Thread] = []
+        self._subs: list[Callable[[str, str, dict], None]] = []
+        self._stop = threading.Event()
+
+    # ------------------------------------------------------------- plumbing
+
+    def _req(self, method: str, path: str, body=None, headers=None, params=None) -> dict:
+        r = self._session.request(
+            method,
+            self._base + path,
+            json=body,
+            headers=headers,
+            params=params,
+            timeout=self._timeout,
+        )
+        if r.status_code == 404:
+            raise NotFoundError(path)
+        if r.status_code == 409:
+            raise ConflictError(path)
+        if r.status_code >= 400:
+            raise ApiError(r.status_code, r.text[:500])
+        return r.json() if r.content else {}
+
+    def _merge_patch(self, path: str, patch: dict) -> dict:
+        return self._req(
+            "PATCH", path, body=patch, headers={"Content-Type": "application/merge-patch+json"}
+        )
+
+    # ------------------------------------------------------------- verbs
+
+    def get_node(self, name: str) -> dict:
+        return self._req("GET", f"/api/v1/nodes/{name}")
+
+    def list_nodes(self) -> list[dict]:
+        return self._req("GET", "/api/v1/nodes").get("items", [])
+
+    def update_node(self, node: dict) -> dict:
+        return self._req("PUT", f"/api/v1/nodes/{node['metadata']['name']}", body=node)
+
+    def patch_node_annotations(self, name: str, annos: dict[str, Optional[str]]) -> dict:
+        return self._merge_patch(f"/api/v1/nodes/{name}", {"metadata": {"annotations": annos}})
+
+    def patch_node_labels(self, name: str, lbls: dict[str, Optional[str]]) -> dict:
+        return self._merge_patch(f"/api/v1/nodes/{name}", {"metadata": {"labels": lbls}})
+
+    def get_pod(self, namespace: str, name: str) -> dict:
+        return self._req("GET", f"/api/v1/namespaces/{namespace}/pods/{name}")
+
+    def list_pods(self, field_selector: str = "", namespace: str = "") -> list[dict]:
+        path = f"/api/v1/namespaces/{namespace}/pods" if namespace else "/api/v1/pods"
+        params = {"fieldSelector": field_selector} if field_selector else None
+        return self._req("GET", path, params=params).get("items", [])
+
+    def patch_pod_annotations(self, namespace: str, name: str, annos: dict[str, Optional[str]]) -> dict:
+        return self._merge_patch(
+            f"/api/v1/namespaces/{namespace}/pods/{name}", {"metadata": {"annotations": annos}}
+        )
+
+    def bind_pod(self, namespace: str, name: str, node: str) -> None:
+        self._req(
+            "POST",
+            f"/api/v1/namespaces/{namespace}/pods/{name}/binding",
+            body={
+                "apiVersion": "v1",
+                "kind": "Binding",
+                "metadata": {"name": name, "namespace": namespace},
+                "target": {"apiVersion": "v1", "kind": "Node", "name": node},
+            },
+        )
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        self._req("DELETE", f"/api/v1/namespaces/{namespace}/pods/{name}")
+
+    def create_event(self, namespace: str, event: dict) -> None:
+        self._req("POST", f"/api/v1/namespaces/{namespace}/events", body=event)
+
+    def list_resource_quotas(self) -> list[dict]:
+        return self._req("GET", "/api/v1/resourcequotas").get("items", [])
+
+    def get_lease(self, namespace: str, name: str) -> Optional[dict]:
+        try:
+            return self._req(
+                "GET", f"/apis/coordination.k8s.io/v1/namespaces/{namespace}/leases/{name}"
+            )
+        except NotFoundError:
+            return None
+
+    # ------------------------------------------------------------- watch
+
+    def subscribe(self, handler: Callable[[str, str, dict], None]) -> Callable[[], None]:
+        self._subs.append(handler)
+        if not self._watch_threads:
+            for kind, path in (("Node", "/api/v1/nodes"), ("Pod", "/api/v1/pods"),
+                               ("ResourceQuota", "/api/v1/resourcequotas")):
+                th = threading.Thread(target=self._watch_loop, args=(kind, path), daemon=True)
+                th.start()
+                self._watch_threads.append(th)
+
+        def unsub() -> None:
+            if handler in self._subs:
+                self._subs.remove(handler)
+
+        return unsub
+
+    def _watch_loop(self, kind: str, path: str) -> None:
+        rv = ""
+        while not self._stop.is_set():
+            try:
+                params = {"watch": "true"}
+                if rv:
+                    params["resourceVersion"] = rv
+                r = self._session.get(
+                    self._base + path, params=params, stream=True, timeout=(10, 300)
+                )
+                for line in r.iter_lines():
+                    if self._stop.is_set():
+                        return
+                    if not line:
+                        continue
+                    evt = json.loads(line)
+                    obj = evt.get("object", {})
+                    if evt.get("type") == "ERROR":
+                        # e.g. 410 Gone after etcd compaction: the rv is stale and
+                        # the Status object must not reach subscribers. Restart
+                        # the watch from a fresh list.
+                        rv = ""
+                        break
+                    rv = obj.get("metadata", {}).get("resourceVersion", rv)
+                    for h in list(self._subs):
+                        h(kind, evt.get("type", "MODIFIED"), obj)
+            except Exception:
+                time.sleep(2)
+
+    def close(self) -> None:
+        self._stop.set()
+
+
+_global_client: Optional[KubeClient] = None
+_global_lock = threading.Lock()
+
+
+def init_global_client(client: Optional[KubeClient] = None) -> KubeClient:
+    """Install the process-wide client (reference client.go InitGlobalClient)."""
+    global _global_client
+    with _global_lock:
+        _global_client = client or RealKubeClient()
+        return _global_client
+
+
+def get_client() -> KubeClient:
+    if _global_client is None:
+        raise RuntimeError("k8s client not initialised; call init_global_client()")
+    return _global_client

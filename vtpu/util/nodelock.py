@@ -1,0 +1,152 @@
+"""Distributed per-node mutex via a node annotation.
+
+Parity: reference pkg/util/nodelock/nodelock.go:39-286. The lock serializes
+"pods in flight" per node so the device plugin's Allocate can unambiguously
+resolve THE pending pod from annotations. Value format::
+
+    <RFC3339 timestamp>,<namespace>,<podname>
+
+Semantics (reference LockNode:218-259):
+- CAS on the node object (resourceVersion) so two schedulers can't both win;
+- an in-process mutex per node avoids spinning against ourselves;
+- expired locks (default 5 min, ``VTPU_NODELOCK_EXPIRE`` seconds) are stolen;
+- locks whose owner pod no longer exists (dangling) are stolen;
+- release only removes the annotation if we (ns/pod) own it.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+
+from vtpu.util import timeutil
+from vtpu.util import types as t
+from vtpu.util.k8sclient import ConflictError, KubeClient, NotFoundError, annotations
+
+log = logging.getLogger(__name__)
+
+DEFAULT_EXPIRE_SECONDS = 300.0
+MAX_RETRIES = 5
+RETRY_BACKOFF = 0.1
+
+
+class NodeLockContention(Exception):
+    """Raised when another pod holds the node lock (reference ErrNodeLockContention)."""
+
+
+_process_locks: dict[str, threading.Lock] = {}
+_process_locks_guard = threading.Lock()
+
+
+def _proc_lock(node: str) -> threading.Lock:
+    with _process_locks_guard:
+        return _process_locks.setdefault(node, threading.Lock())
+
+
+def reset_for_test() -> None:
+    """Drop in-process lock state (reference nodelock test_helpers.go)."""
+    with _process_locks_guard:
+        _process_locks.clear()
+
+
+def _expire_seconds() -> float:
+    try:
+        return float(os.environ.get("VTPU_NODELOCK_EXPIRE", DEFAULT_EXPIRE_SECONDS))
+    except ValueError:
+        return DEFAULT_EXPIRE_SECONDS
+
+
+def format_lock_value(pod: dict, now: float | None = None) -> str:
+    m = pod["metadata"]
+    return f"{timeutil.format_ts(now)},{m.get('namespace', 'default')},{m.get('name', '')}"
+
+
+def parse_node_lock(value: str) -> tuple[float | None, str, str]:
+    """-> (timestamp | None, namespace, podname). Legacy bare-timestamp values
+    parse with empty ns/pod (reference ParseNodeLock)."""
+    parts = value.split(",")
+    ts = timeutil.parse_ts(parts[0])
+    ns = parts[1] if len(parts) > 1 else ""
+    name = parts[2] if len(parts) > 2 else ""
+    return ts, ns, name
+
+
+def _owner_is_dangling(client: KubeClient, ns: str, name: str) -> bool:
+    if not ns or not name:
+        return False
+    try:
+        client.get_pod(ns, name)
+        return False
+    except NotFoundError:
+        return True
+
+
+def lock_node(client: KubeClient, node_name: str, pod: dict, now: float | None = None) -> None:
+    """Acquire the node lock for *pod* or raise NodeLockContention."""
+    plock = _proc_lock(node_name)
+    if not plock.acquire(timeout=_expire_seconds()):
+        raise NodeLockContention(f"in-process lock busy for node {node_name}")
+    try:
+        for attempt in range(MAX_RETRIES):
+            node = client.get_node(node_name)
+            cur = annotations(node).get(t.NODE_LOCK_ANNO, "")
+            wall = now if now is not None else time.time()
+            if cur:
+                ts, ns, name = parse_node_lock(cur)
+                expired = ts is None or (wall - ts) > _expire_seconds()
+                mine = (
+                    ns == pod["metadata"].get("namespace", "default")
+                    and name == pod["metadata"].get("name", "")
+                )
+                # Only pay the owner-pod GET when it can change the outcome.
+                dangling = (
+                    not expired and not mine and _owner_is_dangling(client, ns, name)
+                )
+                if not (expired or dangling or mine):
+                    raise NodeLockContention(
+                        f"node {node_name} locked by {ns}/{name} since {cur.split(',')[0]}"
+                    )
+                if expired or dangling:
+                    log.warning(
+                        "stealing %s node lock on %s held by %s/%s",
+                        "expired" if expired else "dangling",
+                        node_name, ns, name,
+                    )
+            annotations(node)[t.NODE_LOCK_ANNO] = format_lock_value(pod, wall)
+            try:
+                client.update_node(node)
+                return
+            except ConflictError:
+                time.sleep(RETRY_BACKOFF * (attempt + 1))
+        raise NodeLockContention(f"node {node_name}: CAS retries exhausted")
+    finally:
+        plock.release()
+
+
+def release_node_lock(client: KubeClient, node_name: str, pod: dict) -> None:
+    """Drop the lock if owned by *pod* (no-op otherwise, reference
+    ReleaseNodeLock)."""
+    for attempt in range(MAX_RETRIES):
+        try:
+            node = client.get_node(node_name)
+        except NotFoundError:
+            return
+        cur = annotations(node).get(t.NODE_LOCK_ANNO, "")
+        if not cur:
+            return
+        _, ns, name = parse_node_lock(cur)
+        if ns and (
+            ns != pod["metadata"].get("namespace", "default")
+            or name != pod["metadata"].get("name", "")
+        ):
+            log.debug("not releasing %s lock held by %s/%s", node_name, ns, name)
+            return
+        del annotations(node)[t.NODE_LOCK_ANNO]
+        try:
+            client.update_node(node)
+            return
+        except ConflictError:
+            time.sleep(RETRY_BACKOFF * (attempt + 1))
+    log.warning("release_node_lock: CAS retries exhausted for %s", node_name)

@@ -1,0 +1,25 @@
+"""UTC-safe RFC3339 timestamps for annotation protocols.
+
+All wall-clock marks in annotations (handshake, node lock, bind time) are
+emitted in UTC with an explicit offset and parsed offset-aware, so scheduler
+and node-agent containers in different timezones agree on staleness.
+"""
+
+from __future__ import annotations
+
+import time
+from datetime import datetime, timezone
+
+from vtpu.util import types as t
+
+
+def format_ts(epoch: float | None = None) -> str:
+    dt = datetime.fromtimestamp(epoch if epoch is not None else time.time(), tz=timezone.utc)
+    return dt.strftime(t.TIME_LAYOUT)
+
+
+def parse_ts(s: str) -> float | None:
+    try:
+        return datetime.strptime(s, t.TIME_LAYOUT).timestamp()
+    except ValueError:
+        return None

@@ -1,0 +1,63 @@
+"""Protocol constants: annotation keys, bind phases, scheduling policies.
+
+Parity: reference pkg/util/types.go:19-96 defines the HAMi annotation namespace
+(``hami.io/*``) and policy names. This is the vTPU equivalent under ``vtpu.io/*``.
+All scheduler <-> device-plugin communication rides on these keys; annotations ARE
+the database (reference scheduler.go:138-168 replays them on restart).
+"""
+
+from __future__ import annotations
+
+# --- Scheduler identity -----------------------------------------------------
+SCHEDULER_NAME = "vtpu-scheduler"
+
+# --- Pod annotations written by the scheduler (reference types.go:28-47) ----
+ASSIGNED_NODE = "vtpu.io/vtpu-node"  # node chosen by Filter
+ASSIGNED_TIME = "vtpu.io/vtpu-time"  # unix seconds of the Filter decision
+BIND_PHASE = "vtpu.io/bind-phase"  # allocating | success | failed
+BIND_TIME = "vtpu.io/bind-time"  # unix seconds when Bind ran
+
+BIND_PHASE_ALLOCATING = "allocating"
+BIND_PHASE_SUCCESS = "success"
+BIND_PHASE_FAILED = "failed"
+
+# Per-vendor "devices to allocate / allocated" pod annotations are owned by each
+# device backend (e.g. vtpu.io/tpu-devices-to-allocate, see device/tpu/device.py),
+# mirroring hami.io/vgpu-devices-to-allocate (reference nvidia/device.go:517-527).
+
+# --- Per-pod scheduling overrides (reference types.go:83-88) ----------------
+NODE_SCHEDULER_POLICY_ANNO = "vtpu.io/node-scheduler-policy"  # binpack|spread
+DEVICE_SCHEDULER_POLICY_ANNO = "vtpu.io/device-scheduler-policy"  # binpack|spread|mutex
+USE_DEVICE_UUID_ANNO = "vtpu.io/use-tpuuuid"  # comma-separated allowlist
+NO_USE_DEVICE_UUID_ANNO = "vtpu.io/nouse-tpuuuid"  # comma-separated denylist
+USE_DEVICE_TYPE_ANNO = "vtpu.io/use-tputype"
+NO_USE_DEVICE_TYPE_ANNO = "vtpu.io/nouse-tputype"
+NUMA_BIND_ANNO = "vtpu.io/numa-bind"  # "true" -> keep all devices on one NUMA node
+TASK_PRIORITY_ANNO = "vtpu.io/task-priority"  # 0 (low, default) | 1 (high)
+
+# --- Node annotations -------------------------------------------------------
+NODE_LOCK_ANNO = "vtpu.io/mutex.lock"  # RFC3339,<ns>,<pod> (reference nodelock.go:39)
+NODE_HANDSHAKE_PREFIX = "vtpu.io/node-handshake-"  # + vendor common-word
+NODE_REGISTER_SUFFIX = "-register"  # vtpu.io/node-<vendor>-register
+
+HANDSHAKE_REQUESTING = "Requesting"
+HANDSHAKE_DELETED = "Deleted"
+
+# A registration older than this (scheduler side) marks the vendor unhealthy on the
+# node and its devices are withdrawn (reference devices.go:538-577: 60s stale rule).
+HANDSHAKE_TIMEOUT_SECONDS = 60.0
+
+# --- Scheduling policies (reference types.go:60-76) -------------------------
+NODE_POLICY_BINPACK = "binpack"
+NODE_POLICY_SPREAD = "spread"
+DEVICE_POLICY_BINPACK = "binpack"
+DEVICE_POLICY_SPREAD = "spread"
+DEVICE_POLICY_MUTEX = "mutex"  # busy-first: pack shared pods away from exclusive ones
+NODE_POLICY_TOPOLOGY = "topology-aware"
+
+# Weight used when folding usage ratios into a node score
+# (reference types.go:95 Weight=10).
+NODE_SCORE_WEIGHT = 10.0
+
+# --- Time format ------------------------------------------------------------
+TIME_LAYOUT = "%Y-%m-%dT%H:%M:%S%z"  # RFC3339, second resolution
