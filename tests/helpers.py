@@ -1,0 +1,63 @@
+"""Shared test fixtures: fake clusters, pods, registered backends."""
+
+from __future__ import annotations
+
+from vtpu.device import codec
+from vtpu.device.quota import QuotaManager
+from vtpu.device.registry import register_backend
+from vtpu.device.tpu.device import TpuConfig, TpuDevices
+from vtpu.device.tpu.topology import default_ici_mesh
+from vtpu.device.types import DeviceInfo
+from vtpu.util.k8sclient import FakeKubeClient
+
+REGISTER_ANNO = "vtpu.io/node-tpu-register"
+
+
+def v5e_devices(n=8, prefix="v5e", count=4, devmem=16384):
+    mesh = default_ici_mesh(n)
+    return [
+        DeviceInfo(
+            id=f"{prefix}-{i}", count=count, devmem=devmem, devcore=100,
+            type="TPU-v5e", numa=0 if i < n // 2 else 1, ici=mesh[i], index=i,
+        )
+        for i in range(n)
+    ]
+
+
+def fake_cluster(nodes: dict[str, list[DeviceInfo]]) -> FakeKubeClient:
+    client = FakeKubeClient()
+    for name, devices in nodes.items():
+        client.put_node({
+            "metadata": {
+                "name": name,
+                "annotations": {REGISTER_ANNO: codec.encode_node_devices(devices)},
+            }
+        })
+    return client
+
+
+def register_tpu_backend(quota: QuotaManager | None = None, **cfg) -> TpuDevices:
+    backend = TpuDevices(TpuConfig(**cfg), quota=quota)
+    register_backend(backend)
+    if quota is not None:
+        quota.refresh_managed_resources()
+    return backend
+
+
+def tpu_pod(name, tpu=None, tpumem=None, tpucores=None, ns="default", annotations=None,
+            extra_containers=0):
+    limits = {}
+    if tpu is not None:
+        limits["google.com/tpu"] = str(tpu)
+    if tpumem is not None:
+        limits["google.com/tpumem"] = str(tpumem)
+    if tpucores is not None:
+        limits["google.com/tpucores"] = str(tpucores)
+    containers = [{"name": "main", "resources": {"limits": limits}}]
+    for i in range(extra_containers):
+        containers.append({"name": f"side{i}", "resources": {}})
+    return {
+        "metadata": {"name": name, "namespace": ns, "uid": f"uid-{name}",
+                     "annotations": dict(annotations or {})},
+        "spec": {"containers": containers},
+    }

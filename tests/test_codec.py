@@ -90,3 +90,13 @@ def test_handshake_is_utc_safe():
     assert v.endswith("+0000")
     _, ts = codec.parse_handshake(v)
     assert ts == 1700000000.0
+
+
+def test_malformed_segments_raise_codec_error_not_valueerror():
+    """Regression: right arity, wrong content -> CodecError."""
+    with pytest.raises(codec.CodecError):
+        codec.decode_node_devices("dev0,x,16384,100,TPU-v5e,0,true,0-0-0")
+    with pytest.raises(codec.CodecError):
+        codec.decode_node_devices("dev0,4,16384,100,TPU-v5e,0,true,0-0")
+    with pytest.raises(codec.CodecError):
+        codec.decode_container_devices("a,T,notanint,5:")

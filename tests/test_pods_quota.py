@@ -86,3 +86,43 @@ def test_quota_byte_suffix_normalizes_to_mib():
                   "spec": {"hard": {"limits.google.com/tpumem": "16Gi"}}})
     assert qm.fit_quota("ns", "TPU", memreq=16384, coresreq=0)
     assert not qm.fit_quota("ns", "TPU", memreq=16385, coresreq=0)
+
+
+def test_quota_multiple_objects_per_namespace():
+    """Regression: two quotas in one ns both apply (min wins); deleting one
+    keeps the other."""
+    qm = _quota_mgr()
+    qa = {"metadata": {"name": "qa", "namespace": "ns"},
+          "spec": {"hard": {"limits.google.com/tpumem": 8192}}}
+    qb = {"metadata": {"name": "qb", "namespace": "ns"},
+          "spec": {"hard": {"limits.google.com/tpu": 2,
+                            "limits.google.com/tpumem": 4096}}}
+    qm.add_quota(qa)
+    qm.add_quota(qb)
+    assert not qm.fit_quota("ns", "TPU", memreq=4097, coresreq=0)  # min(8192,4096)
+    qm.del_quota(qb)
+    assert qm.fit_quota("ns", "TPU", memreq=8192, coresreq=0)
+    assert not qm.fit_quota("ns", "TPU", memreq=8193, coresreq=0)  # qa survives
+
+
+def test_quota_reparse_after_registry_refresh():
+    """Regression: quotas seen before backends register are re-parsed."""
+    from vtpu.device.quota import QuotaManager
+    from tests.helpers import register_tpu_backend
+    qm = QuotaManager()  # empty _managed
+    qm.add_quota({"metadata": {"name": "q", "namespace": "ns"},
+                  "spec": {"hard": {"limits.google.com/tpumem": 1024}}})
+    assert qm.fit_quota("ns", "TPU", memreq=4096, coresreq=0)  # not yet managed
+    register_tpu_backend(quota=qm)  # calls refresh_managed_resources
+    assert not qm.fit_quota("ns", "TPU", memreq=4096, coresreq=0)
+
+
+def test_quota_weird_quantities_do_not_crash():
+    """Regression: Ti and milli quantities parse; garbage is skipped."""
+    qm = _quota_mgr()
+    qm.add_quota({"metadata": {"name": "q", "namespace": "ns"},
+                  "spec": {"hard": {"limits.google.com/tpumem": "1Ti",
+                                    "limits.google.com/tpucores": "half",
+                                    "limits.google.com/tpu": "2500m"}}})
+    assert not qm.fit_quota("ns", "TPU", memreq=1024 * 1024 + 1, coresreq=0)
+    assert qm.fit_quota("ns", "TPU", memreq=0, coresreq=10**9)  # garbage skipped

@@ -1,0 +1,164 @@
+"""Scheduler Filter/Bind over a fake cluster — the reference's core test
+strategy (scheduler_test.go, score_test.go): fabricate node annotations, run
+the extender protocol, assert chosen node + patched annotations."""
+
+import pytest
+
+from vtpu.device.quota import QuotaManager
+from vtpu.scheduler.scheduler import Scheduler
+from vtpu.util import types as t
+from vtpu.util.k8sclient import annotations
+
+from tests.helpers import fake_cluster, register_tpu_backend, tpu_pod, v5e_devices
+
+
+@pytest.fixture
+def cluster():
+    client = fake_cluster({
+        "node-a": v5e_devices(8, prefix="a"),
+        "node-b": v5e_devices(8, prefix="b"),
+    })
+    sched = Scheduler(client)
+    register_tpu_backend(quota=sched.quota_manager)
+    sched.start(register_interval=3600)
+    yield client, sched
+    sched.stop()
+
+
+def _filter(sched, client, pod, nodes=("node-a", "node-b")):
+    pod = client.put_pod(pod)
+    return pod, sched.filter({"Pod": pod, "NodeNames": list(nodes)})
+
+
+def test_filter_picks_node_and_patches_annotations(cluster):
+    client, sched = cluster
+    pod, result = _filter(sched, client, tpu_pod("p1", tpumem=4096))
+    assert result["Error"] == ""
+    assert len(result["NodeNames"]) == 1
+    winner = result["NodeNames"][0]
+    stored = client.get_pod("default", "p1")
+    annos = annotations(stored)
+    assert annos[t.ASSIGNED_NODE] == winner
+    assert "vtpu.io/tpu-devices-to-allocate" in annos
+    assert annos["vtpu.io/tpu-devices-to-allocate"].count(",") >= 3
+    # usage is visible in the snapshot
+    usage = sched.inspect_all_nodes_usage()[winner]["TPU"]
+    assert sum(d.usedmem for d in usage) == 4096
+
+
+def test_filter_binpack_consolidates(cluster):
+    client, sched = cluster
+    _, r1 = _filter(sched, client, tpu_pod("p1", tpumem=2048))
+    _, r2 = _filter(sched, client, tpu_pod("p2", tpumem=2048))
+    assert r1["NodeNames"] == r2["NodeNames"]  # same node
+    # and same chip (device binpack)
+    usage = sched.inspect_all_nodes_usage()[r1["NodeNames"][0]]["TPU"]
+    shared = [d for d in usage if d.used == 2]
+    assert len(shared) == 1
+
+
+def test_filter_spread_policy_annotation(cluster):
+    client, sched = cluster
+    _, r1 = _filter(sched, client, tpu_pod("p1", tpumem=2048))
+    pod2 = tpu_pod("p2", tpumem=2048,
+                   annotations={t.NODE_SCHEDULER_POLICY_ANNO: t.NODE_POLICY_SPREAD})
+    _, r2 = _filter(sched, client, pod2)
+    assert r1["NodeNames"] != r2["NodeNames"]
+
+
+def test_filter_no_fit_reports_reasons(cluster):
+    client, sched = cluster
+    pod, result = _filter(sched, client, tpu_pod("big", tpu=16))
+    assert result["NodeNames"] == []
+    assert set(result["FailedNodes"]) == {"node-a", "node-b"}
+    assert client.events, "FilteringFailed event expected"
+    assert client.events[-1]["reason"] == "FilteringFailed"
+
+
+def test_filter_non_device_pod_errors(cluster):
+    client, sched = cluster
+    pod = client.put_pod({"metadata": {"name": "plain", "namespace": "default"},
+                          "spec": {"containers": [{"name": "c", "resources": {}}]}})
+    result = sched.filter({"Pod": pod, "NodeNames": ["node-a"]})
+    assert "no schedulable device" in result["Error"]
+
+
+def test_bind_locks_node_and_binds(cluster):
+    client, sched = cluster
+    pod, result = _filter(sched, client, tpu_pod("p1", tpumem=4096))
+    winner = result["NodeNames"][0]
+    bind_result = sched.bind({"PodName": "p1", "PodNamespace": "default", "Node": winner})
+    assert bind_result["Error"] == ""
+    assert client.bindings == [("default", "p1", winner)]
+    annos = annotations(client.get_pod("default", "p1"))
+    assert annos[t.BIND_PHASE] == t.BIND_PHASE_ALLOCATING
+    # node lock held by p1
+    assert "default,p1" in annotations(client.get_node(winner))[t.NODE_LOCK_ANNO]
+
+
+def test_bind_contention_releases_and_reports(cluster):
+    client, sched = cluster
+    _, r1 = _filter(sched, client, tpu_pod("p1", tpumem=1024))
+    winner = r1["NodeNames"][0]
+    assert sched.bind({"PodName": "p1", "PodNamespace": "default", "Node": winner})["Error"] == ""
+    # second pod tries to bind onto the locked node
+    _, r2 = _filter(sched, client, tpu_pod("p2", tpumem=1024, annotations={
+        t.USE_DEVICE_UUID_ANNO: f"{winner.split('-')[1]}-0"}))
+    res = sched.bind({"PodName": "p2", "PodNamespace": "default", "Node": winner})
+    assert "locked" in res["Error"]
+    # p2's decision was rolled back
+    annos = annotations(client.get_pod("default", "p2"))
+    assert t.ASSIGNED_NODE not in annos
+    assert not sched.pod_manager.has_pod(client.get_pod("default", "p2")["metadata"]["uid"])
+
+
+def test_pod_delete_frees_usage(cluster):
+    client, sched = cluster
+    _, result = _filter(sched, client, tpu_pod("p1", tpumem=4096))
+    winner = result["NodeNames"][0]
+    client.delete_pod("default", "p1")
+    usage = sched.inspect_all_nodes_usage()[winner]["TPU"]
+    assert sum(d.usedmem for d in usage) == 0
+
+
+def test_restart_replays_annotations():
+    """Annotations are the database: a fresh Scheduler rebuilds usage from
+    scheduled pods (reference onAddPod replay)."""
+    client = fake_cluster({"node-a": v5e_devices(8, prefix="a")})
+    sched = Scheduler(client)
+    register_tpu_backend(quota=sched.quota_manager)
+    sched.start(register_interval=3600)
+    _filter(sched, client, tpu_pod("p1", tpumem=4096))
+    sched.stop()
+
+    sched2 = Scheduler(client)
+    sched2.start(register_interval=3600)
+    usage = sched2.inspect_all_nodes_usage()["node-a"]["TPU"]
+    assert sum(d.usedmem for d in usage) == 4096
+    sched2.stop()
+
+
+def test_simulation_path_scores_without_patching(cluster):
+    client, sched = cluster
+    pod = client.put_pod(tpu_pod("sim", tpumem=1024))
+    result = sched.filter({
+        "Pod": pod,
+        "Nodes": {"Items": [client.get_node("node-a"), client.get_node("node-b")]},
+    })
+    assert len(result["NodeNames"]) == 1
+    assert t.ASSIGNED_NODE not in annotations(client.get_pod("default", "sim"))
+
+
+def test_handshake_withdraws_dead_agent():
+    import vtpu.device.codec as codec
+    client = fake_cluster({"node-a": v5e_devices(8, prefix="a")})
+    sched = Scheduler(client)
+    backend = register_tpu_backend(quota=sched.quota_manager)
+    sched.start(register_interval=3600)
+    assert "node-a" in sched.inspect_all_nodes_usage()
+    # a stale Requesting mark (dead plugin) withdraws the node's devices
+    client.patch_node_annotations("node-a", {
+        backend.handshake_annotation(): "Requesting_2020-01-01T00:00:00+0000"})
+    sched.register_from_node_annotations()
+    assert "node-a" not in sched.inspect_all_nodes_usage()
+    sched.stop()

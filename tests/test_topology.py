@@ -1,0 +1,65 @@
+"""ICI sub-slice selection (reference links.go + kunlun/topo.go analogs)."""
+
+from vtpu.device.tpu import topology
+from vtpu.device.types import DeviceUsage, IciCoord
+
+
+def _usage(uid, x, y, used=0):
+    return DeviceUsage(id=uid, used=used, count=4, totalmem=16384, totalcore=100,
+                       ici=IciCoord(x, y, 0))
+
+
+def _grid(used_ids=()):
+    """2x4 v5e-8 mesh: ids g<x><y>."""
+    return [
+        _usage(f"g{x}{y}", x, y, used=1 if f"g{x}{y}" in used_ids else 0)
+        for y in range(2)
+        for x in range(4)
+    ]
+
+
+def test_pair_prefers_adjacent():
+    devs = _grid()
+    chosen = topology.select_subslice(devs, 2)
+    a, b = (d.ici for d in chosen)
+    assert a.distance(b) == 1
+
+
+def test_quad_prefers_2x2_square():
+    chosen = topology.select_subslice(_grid(), 4)
+    xs = sorted(d.ici.x for d in chosen)
+    ys = sorted(d.ici.y for d in chosen)
+    # a 2x2 block: two distinct x, two distinct y
+    assert len(set(xs)) == 2 and len(set(ys)) == 2
+    assert max(xs) - min(xs) == 1
+
+
+def test_full_slice():
+    chosen = topology.select_subslice(_grid(), 8)
+    assert len(chosen) == 8
+
+
+def test_insufficient_returns_none():
+    assert topology.select_subslice(_grid()[:3], 4) is None
+
+
+def test_avoids_stranding_free_chips():
+    # chips g00,g10 busy; asking for 2 should NOT carve the middle of the
+    # remaining free block in a way that strands a lone corner.
+    devs = _grid(used_ids={"g00", "g10"})
+    free_before = [d for d in devs if d.used == 0]
+    chosen = topology.select_subslice(free_before, 2)
+    coords = [d.ici for d in chosen]
+    assert coords[0].distance(coords[1]) == 1
+    # remaining free chips must all still have a free neighbor
+    remaining = [d for d in free_before if d not in chosen]
+    for d in remaining:
+        assert any(d.ici.distance(o.ici) == 1 for o in remaining if o is not d)
+
+
+def test_default_mesh_shapes():
+    m8 = topology.default_ici_mesh(8)
+    assert len(m8) == 8
+    assert max(c.x for c in m8) == 3 and max(c.y for c in m8) == 1
+    m3 = topology.default_ici_mesh(3)
+    assert [c.x for c in m3] == [0, 1, 2]

@@ -1,0 +1,129 @@
+"""TPU backend: request generation, admission mutation, Fit semantics
+(reference pkg/device/nvidia/device_test.go analog)."""
+
+from vtpu.device import common
+from vtpu.device.quota import QuotaManager
+from vtpu.device.types import DeviceUsage, NodeInfo
+from vtpu.util import types as t
+
+from tests.helpers import register_tpu_backend, tpu_pod, v5e_devices
+
+
+def _usages(n=8, **kw):
+    return [DeviceUsage.from_info(d) for d in v5e_devices(n, **kw)]
+
+
+def _fit(backend, devices, pod, allocated=None):
+    req = backend.generate_resource_requests(pod["spec"]["containers"][0])
+    return backend.fit(devices, req, pod, NodeInfo(node_name="n1"), allocated or {})
+
+
+def test_generate_requests_defaults():
+    b = register_tpu_backend()
+    # fractional ask without count -> one chip
+    r = b.generate_resource_requests(
+        {"resources": {"limits": {"google.com/tpumem": "4096"}}})
+    assert (r.nums, r.memreq, r.coresreq) == (1, 4096, 0)
+    # count only -> whole-chip HBM via percentage
+    r = b.generate_resource_requests(
+        {"resources": {"limits": {"google.com/tpu": "2"}}})
+    assert (r.nums, r.memreq, r.mem_percentage_req) == (2, 0, 100)
+    # nothing -> empty
+    assert b.generate_resource_requests({"resources": {}}).empty()
+
+
+def test_mutate_admission_infers_count_and_priority():
+    b = register_tpu_backend()
+    pod = tpu_pod("p", tpumem=4096, annotations={t.TASK_PRIORITY_ANNO: "1"})
+    ctr = pod["spec"]["containers"][0]
+    assert b.mutate_admission(ctr, pod)
+    assert ctr["resources"]["limits"]["google.com/tpu"] == "1"
+    assert {"name": "VTPU_TASK_PRIORITY", "value": "1"} in ctr["env"]
+    assert not b.mutate_admission({"resources": {"limits": {"cpu": "1"}}}, pod)
+
+
+def test_fit_shares_chip_until_split_exhausted():
+    b = register_tpu_backend()
+    devices = _usages(1)
+    pod = tpu_pod("p", tpumem=4096)
+    for i in range(4):  # split count 4
+        ok, result, reason = _fit(b, devices, pod)
+        assert ok, reason
+        devices[0].add(result["TPU"][0], f"default/p{i}")
+    ok, _, reason = _fit(b, devices, pod)
+    assert not ok
+    assert common.CARD_TIME_SLICING_EXHAUSTED in reason
+
+
+def test_fit_memory_exhaustion():
+    b = register_tpu_backend()
+    devices = _usages(1)
+    devices[0].usedmem = 13000
+    devices[0].used = 1
+    ok, _, reason = _fit(b, devices, tpu_pod("p", tpumem=4096))
+    assert not ok and common.CARD_INSUFFICIENT_MEMORY in reason
+    ok, _, _ = _fit(b, devices, tpu_pod("p", tpumem=3000))
+    assert ok
+
+
+def test_fit_exclusive_conflicts():
+    b = register_tpu_backend()
+    devices = _usages(1)
+    devices[0].used = 1
+    devices[0].usedcores = 30
+    # exclusive ask on a shared chip
+    ok, _, reason = _fit(b, devices, tpu_pod("p", tpumem=1024, tpucores=100))
+    assert not ok and common.EXCLUSIVE_DEVICE_ALLOCATE_CONFLICT in reason
+    # core budget exhaustion
+    devices[0].usedcores = 80
+    ok, _, reason = _fit(b, devices, tpu_pod("p", tpumem=1024, tpucores=30))
+    assert not ok and common.CARD_INSUFFICIENT_CORE in reason
+
+
+def test_fit_unhealthy_and_type_uuid_selectors():
+    b = register_tpu_backend()
+    devices = _usages(2)
+    devices[0].health = False
+    pod = tpu_pod("p", tpumem=1024,
+                  annotations={t.NO_USE_DEVICE_UUID_ANNO: "v5e-1"})
+    ok, _, reason = _fit(b, devices, pod)
+    assert not ok
+    assert common.CARD_UNHEALTHY in reason and common.CARD_UUID_MISMATCH in reason
+    pod = tpu_pod("p", tpumem=1024, annotations={t.USE_DEVICE_TYPE_ANNO: "TPU-v4"})
+    ok, _, reason = _fit(b, devices, pod)
+    assert not ok and common.CARD_TYPE_MISMATCH in reason
+
+
+def test_fit_numa_bind():
+    b = register_tpu_backend()
+    devices = _usages(8)  # numa 0: chips 0-3, numa 1: chips 4-7
+    pod = tpu_pod("p", tpu=4, tpumem=1024, annotations={t.NUMA_BIND_ANNO: "true"})
+    ok, result, _ = _fit(b, devices, pod)
+    assert ok
+    numas = {d.numa for d in devices if d.id in {c.uuid for c in result["TPU"]}}
+    assert len(numas) == 1
+    # 6-chip numa-bound ask can't fit any single numa node
+    pod = tpu_pod("p", tpu=6, tpumem=1024, annotations={t.NUMA_BIND_ANNO: "true"})
+    ok, _, reason = _fit(b, devices, pod)
+    assert not ok and common.NUMA_NOT_FIT in reason
+
+
+def test_fit_multi_chip_contiguous():
+    b = register_tpu_backend()
+    devices = _usages(8)
+    ok, result, _ = _fit(b, devices, tpu_pod("p", tpu=2, tpumem=1024))
+    assert ok
+    chosen = [d for d in devices if d.id in {c.uuid for c in result["TPU"]}]
+    assert chosen[0].ici.distance(chosen[1].ici) == 1
+
+
+def test_fit_quota_enforced():
+    qm = QuotaManager()
+    b = register_tpu_backend(quota=qm)
+    qm.add_quota({"metadata": {"name": "q", "namespace": "team"},
+                  "spec": {"hard": {"limits.google.com/tpumem": 4096}}})
+    devices = _usages(1)
+    ok, _, reason = _fit(b, devices, tpu_pod("p", tpumem=8192, ns="team"))
+    assert not ok and common.ALLOCATED_POD_OVERQUOTA in reason
+    ok, _, _ = _fit(b, devices, tpu_pod("p", tpumem=4096, ns="team"))
+    assert ok

@@ -19,6 +19,8 @@ slots survive the round trip, matching the reference encoding.
 
 from __future__ import annotations
 
+import time
+
 from vtpu.device.types import (
     ContainerDevice,
     ContainerDevices,
@@ -70,20 +72,23 @@ def decode_node_devices(anno: str) -> list[DeviceInfo]:
         fields = seg.split(FIELD_SPLIT)
         if len(fields) < 8:
             raise CodecError(f"bad node device segment {seg!r}")
-        out.append(
-            DeviceInfo(
-                id=fields[0],
-                count=int(fields[1]),
-                devmem=int(fields[2]),
-                devcore=int(fields[3]),
-                type=fields[4],
-                numa=int(fields[5]),
-                health=fields[6] == "true",
-                ici=IciCoord.decode(fields[7]),
-                mode=fields[8] if len(fields) > 8 else "",
-                index=index,
+        try:
+            out.append(
+                DeviceInfo(
+                    id=fields[0],
+                    count=int(fields[1]),
+                    devmem=int(fields[2]),
+                    devcore=int(fields[3]),
+                    type=fields[4],
+                    numa=int(fields[5]),
+                    health=fields[6] == "true",
+                    ici=IciCoord.decode(fields[7]),
+                    mode=fields[8] if len(fields) > 8 else "",
+                    index=index,
+                )
             )
-        )
+        except ValueError as e:
+            raise CodecError(f"bad node device segment {seg!r}: {e}") from e
     return out
 
 
@@ -107,15 +112,18 @@ def decode_container_devices(s: str) -> ContainerDevices:
         fields = seg.split(FIELD_SPLIT)
         if len(fields) != 4:
             raise CodecError(f"bad container device segment {seg!r}")
-        out.append(
-            ContainerDevice(
-                idx=idx,
-                uuid=fields[0],
-                type=fields[1],
-                usedmem=int(fields[2]),
-                usedcores=int(fields[3]),
+        try:
+            out.append(
+                ContainerDevice(
+                    idx=idx,
+                    uuid=fields[0],
+                    type=fields[1],
+                    usedmem=int(fields[2]),
+                    usedcores=int(fields[3]),
+                )
             )
-        )
+        except ValueError as e:
+            raise CodecError(f"bad container device segment {seg!r}: {e}") from e
     return out
 
 

@@ -1,0 +1,1 @@
+from vtpu.device.mock.device import MockDevices  # noqa: F401

@@ -1,0 +1,59 @@
+"""Count-only mock backend: exercises multi-vendor registry paths in CI and
+doubles as the CPU-cluster mock device plugin's scheduler side (reference
+charts mock-device-plugin, SURVEY §4 'multi-node without real GPUs')."""
+
+from __future__ import annotations
+
+from collections import Counter
+
+from vtpu.device import common
+from vtpu.device.base import Devices
+from vtpu.device.types import (
+    ContainerDevice,
+    ContainerDeviceRequest,
+    ContainerDevices,
+    DeviceUsage,
+    NodeInfo,
+    PodDevices,
+)
+from vtpu.util.helpers import resource_limits
+
+
+class MockDevices(Devices):
+    def __init__(self, common_word: str = "Mock", resource_name: str = "example.com/mockdev"):
+        self._word = common_word
+        self._resource = resource_name
+
+    def common_word(self) -> str:
+        return self._word
+
+    def resource_names(self) -> dict[str, str]:
+        return {"count": self._resource}
+
+    def mutate_admission(self, container: dict, pod: dict) -> bool:
+        return self._resource in resource_limits(container)
+
+    def generate_resource_requests(self, container: dict) -> ContainerDeviceRequest:
+        try:
+            nums = int(str(resource_limits(container).get(self._resource, 0)))
+        except ValueError:
+            nums = 0
+        return ContainerDeviceRequest(nums=nums, type=self._word)
+
+    def fit(self, devices, request, pod, node_info, allocated):
+        reasons: Counter = Counter()
+        picked: ContainerDevices = []
+        for dev in devices:
+            if len(picked) == request.nums:
+                break
+            if not dev.health:
+                reasons[common.CARD_UNHEALTHY] += 1
+            elif dev.used >= dev.count:
+                reasons[common.CARD_TIME_SLICING_EXHAUSTED] += 1
+            else:
+                picked.append(
+                    ContainerDevice(idx=dev.index, uuid=dev.id, type=dev.type)
+                )
+        if len(picked) < request.nums:
+            return False, {}, common.gen_reason(reasons, len(devices))
+        return True, {self._word: picked}, ""

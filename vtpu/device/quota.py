@@ -5,39 +5,60 @@ Parity: reference pkg/device/quota.go:27-271. Quotas are expressed as
 ``limits.google.com/tpumem: 32000``); admission and Fit both consult this cache
 so an over-quota pod fails fast with a clear reason instead of landing and being
 evicted.
+
+Multiple ResourceQuota objects may coexist in one namespace; k8s semantics are
+that every quota applies, so the effective limit per resource is the minimum
+across them. Raw specs are kept so quotas observed before the backend registry
+is populated are re-parsed by refresh_managed_resources().
 """
 
 from __future__ import annotations
 
+import logging
 import threading
 from dataclasses import dataclass, field
 
-from vtpu.device.types import ContainerDevice, PodDevices
+from vtpu.device.types import PodDevices
+
+log = logging.getLogger(__name__)
 
 QUOTA_PREFIX = "limits."
 
+_SUFFIXES = (
+    ("Ki", 1024), ("Mi", 1024**2), ("Gi", 1024**3), ("Ti", 1024**4),
+    ("Pi", 1024**5), ("Ei", 1024**6),
+    ("k", 1000), ("M", 1000**2), ("G", 1000**3), ("T", 1000**4),
+    ("P", 1000**5), ("E", 1000**6),
+)
 
-def _parse_quantity(v, role: str = "") -> int:
-    """Parse a k8s quantity into the resource's native unit.
+
+def _parse_quantity(v, role: str = "") -> int | None:
+    """Parse a k8s quantity into the resource's native unit; None if invalid.
 
     Bare numbers pass through unchanged (device resources are denominated in
-    MiB / percent / count). Byte suffixes (k/M/G/Ki/Mi/Gi) are normalized to
-    **MiB** for mem-role resources so ``limits.google.com/tpumem: 16Gi`` means
-    16384, not 17179869184.
+    MiB / percent / count). Byte suffixes are normalized to **MiB** for
+    mem-role resources so ``limits.google.com/tpumem: 16Gi`` means 16384.
+    Milli quantities ('500m') round down to whole units.
     """
     if isinstance(v, (int, float)):
         return int(v)
     s = str(v).strip()
-    mult = 1
+    mult = 1.0
     suffixed = False
-    for suffix, m in (("Ki", 1024), ("Mi", 1024**2), ("Gi", 1024**3),
-                      ("k", 1000), ("M", 1000**2), ("G", 1000**3)):
-        if s.endswith(suffix):
-            s = s[: -len(suffix)]
-            mult = m
-            suffixed = True
-            break
-    n = float(s) * mult
+    if s.endswith("m") and not any(s.endswith(suf) for suf, _ in _SUFFIXES):
+        s = s[:-1]
+        mult = 1e-3
+    else:
+        for suffix, m in _SUFFIXES:
+            if s.endswith(suffix):
+                s = s[: -len(suffix)]
+                mult = float(m)
+                suffixed = True
+                break
+    try:
+        n = float(s) * mult
+    except ValueError:
+        return None
     if suffixed and role in ("mem", "memPercentage"):
         n /= 1024**2
     return int(n)
@@ -45,10 +66,20 @@ def _parse_quantity(v, role: str = "") -> int:
 
 @dataclass
 class _NsQuota:
-    # resource name (without "limits." prefix) -> hard limit
-    limits: dict[str, int] = field(default_factory=dict)
-    # resource name -> usage accounted by the scheduler
+    # quota object name -> raw `spec.hard` dict (kept for re-parsing)
+    raw: dict[str, dict] = field(default_factory=dict)
+    # quota object name -> {resource: limit}
+    parsed: dict[str, dict[str, int]] = field(default_factory=dict)
+    # resource -> usage accounted by the scheduler
     used: dict[str, int] = field(default_factory=dict)
+
+    def effective_limits(self) -> dict[str, int]:
+        """Most-restrictive limit per resource across all quotas."""
+        out: dict[str, int] = {}
+        for limits in self.parsed.values():
+            for res, lim in limits.items():
+                out[res] = min(out.get(res, lim), lim)
+        return out
 
 
 class QuotaManager:
@@ -71,6 +102,11 @@ class QuotaManager:
             for word, dev in DEVICES_MAP.items():
                 for role, res in dev.resource_names().items():
                     self._managed[res] = (word, role)
+            # Quotas observed before the registry existed parse to nothing;
+            # re-parse every raw spec now that roles are known.
+            for entry in self._ns.values():
+                for name, hard in entry.raw.items():
+                    entry.parsed[name] = self._parse_hard(hard)
 
     def is_managed_quota(self, quota_resource: str) -> bool:
         """True for 'limits.<res>' entries over device resources we schedule
@@ -79,28 +115,41 @@ class QuotaManager:
             return False
         return quota_resource[len(QUOTA_PREFIX):] in self._managed
 
+    def _parse_hard(self, hard: dict) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for name, v in hard.items():
+            if not self.is_managed_quota(name):
+                continue
+            res = name[len(QUOTA_PREFIX):]
+            n = _parse_quantity(v, self._managed[res][1])
+            if n is None:
+                log.warning("unparseable quota quantity %s=%r; ignoring entry", name, v)
+                continue
+            out[res] = n
+        return out
+
     # ---------------------------------------------------------------- informer
 
     def add_quota(self, quota: dict) -> None:
         """Mirror a ResourceQuota object (create/update)."""
-        ns = quota["metadata"].get("namespace", "default")
+        m = quota.get("metadata", {})
+        ns = m.get("namespace", "default")
+        name = m.get("name", "quota")
         hard = quota.get("spec", {}).get("hard", {}) or {}
         with self._lock:
             entry = self._ns.setdefault(ns, _NsQuota())
-            entry.limits = {
-                name[len(QUOTA_PREFIX):]: _parse_quantity(
-                    v, self._managed[name[len(QUOTA_PREFIX):]][1]
-                )
-                for name, v in hard.items()
-                if self.is_managed_quota(name)
-            }
+            entry.raw[name] = dict(hard)
+            entry.parsed[name] = self._parse_hard(hard)
 
     def del_quota(self, quota: dict) -> None:
-        ns = quota["metadata"].get("namespace", "default")
+        m = quota.get("metadata", {})
+        ns = m.get("namespace", "default")
+        name = m.get("name", "quota")
         with self._lock:
             entry = self._ns.get(ns)
             if entry:
-                entry.limits = {}
+                entry.raw.pop(name, None)
+                entry.parsed.pop(name, None)
 
     # ---------------------------------------------------------------- checks
 
@@ -109,15 +158,18 @@ class QuotaManager:
         (reference FitQuota; called from vendor Fit paths)."""
         with self._lock:
             entry = self._ns.get(namespace)
-            if not entry or not entry.limits:
+            if not entry:
+                return True
+            limits = entry.effective_limits()
+            if not limits:
                 return True
             for res, (word, role) in self._managed.items():
-                if word != vendor or res not in entry.limits:
+                if word != vendor or res not in limits:
                     continue
                 add = memreq if role in ("mem", "memPercentage") else (
                     coresreq if role == "cores" else 0
                 )
-                if add and entry.used.get(res, 0) + add > entry.limits[res]:
+                if add and entry.used.get(res, 0) + add > limits[res]:
                     return False
             return True
 
@@ -158,11 +210,12 @@ class QuotaManager:
     def snapshot(self) -> dict[str, dict[str, dict[str, int]]]:
         """{namespace: {resource: {'limit': x, 'used': y}}} for metrics."""
         with self._lock:
-            return {
-                ns: {
-                    res: {"limit": lim, "used": entry.used.get(res, 0)}
-                    for res, lim in entry.limits.items()
-                }
-                for ns, entry in self._ns.items()
-                if entry.limits
-            }
+            out = {}
+            for ns, entry in self._ns.items():
+                limits = entry.effective_limits()
+                if limits:
+                    out[ns] = {
+                        res: {"limit": lim, "used": entry.used.get(res, 0)}
+                        for res, lim in limits.items()
+                    }
+            return out

@@ -1,0 +1,9 @@
+"""TPU vendor backend: fractional chip sharing + ICI-topology-aware placement.
+
+The flagship backend (the reference's NVIDIA backend analog,
+pkg/device/nvidia/), built TPU-first: devices are chips of a pod slice with
+torus coordinates, and multi-chip requests are placed onto contiguous ICI
+sub-slices instead of NVLink pair combinations.
+"""
+
+from vtpu.device.tpu.device import TpuConfig, TpuDevices  # noqa: F401

@@ -1,0 +1,275 @@
+"""TPU backend: fractional HBM/core sharing of TPU chips with ICI-aware fit.
+
+Parity map (reference pkg/device/nvidia/device.go):
+- resource names / GenerateResourceRequests  <- :529-599
+- MutateAdmission (count inference, priority) <- :359-462
+- Fit (health/type/uuid/numa/mem/core/exclusive + topology) <- :746-889
+- topology combination selection <- :863-986, re-designed for ICI torus
+  (see topology.py)
+
+Resources (defaults; all renameable via TpuConfig):
+- ``google.com/tpu``              whole/shared chip count
+- ``google.com/tpumem``           HBM MiB per chip
+- ``google.com/tpumem-percentage``HBM percent per chip
+- ``google.com/tpucores``         TensorCore percent per chip (100 = exclusive)
+"""
+
+from __future__ import annotations
+
+import logging
+from collections import Counter
+from dataclasses import dataclass, field
+from typing import Optional
+
+from vtpu.device import common
+from vtpu.device.base import Devices
+from vtpu.device.quota import QuotaManager
+from vtpu.device.tpu import topology
+from vtpu.device.types import (
+    ContainerDevice,
+    ContainerDeviceRequest,
+    ContainerDevices,
+    DeviceUsage,
+    NodeInfo,
+    PodDevices,
+)
+from vtpu.util import types as t
+from vtpu.util.helpers import pod_annotations, resource_limits
+
+log = logging.getLogger(__name__)
+
+TPU_COMMON_WORD = "TPU"
+
+# Env protocol consumed by libvtpu inside the container (reference
+# server.go:660-673 CUDA_DEVICE_MEMORY_LIMIT_* / CUDA_DEVICE_SM_LIMIT).
+ENV_TASK_PRIORITY = "VTPU_TASK_PRIORITY"
+
+
+def _parse_int(v) -> int:
+    try:
+        return int(str(v))
+    except (TypeError, ValueError):
+        return 0
+
+
+@dataclass
+class TpuConfig:
+    resource_count_name: str = "google.com/tpu"
+    resource_memory_name: str = "google.com/tpumem"
+    resource_memory_percentage_name: str = "google.com/tpumem-percentage"
+    resource_cores_name: str = "google.com/tpucores"
+    # max concurrent sharers per chip (reference --device-split-count)
+    device_split_count: int = 4
+    # HBM oversubscription factor (reference --device-memory-scaling)
+    device_memory_scaling: float = 1.0
+    device_cores_scaling: float = 1.0
+    default_memory: int = 0  # 0 -> whole-chip HBM when unspecified
+    default_cores: int = 0  # 0 -> no core guarantee (share freely)
+    # type allow/deny configured cluster-wide (reference type selectors)
+    allowed_types: list[str] = field(default_factory=list)
+
+
+class TpuDevices(Devices):
+    def __init__(self, config: Optional[TpuConfig] = None, quota: Optional[QuotaManager] = None):
+        self.config = config or TpuConfig()
+        self.quota = quota
+
+    # ------------------------------------------------------------- identity
+
+    def common_word(self) -> str:
+        return TPU_COMMON_WORD
+
+    def resource_names(self) -> dict[str, str]:
+        return {
+            "count": self.config.resource_count_name,
+            "mem": self.config.resource_memory_name,
+            "memPercentage": self.config.resource_memory_percentage_name,
+            "cores": self.config.resource_cores_name,
+        }
+
+    # ------------------------------------------------------------- admission
+
+    def mutate_admission(self, container: dict, pod: dict) -> bool:
+        limits = resource_limits(container)
+        cfg = self.config
+        has_count = cfg.resource_count_name in limits
+        has_frac = any(
+            r in limits
+            for r in (
+                cfg.resource_memory_name,
+                cfg.resource_memory_percentage_name,
+                cfg.resource_cores_name,
+            )
+        )
+        if not has_count and not has_frac:
+            return False
+        if not has_count:
+            # Fractional ask without a count implies one chip (reference
+            # default-GPU-count inference device.go:410-427).
+            res = container.setdefault("resources", {})
+            res.setdefault("limits", {})[cfg.resource_count_name] = "1"
+        priority = pod_annotations(pod).get(t.TASK_PRIORITY_ANNO, "")
+        if priority:
+            envs = container.setdefault("env", [])
+            if not any(e.get("name") == ENV_TASK_PRIORITY for e in envs):
+                envs.append({"name": ENV_TASK_PRIORITY, "value": priority})
+        return True
+
+    # ------------------------------------------------------------- requests
+
+    def generate_resource_requests(self, container: dict) -> ContainerDeviceRequest:
+        limits = resource_limits(container)
+        cfg = self.config
+        nums = _parse_int(limits.get(cfg.resource_count_name))
+        mem = _parse_int(limits.get(cfg.resource_memory_name))
+        mem_pct = _parse_int(limits.get(cfg.resource_memory_percentage_name))
+        cores = _parse_int(limits.get(cfg.resource_cores_name))
+        if nums == 0 and (mem or mem_pct or cores):
+            nums = 1
+        if nums == 0:
+            return ContainerDeviceRequest()
+        if mem == 0 and mem_pct == 0:
+            if cfg.default_memory:
+                mem = cfg.default_memory
+            else:
+                mem_pct = 100  # whole-chip HBM when unspecified
+        if cores == 0:
+            cores = cfg.default_cores
+        return ContainerDeviceRequest(
+            nums=nums,
+            type=TPU_COMMON_WORD,
+            memreq=mem,
+            mem_percentage_req=mem_pct,
+            coresreq=cores,
+        )
+
+    # ------------------------------------------------------------- selectors
+
+    @staticmethod
+    def _split_anno(annos: dict, key: str) -> list[str]:
+        raw = annos.get(key, "")
+        return [s.strip() for s in raw.split(",") if s.strip()]
+
+    def _check_uuid(self, annos: dict, dev: DeviceUsage) -> bool:
+        use = self._split_anno(annos, t.USE_DEVICE_UUID_ANNO)
+        if use and dev.id not in use:
+            return False
+        nouse = self._split_anno(annos, t.NO_USE_DEVICE_UUID_ANNO)
+        return dev.id not in nouse
+
+    def _check_type(self, annos: dict, dev: DeviceUsage) -> bool:
+        if self.config.allowed_types and not any(
+            dev.type.lower().startswith(a.lower()) for a in self.config.allowed_types
+        ):
+            return False
+        use = self._split_anno(annos, t.USE_DEVICE_TYPE_ANNO)
+        if use and not any(dev.type.lower().startswith(u.lower()) for u in use):
+            return False
+        nouse = self._split_anno(annos, t.NO_USE_DEVICE_TYPE_ANNO)
+        return not any(dev.type.lower().startswith(u.lower()) for u in nouse)
+
+    # ------------------------------------------------------------- fit
+
+    def fit(
+        self,
+        devices: list[DeviceUsage],
+        request: ContainerDeviceRequest,
+        pod: dict,
+        node_info: NodeInfo,
+        allocated: PodDevices,
+    ) -> tuple[bool, dict[str, ContainerDevices], str]:
+        annos = pod_annotations(pod)
+        reasons: Counter = Counter()
+        candidates: list[DeviceUsage] = []
+
+        for dev in devices:
+            memreq = request.memreq
+            if memreq == 0 and request.mem_percentage_req:
+                memreq = dev.totalmem * request.mem_percentage_req // 100
+            if not dev.health:
+                reasons[common.CARD_UNHEALTHY] += 1
+            elif not self._check_type(annos, dev):
+                reasons[common.CARD_TYPE_MISMATCH] += 1
+            elif not self._check_uuid(annos, dev):
+                reasons[common.CARD_UUID_MISMATCH] += 1
+            elif dev.used >= dev.count:
+                reasons[common.CARD_TIME_SLICING_EXHAUSTED] += 1
+            elif dev.free_mem() < memreq:
+                reasons[common.CARD_INSUFFICIENT_MEMORY] += 1
+            elif request.coresreq == 100 and dev.used > 0:
+                # Exclusive ask can't land on a shared chip (reference
+                # exclusive-card logic device.go:809-818).
+                reasons[common.EXCLUSIVE_DEVICE_ALLOCATE_CONFLICT] += 1
+            elif request.coresreq and dev.free_cores() < request.coresreq:
+                reasons[common.CARD_INSUFFICIENT_CORE] += 1
+            elif dev.mode == "exclusive" and dev.used > 0:
+                reasons[common.EXCLUSIVE_DEVICE_ALLOCATE_CONFLICT] += 1
+            else:
+                candidates.append(dev)
+
+        # NUMA binding: keep all chips of this container (and any devices the
+        # pod already holds) on one NUMA node (reference prevnuma device.go
+        # :771-779).
+        if candidates and annos.get(t.NUMA_BIND_ANNO, "").lower() == "true":
+            prev_numa: Optional[int] = None
+            for single in allocated.values():
+                for ctr in single:
+                    for cd in ctr:
+                        for dev in devices:
+                            if dev.id == cd.uuid:
+                                prev_numa = dev.numa
+            by_numa: dict[int, list[DeviceUsage]] = {}
+            for dev in candidates:
+                by_numa.setdefault(dev.numa, []).append(dev)
+            pools = (
+                [by_numa.get(prev_numa, [])]
+                if prev_numa is not None
+                else sorted(by_numa.values(), key=len, reverse=True)
+            )
+            picked = next((p for p in pools if len(p) >= request.nums), None)
+            if picked is None:
+                reasons[common.NUMA_NOT_FIT] += len(candidates)
+                candidates = []
+            else:
+                candidates = picked
+
+        if len(candidates) < request.nums:
+            detail = common.gen_reason(reasons, len(devices))
+            msg = (
+                f"{common.NODE_INSUFFICIENT_DEVICE}: "
+                f"requesting {request.nums}, {len(candidates)}/{len(devices)} usable"
+            )
+            return False, {}, f"{msg}; {detail}" if detail else msg
+
+        # Namespace device quota (reference fitQuota device.go:725-744).
+        if self.quota is not None:
+            ns = pod.get("metadata", {}).get("namespace", "default")
+            memsum = sum(
+                request.memreq
+                or d.totalmem * request.mem_percentage_req // 100
+                for d in candidates[: request.nums]
+            )
+            if not self.quota.fit_quota(ns, TPU_COMMON_WORD, memsum, request.coresreq * request.nums):
+                reasons[common.ALLOCATED_POD_OVERQUOTA] += 1
+                return False, {}, common.gen_reason(reasons, len(devices))
+
+        chosen = topology.select_subslice(candidates, request.nums)
+        if chosen is None:
+            reasons[common.TOPOLOGY_NOT_FIT] += 1
+            return False, {}, common.gen_reason(reasons, len(devices))
+
+        out: ContainerDevices = []
+        for dev in chosen:
+            memreq = request.memreq
+            if memreq == 0 and request.mem_percentage_req:
+                memreq = dev.totalmem * request.mem_percentage_req // 100
+            out.append(
+                ContainerDevice(
+                    idx=dev.index,
+                    uuid=dev.id,
+                    type=dev.type,
+                    usedmem=memreq,
+                    usedcores=request.coresreq,
+                )
+            )
+        return True, {TPU_COMMON_WORD: out}, ""

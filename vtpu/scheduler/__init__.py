@@ -1,0 +1,5 @@
+"""Scheduler extender: Filter/Score/Bind + webhook + routes + policies.
+
+Parity: reference pkg/scheduler (scheduler.go, score.go, nodes.go, policy/,
+routes/, webhook.go, event.go) and cmd/scheduler.
+"""

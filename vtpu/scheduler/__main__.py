@@ -1,0 +1,121 @@
+"""Scheduler binary (reference cmd/scheduler/main.go).
+
+Run against a real cluster (in-cluster service account or --kube-api), or with
+``--fake-cluster N`` to serve the extender protocol over an in-memory cluster
+of N mock v5e-8 nodes (the reference's mock-device-plugin CI trick).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+
+from vtpu.device import codec
+from vtpu.device.types import DeviceInfo
+from vtpu.device.tpu.topology import default_ici_mesh
+from vtpu.scheduler.config import (
+    SchedulerOptions,
+    init_devices_with_config,
+    load_device_config,
+)
+from vtpu.scheduler.routes import SchedulerServer
+from vtpu.scheduler.scheduler import Scheduler
+from vtpu.scheduler.webhook import WebHook
+from vtpu.util.k8sclient import FakeKubeClient, RealKubeClient, init_global_client
+
+
+def make_fake_cluster(n_nodes: int, chips_per_node: int = 8) -> FakeKubeClient:
+    client = FakeKubeClient()
+    mesh = default_ici_mesh(chips_per_node)
+    for i in range(n_nodes):
+        devices = [
+            DeviceInfo(
+                id=f"node{i}-v5e-{c}",
+                count=4,
+                devmem=16384,
+                devcore=100,
+                type="TPU-v5e",
+                numa=0 if c < chips_per_node // 2 else 1,
+                ici=mesh[c],
+                index=c,
+            )
+            for c in range(chips_per_node)
+        ]
+        client.put_node(
+            {
+                "metadata": {
+                    "name": f"tpu-node-{i}",
+                    "annotations": {
+                        "vtpu.io/node-tpu-register": codec.encode_node_devices(devices)
+                    },
+                }
+            }
+        )
+    return client
+
+
+class _DemoScheduler(Scheduler):
+    """Fake-cluster mode: seed the extender-args pod into the in-memory
+    cluster first (a real kube-scheduler only sends pods that exist)."""
+
+    def filter(self, args: dict) -> dict:
+        pod = args.get("Pod") or {}
+        m = pod.get("metadata", {})
+        if m.get("name"):
+            try:
+                self.client.get_pod(m.get("namespace", "default"), m["name"])
+            except Exception:
+                args = dict(args)
+                args["Pod"] = self.client.put_pod(pod)
+        return super().filter(args)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser("vtpu-scheduler")
+    parser.add_argument("--port", type=int, default=9395)
+    parser.add_argument("--tls-cert", default="")
+    parser.add_argument("--tls-key", default="")
+    parser.add_argument("--node-policy", default="binpack", choices=["binpack", "spread"])
+    parser.add_argument("--device-policy", default="binpack",
+                        choices=["binpack", "spread", "mutex"])
+    parser.add_argument("--register-interval", type=float, default=15.0)
+    parser.add_argument("--device-config", default="", help="device-config.yaml path")
+    parser.add_argument("--kube-api", default="", help="API server URL (else in-cluster)")
+    parser.add_argument("--fake-cluster", type=int, default=0,
+                        help="serve over an in-memory cluster of N v5e-8 nodes")
+    parser.add_argument("-v", "--verbose", action="count", default=0)
+    args = parser.parse_args()
+
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
+
+    if args.fake_cluster:
+        client = make_fake_cluster(args.fake_cluster)
+    else:
+        client = RealKubeClient(base_url=args.kube_api)
+    init_global_client(client)
+
+    scheduler_cls = _DemoScheduler if args.fake_cluster else Scheduler
+    scheduler = scheduler_cls(
+        client, node_policy=args.node_policy, device_policy=args.device_policy
+    )
+    init_devices_with_config(
+        load_device_config(args.device_config), scheduler.quota_manager
+    )
+    scheduler.start(register_interval=args.register_interval)
+    webhook = WebHook(scheduler.quota_manager)
+    server = SchedulerServer(
+        scheduler,
+        webhook,
+        port=args.port,
+        tls_cert=args.tls_cert,
+        tls_key=args.tls_key,
+    )
+    logging.info("vtpu-scheduler serving on :%d", server.port)
+    server.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,94 @@
+"""Config: CLI-level scheduler options + cluster-wide device-config YAML.
+
+Parity: reference pkg/scheduler/config/config.go:76-497 — a global flag layer,
+a ``device-config.yaml`` ConfigMap with per-vendor sections and an embedded
+default, and the registry init that turns config into backend instances.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+
+import yaml
+
+from vtpu.device.mock.device import MockDevices
+from vtpu.device.quota import QuotaManager
+from vtpu.device.registry import register_backend
+from vtpu.device.tpu.device import TpuConfig, TpuDevices
+from vtpu.util import types as t
+
+log = logging.getLogger(__name__)
+
+DEFAULT_DEVICE_CONFIG_YAML = """
+tpu:
+  resourceCountName: google.com/tpu
+  resourceMemoryName: google.com/tpumem
+  resourceMemoryPercentageName: google.com/tpumem-percentage
+  resourceCoresName: google.com/tpucores
+  deviceSplitCount: 4
+  deviceMemoryScaling: 1.0
+  deviceCoresScaling: 1.0
+  defaultMemory: 0
+  defaultCores: 0
+"""
+
+
+@dataclass
+class SchedulerOptions:
+    http_port: int = 9395
+    tls_cert: str = ""
+    tls_key: str = ""
+    node_policy: str = t.NODE_POLICY_BINPACK
+    device_policy: str = t.DEVICE_POLICY_BINPACK
+    register_interval: float = 15.0
+    leader_election: bool = False
+    device_config_file: str = ""
+    mock_devices: bool = False
+
+
+def load_device_config(path: str = "") -> dict:
+    if path:
+        with open(path) as f:
+            return yaml.safe_load(f) or {}
+    return yaml.safe_load(DEFAULT_DEVICE_CONFIG_YAML) or {}
+
+
+def tpu_config_from_dict(d: dict) -> TpuConfig:
+    return TpuConfig(
+        resource_count_name=d.get("resourceCountName", "google.com/tpu"),
+        resource_memory_name=d.get("resourceMemoryName", "google.com/tpumem"),
+        resource_memory_percentage_name=d.get(
+            "resourceMemoryPercentageName", "google.com/tpumem-percentage"
+        ),
+        resource_cores_name=d.get("resourceCoresName", "google.com/tpucores"),
+        device_split_count=int(d.get("deviceSplitCount", 4)),
+        device_memory_scaling=float(d.get("deviceMemoryScaling", 1.0)),
+        device_cores_scaling=float(d.get("deviceCoresScaling", 1.0)),
+        default_memory=int(d.get("defaultMemory", 0)),
+        default_cores=int(d.get("defaultCores", 0)),
+        allowed_types=list(d.get("allowedTypes", []) or []),
+    )
+
+
+def init_devices_with_config(
+    config: dict, quota_manager: QuotaManager | None = None, mock_devices: bool = False
+) -> None:
+    """Populate the backend registry from a device-config dict (reference
+    InitDevicesWithConfig config.go:107-251)."""
+    tpu_section = config.get("tpu", {}) or {}
+    register_backend(TpuDevices(tpu_config_from_dict(tpu_section), quota=quota_manager))
+    if mock_devices or config.get("mock"):
+        mock_section = config.get("mock") or {}
+        register_backend(
+            MockDevices(
+                common_word=mock_section.get("commonWord", "Mock"),
+                resource_name=mock_section.get("resourceName", "example.com/mockdev"),
+            )
+        )
+    if quota_manager is not None:
+        quota_manager.refresh_managed_resources()
+
+
+def init_default_devices(quota_manager: QuotaManager | None = None) -> None:
+    init_devices_with_config(load_device_config(), quota_manager)

@@ -1,0 +1,55 @@
+"""Kubernetes Event recording for every filter/bind outcome (reference
+pkg/scheduler/event.go:33-78)."""
+
+from __future__ import annotations
+
+import logging
+from datetime import datetime, timezone
+
+from vtpu.util.k8sclient import ApiError, KubeClient
+
+log = logging.getLogger(__name__)
+
+
+class EventRecorder:
+    def __init__(self, client: KubeClient, component: str = "vtpu-scheduler"):
+        self.client = client
+        self.component = component
+
+    def _emit(self, pod: dict, reason: str, message: str, etype: str = "Normal") -> None:
+        m = pod.get("metadata", {})
+        ns = m.get("namespace", "default")
+        now = datetime.now(timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
+        event = {
+            "metadata": {"generateName": f"{m.get('name', 'pod')}-", "namespace": ns},
+            "involvedObject": {
+                "kind": "Pod",
+                "namespace": ns,
+                "name": m.get("name", ""),
+                "uid": m.get("uid", ""),
+            },
+            "reason": reason,
+            "message": message[:1024],
+            "type": etype,
+            "source": {"component": self.component},
+            "firstTimestamp": now,
+            "lastTimestamp": now,
+            "count": 1,
+        }
+        try:
+            self.client.create_event(ns, event)
+        except ApiError:
+            log.exception("event emit failed")
+
+    def filtering_succeed(self, pod: dict, node: str) -> None:
+        self._emit(pod, "FilteringSucceed", f"assigned to node {node}")
+
+    def filtering_failed(self, pod: dict, failed: dict[str, str]) -> None:
+        detail = "; ".join(f"{n}: {r}" for n, r in sorted(failed.items())) or "no fitting node"
+        self._emit(pod, "FilteringFailed", detail, etype="Warning")
+
+    def binding_succeed(self, pod: dict, node: str) -> None:
+        self._emit(pod, "BindingSucceed", f"bound to node {node}")
+
+    def binding_failed(self, pod: dict, node: str, err: str) -> None:
+        self._emit(pod, "BindingFailed", f"bind to {node} failed: {err}", etype="Warning")

@@ -1,0 +1,52 @@
+"""Thread-safe node device cache (reference pkg/scheduler/nodes.go:60-142)."""
+
+from __future__ import annotations
+
+import threading
+
+from vtpu.device.types import DeviceInfo, NodeInfo
+
+
+class NodeManager:
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._nodes: dict[str, NodeInfo] = {}
+
+    def add_node_devices(self, node_name: str, vendor: str, devices: list[DeviceInfo]) -> None:
+        with self._lock:
+            info = self._nodes.setdefault(node_name, NodeInfo(node_name=node_name))
+            info.devices[vendor] = [d.clone() for d in devices]
+
+    def rm_node_devices(self, node_name: str, vendor: str | None = None) -> None:
+        """Withdraw one vendor (or the whole node) from the cache (reference
+        rmNodeDevices)."""
+        with self._lock:
+            if vendor is None:
+                self._nodes.pop(node_name, None)
+                return
+            info = self._nodes.get(node_name)
+            if info:
+                info.devices.pop(vendor, None)
+                if not info.devices:
+                    self._nodes.pop(node_name, None)
+
+    def get_node(self, node_name: str) -> NodeInfo | None:
+        with self._lock:
+            info = self._nodes.get(node_name)
+            if info is None:
+                return None
+            return NodeInfo(
+                node_name=info.node_name,
+                devices={v: [d.clone() for d in ds] for v, ds in info.devices.items()},
+            )
+
+    def list_nodes(self) -> dict[str, NodeInfo]:
+        """Deep-copied snapshot (reference ListNodes deep-copy-on-list)."""
+        with self._lock:
+            return {
+                name: NodeInfo(
+                    node_name=info.node_name,
+                    devices={v: [d.clone() for d in ds] for v, ds in info.devices.items()},
+                )
+                for name, info in self._nodes.items()
+            }

@@ -1,0 +1,86 @@
+"""Node and device scoring policies: binpack / spread / mutex / numa.
+
+Parity: reference pkg/scheduler/policy/node_policy.go:27-99 and
+gpu_policy.go:26-144. Scores fold usage ratios with a fixed weight; binpack
+prefers the most-used placement (consolidate, keep big contiguous sub-slices
+free), spread the least-used (isolate, minimize interference).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from vtpu.device.types import DeviceUsage, PodDevices
+from vtpu.util import types as t
+
+
+@dataclass
+class NodeScore:
+    node_name: str
+    score: float = 0.0
+    devices: PodDevices = field(default_factory=dict)  # winning assignment
+    snapshot: dict[str, list[DeviceUsage]] = field(default_factory=dict)
+
+
+def compute_default_node_score(usages: dict[str, list[DeviceUsage]]) -> float:
+    """Weight * mean(used/count + usedcores/totalcore + usedmem/totalmem)
+    over all devices (reference ComputeDefaultScore node_policy.go:75-99)."""
+    total = 0.0
+    n = 0
+    for devs in usages.values():
+        for d in devs:
+            n += 1
+            if d.count:
+                total += d.used / d.count
+            if d.totalcore:
+                total += d.usedcores / d.totalcore
+            if d.totalmem:
+                total += d.usedmem / d.totalmem
+    if n == 0:
+        return 0.0
+    return t.NODE_SCORE_WEIGHT * total / n
+
+
+def pick_winner(scores: list[NodeScore], policy: str) -> NodeScore | None:
+    """binpack: highest usage score wins; spread: lowest (reference
+    NodeScoreList.Less + scheduler.go:955-956 'winner = last after sort')."""
+    if not scores:
+        return None
+    if policy == t.NODE_POLICY_SPREAD:
+        return min(scores, key=lambda s: s.score)
+    return max(scores, key=lambda s: s.score)
+
+
+def compute_device_score(dev: DeviceUsage) -> float:
+    """Per-device usage score (reference ComputeScore gpu_policy.go:116-144)."""
+    score = 0.0
+    if dev.count:
+        score += dev.used / dev.count
+    if dev.totalcore:
+        score += dev.usedcores / dev.totalcore
+    if dev.totalmem:
+        score += dev.usedmem / dev.totalmem
+    return t.NODE_SCORE_WEIGHT * score
+
+
+def sort_devices_for_policy(devices: list[DeviceUsage], policy: str) -> list[DeviceUsage]:
+    """Order devices so earlier entries are tried first by Fit (reference
+    DeviceUsageList.Less gpu_policy.go:40-114).
+
+    - binpack: most-used healthy device first (fill it up)
+    - spread:  least-used first
+    - mutex:   devices already busy with *shared* pods first, exclusive-mode
+               and empty devices last (keep exclusives clean)
+    """
+    if policy == t.DEVICE_POLICY_SPREAD:
+        return sorted(devices, key=compute_device_score)
+    if policy == t.DEVICE_POLICY_MUTEX:
+        return sorted(
+            devices,
+            key=lambda d: (
+                0 if (d.used > 0 and d.mode != "exclusive") else 1,
+                -compute_device_score(d),
+            ),
+        )
+    # binpack default
+    return sorted(devices, key=compute_device_score, reverse=True)

@@ -1,0 +1,142 @@
+"""HTTP routes: the scheduler-extender protocol + webhook + health + metrics.
+
+Parity: reference pkg/scheduler/routes/route.go:42-170 and
+cmd/scheduler/main.go:145-156 — POST /filter, POST /bind, POST /webhook,
+GET /healthz, GET /readyz, GET /metrics; 1 MB request-body cap.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import ssl
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from vtpu.scheduler.scheduler import Scheduler
+from vtpu.scheduler.webhook import WebHook
+
+log = logging.getLogger(__name__)
+
+MAX_BODY_BYTES = 1 << 20  # reference route.go 1 MB cap
+
+try:
+    from prometheus_client import Histogram
+
+    FILTER_LATENCY = Histogram(
+        "vtpu_scheduler_filter_seconds", "Extender Filter latency"
+    )
+    BIND_LATENCY = Histogram("vtpu_scheduler_bind_seconds", "Extender Bind latency")
+except Exception:  # pragma: no cover - prometheus always present in this image
+    FILTER_LATENCY = BIND_LATENCY = None
+
+
+def make_handler(scheduler: Scheduler, webhook: WebHook):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # route access logs to logging
+            log.debug("http %s", fmt % args)
+
+        def _reply(self, code: int, payload) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _read_json(self):
+            length = int(self.headers.get("Content-Length", 0))
+            if length > MAX_BODY_BYTES:
+                self._reply(413, {"Error": "request body too large"})
+                return None
+            raw = self.rfile.read(length)
+            try:
+                return json.loads(raw)
+            except json.JSONDecodeError as e:
+                self._reply(400, {"Error": f"bad json: {e}"})
+                return None
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._reply(200, {"status": "ok"})
+            elif self.path == "/readyz":
+                ready = scheduler.wait_for_cache_sync(timeout=0.001)
+                self._reply(200 if ready else 503, {"ready": ready})
+            elif self.path == "/metrics":
+                try:
+                    from prometheus_client import CONTENT_TYPE_LATEST, generate_latest
+
+                    body = generate_latest()
+                    self.send_response(200)
+                    self.send_header("Content-Type", CONTENT_TYPE_LATEST)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                except Exception as e:  # pragma: no cover
+                    self._reply(500, {"Error": str(e)})
+            else:
+                self._reply(404, {"Error": "not found"})
+
+        def do_POST(self):
+            body = self._read_json()
+            if body is None:
+                return
+            if self.path == "/filter":
+                if not scheduler.wait_for_cache_sync():
+                    self._reply(503, {"Error": "cache not synced"})
+                    return
+                start = time.monotonic()
+                result = scheduler.filter(body)
+                if FILTER_LATENCY:
+                    FILTER_LATENCY.observe(time.monotonic() - start)
+                self._reply(200, result)
+            elif self.path == "/bind":
+                start = time.monotonic()
+                result = scheduler.bind(body)
+                if BIND_LATENCY:
+                    BIND_LATENCY.observe(time.monotonic() - start)
+                self._reply(200, result)
+            elif self.path == "/webhook":
+                self._reply(200, webhook.handle(body))
+            else:
+                self._reply(404, {"Error": "not found"})
+
+    return Handler
+
+
+class SchedulerServer:
+    """HTTP(S) front for the scheduler (reference cmd/scheduler/main.go)."""
+
+    def __init__(
+        self,
+        scheduler: Scheduler,
+        webhook: WebHook,
+        host: str = "0.0.0.0",
+        port: int = 9395,
+        tls_cert: str = "",
+        tls_key: str = "",
+    ) -> None:
+        self.httpd = ThreadingHTTPServer((host, port), make_handler(scheduler, webhook))
+        if tls_cert and tls_key:
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(tls_cert, tls_key)
+            self.httpd.socket = ctx.wrap_socket(self.httpd.socket, server_side=True)
+        self._thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    def start_background(self) -> None:
+        self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    def serve_forever(self) -> None:
+        self.httpd.serve_forever()
+
+    def shutdown(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()

@@ -1,0 +1,349 @@
+"""Scheduler core: in-memory cluster state + Filter/Score/Bind.
+
+Parity: reference pkg/scheduler/scheduler.go:59-1043. Key invariants carried
+over:
+
+- Annotations are the database: the pod informer replays assigned pods into
+  PodManager/QuotaManager so a scheduler restart loses nothing (onAddPod
+  :138-168).
+- Filter builds a fresh per-node DeviceUsage snapshot from registered devices
+  plus a replay of every scheduled pod (getNodesUsage:623-707), then fans out
+  scoring per node (score.py).
+- Bind takes the per-node annotation lock before binding so the device plugin
+  can identify THE pending pod (acquireNodeLocks:794-819).
+- A register loop ingests node register annotations and runs the handshake
+  health protocol (RegisterFromNodeAnnotations:325-446).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Optional
+
+from vtpu.device.pods import PodManager
+from vtpu.device.quota import QuotaManager
+from vtpu.device.registry import DEVICES_MAP, SUPPORT_DEVICES
+from vtpu.device import codec
+from vtpu.device.types import DeviceUsage, NodeInfo, PodDevices
+from vtpu.scheduler import score as score_mod
+from vtpu.scheduler.events import EventRecorder
+from vtpu.scheduler.nodes import NodeManager
+from vtpu.scheduler.policy import pick_winner
+from vtpu.util import nodelock
+from vtpu.util import types as t
+from vtpu.util.helpers import is_pod_deleted, pod_annotations, pod_key
+from vtpu.util.k8sclient import ApiError, KubeClient, annotations
+
+log = logging.getLogger(__name__)
+
+
+class Scheduler:
+    def __init__(
+        self,
+        client: KubeClient,
+        node_policy: str = t.NODE_POLICY_BINPACK,
+        device_policy: str = t.DEVICE_POLICY_BINPACK,
+        leader_check=None,
+    ) -> None:
+        self.client = client
+        self.node_policy = node_policy
+        self.device_policy = device_policy
+        self.pod_manager = PodManager()
+        self.quota_manager = QuotaManager()
+        self.node_manager = NodeManager()
+        self.events = EventRecorder(client)
+        self.quota_manager.refresh_managed_resources()
+        self._lock = threading.RLock()
+        self._synced = threading.Event()
+        self._stop = threading.Event()
+        self._leader_check = leader_check or (lambda: True)
+        self._unsubscribe = client.subscribe(self._on_cluster_event)
+        self._register_thread: Optional[threading.Thread] = None
+
+    # ----------------------------------------------------------------- infra
+
+    def start(self, register_interval: float = 15.0) -> None:
+        """Seed caches and launch the register loop (reference Start:267)."""
+        self.sync_existing_pods()
+        self.sync_quotas()
+        self.register_from_node_annotations()
+        self._synced.set()
+
+        def loop() -> None:
+            while not self._stop.wait(register_interval):
+                try:
+                    self.register_from_node_annotations()
+                except Exception:
+                    log.exception("register loop")
+
+        self._register_thread = threading.Thread(target=loop, daemon=True)
+        self._register_thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._unsubscribe()
+
+    def wait_for_cache_sync(self, timeout: float = 30.0) -> bool:
+        return self._synced.wait(timeout)
+
+    # ------------------------------------------------------------- informers
+
+    def _on_cluster_event(self, kind: str, event_type: str, obj: dict) -> None:
+        try:
+            if kind == "Pod":
+                if event_type == "DELETED":
+                    self.on_del_pod(obj)
+                else:
+                    self.on_add_pod(obj)
+            elif kind == "Node" and event_type == "DELETED":
+                self.on_del_node(obj)
+            elif kind == "ResourceQuota":
+                if event_type == "DELETED":
+                    self.quota_manager.del_quota(obj)
+                else:
+                    self.quota_manager.add_quota(obj)
+        except Exception:
+            log.exception("informer handler %s/%s", kind, event_type)
+
+    def on_add_pod(self, pod: dict) -> None:
+        """Replay a scheduled pod's devices into the caches (reference
+        onAddPod:138-168)."""
+        annos = pod_annotations(pod)
+        node = annos.get(t.ASSIGNED_NODE, "")
+        if not node:
+            return
+        if is_pod_deleted(pod):
+            self.on_del_pod(pod)
+            return
+        devices = codec.decode_pod_devices(
+            annos, {key: vendor for vendor, key in SUPPORT_DEVICES.items()}
+        )
+        if not devices:
+            return
+        uid = pod["metadata"]["uid"]
+        if not self.pod_manager.has_pod(uid):
+            self.pod_manager.add_pod(pod, node, devices)
+            self.quota_manager.add_usage(pod, devices)
+
+    def on_del_pod(self, pod: dict) -> None:
+        info = self.pod_manager.take_and_delete_pod(pod["metadata"]["uid"])
+        if info is not None:
+            self.quota_manager.rm_usage(pod, info.devices)
+
+    def on_del_node(self, node: dict) -> None:
+        """Node gone: drop its devices and any stale lock bookkeeping
+        (reference onDelNode:206-231)."""
+        self.node_manager.rm_node_devices(node["metadata"]["name"])
+
+    def sync_existing_pods(self) -> None:
+        for pod in self.client.list_pods():
+            self.on_add_pod(pod)
+
+    def sync_quotas(self) -> None:
+        for quota in self.client.list_resource_quotas():
+            self.quota_manager.add_quota(quota)
+
+    # -------------------------------------------------------------- register
+
+    def register_from_node_annotations(self) -> None:
+        """Ingest node register annotations; run handshake health (reference
+        register:355-446, leader-only)."""
+        if not self._leader_check():
+            return
+        with self._lock:
+            for node in self.client.list_nodes():
+                name = node["metadata"]["name"]
+                for vendor, backend in DEVICES_MAP.items():
+                    try:
+                        healthy, _ = backend.check_health(node, self.client)
+                        if not healthy:
+                            log.warning("node %s vendor %s unhealthy; withdrawing", name, vendor)
+                            backend.node_cleanup(name, self.client)
+                            self.node_manager.rm_node_devices(name, vendor)
+                            continue
+                        devices = backend.get_node_devices(node)
+                        if devices:
+                            self.node_manager.add_node_devices(name, vendor, devices)
+                        else:
+                            self.node_manager.rm_node_devices(name, vendor)
+                    except codec.CodecError:
+                        log.exception("bad register annotation on %s/%s", name, vendor)
+                    except ApiError:
+                        log.exception("api error registering %s/%s", name, vendor)
+
+    # ----------------------------------------------------------------- usage
+
+    def get_nodes_usage(
+        self, node_names: Optional[list[str]] = None
+    ) -> tuple[dict[str, dict[str, list[DeviceUsage]]], dict[str, NodeInfo]]:
+        """Fresh usage snapshot per node: registered devices + scheduled-pod
+        replay (reference getNodesUsage:623-707)."""
+        node_infos = self.node_manager.list_nodes()
+        usages: dict[str, dict[str, list[DeviceUsage]]] = {}
+        for name, info in node_infos.items():
+            if node_names is not None and name not in node_names:
+                continue
+            usages[name] = {
+                vendor: [DeviceUsage.from_info(d) for d in devs]
+                for vendor, devs in info.devices.items()
+            }
+        for pinfo in self.pod_manager.list_pods_info():
+            node_usage = usages.get(pinfo.node_id)
+            if not node_usage:
+                continue
+            for vendor, single in pinfo.devices.items():
+                devs = node_usage.get(vendor, [])
+                for ctr in single:
+                    for cd in ctr:
+                        for du in devs:
+                            if du.id == cd.uuid:
+                                du.add(cd, pinfo.key)
+                                break
+        return usages, node_infos
+
+    def inspect_all_nodes_usage(self) -> dict[str, dict[str, list[DeviceUsage]]]:
+        usages, _ = self.get_nodes_usage()
+        return usages
+
+    # ------------------------------------------------------------------ reqs
+
+    @staticmethod
+    def pod_requests(pod: dict) -> list[score_mod.ContainerRequests]:
+        """Per-container, per-vendor device requests (reference Resourcereqs
+        devices.go:611-663). Init containers: the scheduler requires each init
+        container's ask to be covered by the pod's regular containers (the
+        common k8s device-plugin pattern); a larger init ask is unsupported."""
+        out: list[score_mod.ContainerRequests] = []
+        for ctr in pod.get("spec", {}).get("containers", []) or []:
+            reqs: score_mod.ContainerRequests = {}
+            for vendor, backend in DEVICES_MAP.items():
+                r = backend.generate_resource_requests(ctr)
+                if not r.empty():
+                    reqs[vendor] = r
+            out.append(reqs)
+        return out
+
+    @staticmethod
+    def has_device_request(pod: dict) -> bool:
+        return any(reqs for reqs in Scheduler.pod_requests(pod))
+
+    # ---------------------------------------------------------------- filter
+
+    def filter(self, args: dict) -> dict:
+        """Extender Filter: pick the winning node, write the decision
+        annotations (reference Filter:890-988). *args* is ExtenderArgs JSON:
+        {Pod, NodeNames | Nodes}."""
+        pod = args.get("Pod") or args.get("pod") or {}
+        requests = self.pod_requests(pod)
+        if not any(requests):
+            return {
+                "NodeNames": args.get("NodeNames") or [],
+                "FailedNodes": {},
+                "Error": "pod requests no schedulable device",
+            }
+
+        # Volcano-style simulation: full Node objects instead of names
+        # (reference filterSimulation:990-1033): score only, no annotations.
+        nodes = args.get("Nodes") or {}
+        simulation = bool(nodes.get("Items"))
+        if simulation:
+            node_names = [n["metadata"]["name"] for n in nodes["Items"]]
+        else:
+            node_names = args.get("NodeNames") or []
+
+        usages, node_infos = self.get_nodes_usage(node_names or None)
+        candidates = {n: u for n, u in usages.items() if not node_names or n in node_names}
+        failed: dict[str, str] = {
+            n: "no registered devices" for n in node_names if n not in candidates
+        }
+        scores, failures = score_mod.calc_score(
+            candidates, node_infos, pod, requests, self.node_policy, self.device_policy
+        )
+        failed.update(failures)
+        winner = pick_winner(scores, pod_annotations(pod).get(
+            t.NODE_SCHEDULER_POLICY_ANNO, self.node_policy
+        ))
+        if winner is None:
+            self.events.filtering_failed(pod, failed)
+            return {"NodeNames": [], "FailedNodes": failed, "Error": ""}
+
+        if simulation:
+            return {"NodeNames": [winner.node_name], "FailedNodes": failed, "Error": ""}
+
+        patch: dict[str, str] = {
+            t.ASSIGNED_NODE: winner.node_name,
+            t.ASSIGNED_TIME: str(int(time.time())),
+            t.BIND_PHASE: t.BIND_PHASE_ALLOCATING,
+        }
+        for backend in DEVICES_MAP.values():
+            backend.patch_annotations(pod, patch, winner.devices)
+        self.pod_manager.add_pod(pod, winner.node_name, winner.devices)
+        self.quota_manager.add_usage(pod, winner.devices)
+        try:
+            self.client.patch_pod_annotations(
+                pod["metadata"].get("namespace", "default"),
+                pod["metadata"]["name"],
+                patch,
+            )
+        except ApiError as e:
+            self.pod_manager.del_pod(pod)
+            self.quota_manager.rm_usage(pod, winner.devices)
+            self.events.filtering_failed(pod, {winner.node_name: str(e)})
+            return {"NodeNames": [], "FailedNodes": failed, "Error": f"patch failed: {e}"}
+        self.events.filtering_succeed(pod, winner.node_name)
+        return {"NodeNames": [winner.node_name], "FailedNodes": failed, "Error": ""}
+
+    # ------------------------------------------------------------------ bind
+
+    def bind(self, args: dict) -> dict:
+        """Extender Bind: node lock -> bind-phase annotations -> Binding
+        (reference Bind:821-888)."""
+        ns = args.get("PodNamespace") or "default"
+        name = args.get("PodName") or ""
+        node_name = args.get("Node") or ""
+        try:
+            pod = self.client.get_pod(ns, name)
+            node = self.client.get_node(node_name)
+        except ApiError as e:
+            return {"Error": f"bind lookup failed: {e}"}
+
+        locked_vendors: list[str] = []
+        try:
+            for vendor, backend in DEVICES_MAP.items():
+                backend.lock_node(node, pod, self.client)
+                locked_vendors.append(vendor)
+            self.client.patch_pod_annotations(
+                ns,
+                name,
+                {t.BIND_PHASE: t.BIND_PHASE_ALLOCATING, t.BIND_TIME: str(int(time.time()))},
+            )
+            self.client.bind_pod(ns, name, node_name)
+        except (nodelock.NodeLockContention, ApiError) as e:
+            log.warning("bind %s/%s -> %s failed: %s", ns, name, node_name, e)
+            for vendor in locked_vendors:
+                try:
+                    DEVICES_MAP[vendor].release_node_lock(node, pod, self.client)
+                except ApiError:
+                    log.exception("release lock after failed bind")
+            self._cleanup_stale_pod_allocation(pod)
+            self.events.binding_failed(pod, node_name, str(e))
+            return {"Error": str(e)}
+        self.events.binding_succeed(pod, node_name)
+        return {"Error": ""}
+
+    def _cleanup_stale_pod_allocation(self, pod: dict) -> None:
+        """Failed bind: withdraw the Filter decision so the devices free up
+        (reference cleanupStalePodAllocation scheduler.go:771-775)."""
+        info = self.pod_manager.take_and_delete_pod(pod["metadata"]["uid"])
+        if info is not None:
+            self.quota_manager.rm_usage(pod, info.devices)
+        try:
+            self.client.patch_pod_annotations(
+                pod["metadata"].get("namespace", "default"),
+                pod["metadata"]["name"],
+                {t.ASSIGNED_NODE: None, t.ASSIGNED_TIME: None, t.BIND_PHASE: None},
+            )
+        except ApiError:
+            log.exception("cleanup stale pod allocation")

@@ -1,0 +1,110 @@
+"""Score engine: per-node fan-out, per-container device fitting.
+
+Parity: reference pkg/scheduler/score.go (calcScoreWithOptions:105-217 with
+one goroutine per node; fitInDevices:52-99). Python version fans out on a
+thread pool; each node works on its own usage snapshot so no locking is
+needed inside the fit loop.
+"""
+
+from __future__ import annotations
+
+import logging
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional
+
+from vtpu.device.registry import DEVICES_MAP
+from vtpu.device.types import ContainerDeviceRequest, DeviceUsage, NodeInfo
+from vtpu.scheduler import policy as policy_mod
+from vtpu.scheduler.policy import NodeScore
+from vtpu.util import types as t
+from vtpu.util.helpers import pod_annotations
+
+log = logging.getLogger(__name__)
+
+# vendor -> request, one dict per container
+ContainerRequests = dict[str, ContainerDeviceRequest]
+
+
+def fit_in_devices(
+    score: NodeScore,
+    requests: ContainerRequests,
+    pod: dict,
+    node_info: NodeInfo,
+    device_policy: str,
+) -> tuple[bool, str]:
+    """Fit ONE container's per-vendor requests onto the node snapshot,
+    mutating the snapshot and appending the assignment (reference
+    fitInDevices score.go:52-99)."""
+    for vendor, request in requests.items():
+        if request.empty():
+            score.devices.setdefault(vendor, []).append([])
+            continue
+        backend = DEVICES_MAP.get(vendor)
+        if backend is None:
+            return False, f"no backend for vendor {vendor}"
+        devices = score.snapshot.get(vendor, [])
+        ordered = policy_mod.sort_devices_for_policy(devices, device_policy)
+        fit, result, reason = backend.fit(ordered, request, pod, node_info, score.devices)
+        if not fit:
+            return False, reason or "fit failed"
+        for res_vendor, ctr_devices in result.items():
+            for cd in ctr_devices:
+                for dev in score.snapshot.get(res_vendor, []):
+                    if dev.id == cd.uuid:
+                        DEVICES_MAP[res_vendor].add_resource_usage(pod, dev, cd)
+                        break
+            score.devices.setdefault(res_vendor, []).append(ctr_devices)
+    # vendors not requested by this container still need their slot recorded
+    for vendor in score.devices:
+        if vendor not in requests:
+            score.devices[vendor].append([])
+    return True, ""
+
+
+def calc_score(
+    nodes_usage: dict[str, dict[str, list[DeviceUsage]]],
+    node_infos: dict[str, NodeInfo],
+    pod: dict,
+    per_container_requests: list[ContainerRequests],
+    node_policy: str = t.NODE_POLICY_BINPACK,
+    device_policy: str = t.DEVICE_POLICY_BINPACK,
+    max_workers: int = 8,
+) -> tuple[list[NodeScore], dict[str, str]]:
+    """Score every candidate node for *pod*; returns (fitting nodes' scores,
+    failure reason per failed node). Per-pod annotations override policies
+    (reference score.go:105-217)."""
+    annos = pod_annotations(pod)
+    node_policy = annos.get(t.NODE_SCHEDULER_POLICY_ANNO, node_policy)
+    device_policy = annos.get(t.DEVICE_SCHEDULER_POLICY_ANNO, device_policy)
+
+    def score_node(node_name: str) -> tuple[Optional[NodeScore], str]:
+        snapshot = nodes_usage[node_name]
+        ns = NodeScore(node_name=node_name, snapshot=snapshot)
+        ns.score = policy_mod.compute_default_node_score(snapshot)
+        node_info = node_infos.get(node_name) or NodeInfo(node_name=node_name)
+        for requests in per_container_requests:
+            ok, reason = fit_in_devices(ns, requests, pod, node_info, device_policy)
+            if not ok:
+                return None, reason
+        # vendor ScoreNode overrides stack on the default (reference
+        # OverrideScore node_policy.go:56)
+        for vendor, backend in DEVICES_MAP.items():
+            ns.score += backend.score_node(
+                {}, ns.devices.get(vendor, []), snapshot.get(vendor, []), node_policy
+            )
+        return ns, ""
+
+    scores: list[NodeScore] = []
+    failures: dict[str, str] = {}
+    names = list(nodes_usage.keys())
+    if len(names) == 1:
+        results = [score_node(names[0])]
+    else:
+        with ThreadPoolExecutor(max_workers=min(max_workers, max(1, len(names)))) as ex:
+            results = list(ex.map(score_node, names))
+    for name, (ns, reason) in zip(names, results):
+        if ns is None:
+            failures[name] = reason
+        else:
+            scores.append(ns)
+    return scores, failures

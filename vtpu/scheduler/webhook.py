@@ -1,0 +1,94 @@
+"""Mutating admission webhook: steer device pods to the vtpu scheduler.
+
+Parity: reference pkg/scheduler/webhook.go:38-158 — skip privileged
+containers, let every vendor backend normalize the container, force
+schedulerName, deny pre-set nodeName, pre-check namespace ResourceQuota.
+"""
+
+from __future__ import annotations
+
+import base64
+import copy
+import json
+import logging
+
+from vtpu.device.registry import DEVICES_MAP
+from vtpu.device.quota import QuotaManager
+from vtpu.util import types as t
+
+log = logging.getLogger(__name__)
+
+FOREIGN_SCHEDULERS_OK = ("", "default-scheduler", t.SCHEDULER_NAME)
+
+
+class WebHook:
+    def __init__(self, quota_manager: QuotaManager | None = None, scheduler_name: str = t.SCHEDULER_NAME):
+        self.quota_manager = quota_manager
+        self.scheduler_name = scheduler_name
+
+    def handle(self, review: dict) -> dict:
+        """AdmissionReview in -> AdmissionReview out (JSONPatch response)."""
+        request = review.get("request", {})
+        uid = request.get("uid", "")
+        pod = copy.deepcopy(request.get("object", {}) or {})
+        response: dict = {"uid": uid, "allowed": True}
+        out = {
+            "apiVersion": review.get("apiVersion", "admission.k8s.io/v1"),
+            "kind": "AdmissionReview",
+            "response": response,
+        }
+
+        spec = pod.get("spec", {})
+        scheduler_name = spec.get("schedulerName", "")
+        if scheduler_name not in FOREIGN_SCHEDULERS_OK:
+            # Foreign scheduler owns this pod (reference webhook.go:64-69).
+            return out
+
+        found = False
+        for ctr in spec.get("containers", []) or []:
+            if (ctr.get("securityContext") or {}).get("privileged"):
+                # Privileged containers see all devices anyway; don't hook them
+                # (reference webhook.go:74-79).
+                continue
+            for backend in DEVICES_MAP.values():
+                if backend.mutate_admission(ctr, pod):
+                    found = True
+        if not found:
+            return out
+
+        if spec.get("nodeName"):
+            response["allowed"] = False
+            response["status"] = {
+                "message": f"pod {pod.get('metadata', {}).get('name')} has nodeName set; "
+                "device-aware scheduling is impossible (reference webhook.go:87-91)",
+            }
+            return out
+
+        if self.quota_manager is not None and not self._fit_resource_quota(pod):
+            response["allowed"] = False
+            response["status"] = {"message": "namespace device quota exceeded"}
+            return out
+
+        spec["schedulerName"] = self.scheduler_name
+        patch = [
+            {"op": "replace", "path": "/spec/containers", "value": spec["containers"]},
+            {"op": "add", "path": "/spec/schedulerName", "value": self.scheduler_name},
+        ]
+        response["patchType"] = "JSONPatch"
+        response["patch"] = base64.b64encode(json.dumps(patch).encode()).decode()
+        return out
+
+    def _fit_resource_quota(self, pod: dict) -> bool:
+        """Admission-time namespace quota pre-check (reference
+        fitResourceQuota webhook.go:111-158)."""
+        ns = pod.get("metadata", {}).get("namespace", "default")
+        for ctr in pod.get("spec", {}).get("containers", []) or []:
+            for vendor, backend in DEVICES_MAP.items():
+                req = backend.generate_resource_requests(ctr)
+                if req.empty():
+                    continue
+                if not self.quota_manager.fit_quota(
+                    ns, vendor, req.memreq * req.nums, req.coresreq * req.nums
+                ):
+                    return False
+        return True

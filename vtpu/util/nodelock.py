@@ -28,6 +28,10 @@ from vtpu.util.k8sclient import ConflictError, KubeClient, NotFoundError, annota
 log = logging.getLogger(__name__)
 
 DEFAULT_EXPIRE_SECONDS = 300.0
+# How long to wait for the in-process mutex before failing fast with
+# contention; deliberately NOT the lock expiry (a bind should not stall 5 min
+# behind a stuck sibling thread).
+DEFAULT_WAIT_SECONDS = 10.0
 MAX_RETRIES = 5
 RETRY_BACKOFF = 0.1
 
@@ -58,6 +62,13 @@ def _expire_seconds() -> float:
         return DEFAULT_EXPIRE_SECONDS
 
 
+def _wait_seconds() -> float:
+    try:
+        return float(os.environ.get("VTPU_NODELOCK_WAIT", DEFAULT_WAIT_SECONDS))
+    except ValueError:
+        return DEFAULT_WAIT_SECONDS
+
+
 def format_lock_value(pod: dict, now: float | None = None) -> str:
     m = pod["metadata"]
     return f"{timeutil.format_ts(now)},{m.get('namespace', 'default')},{m.get('name', '')}"
@@ -86,7 +97,7 @@ def _owner_is_dangling(client: KubeClient, ns: str, name: str) -> bool:
 def lock_node(client: KubeClient, node_name: str, pod: dict, now: float | None = None) -> None:
     """Acquire the node lock for *pod* or raise NodeLockContention."""
     plock = _proc_lock(node_name)
-    if not plock.acquire(timeout=_expire_seconds()):
+    if not plock.acquire(timeout=_wait_seconds()):
         raise NodeLockContention(f"in-process lock busy for node {node_name}")
     try:
         for attempt in range(MAX_RETRIES):
