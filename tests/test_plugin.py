@@ -1,0 +1,178 @@
+"""Device plugin: rm enumeration, gRPC surface over a unix socket, and the
+full control-plane slice (scheduler Filter/Bind -> plugin Allocate), mirroring
+the reference's plugin tests + e2e pod suite shape."""
+
+import os
+import threading
+
+import grpc
+import pytest
+
+from vtpu.device import codec
+from vtpu.plugin import envs
+from vtpu.plugin.api import deviceplugin_pb2 as pb
+from vtpu.plugin.api.grpc_api import DevicePluginStub
+from vtpu.plugin.register import Registrar
+from vtpu.plugin.rm import TpuResourceManager, discover_chips
+from vtpu.plugin.server import PluginConfig, PluginServer, TpuDevicePlugin
+from vtpu.scheduler.scheduler import Scheduler
+from vtpu.util import types as t
+from vtpu.util.k8sclient import FakeKubeClient, annotations
+
+from tests.helpers import fake_cluster, register_tpu_backend, tpu_pod, v5e_devices
+
+
+@pytest.fixture
+def mock_chips(monkeypatch):
+    monkeypatch.setenv("VTPU_MOCK_DEVICES", "8")
+    monkeypatch.setenv("VTPU_MOCK_DEVMEM", "16384")
+    return discover_chips(split_count=4, hostname="host1")
+
+
+def test_discover_mock_chips(mock_chips):
+    assert len(mock_chips) == 8
+    assert mock_chips[0].uuid == "host1-tpu-0"
+    assert mock_chips[0].devmem == 16384
+    assert {c.numa for c in mock_chips} == {0, 1}
+    assert mock_chips[7].ici.x == 3 and mock_chips[7].ici.y == 1
+
+
+def test_rm_replicas_and_health(mock_chips):
+    rm = TpuResourceManager(mock_chips, split_count=4)
+    ids = rm.replica_ids()
+    assert len(ids) == 32
+    assert ids[0][0] == "host1-tpu-0::0"
+    assert rm.chip_uuid_of("host1-tpu-0::3") == "host1-tpu-0"
+    fired = []
+    rm.on_health_change(lambda: fired.append(1))
+    rm.set_health("host1-tpu-0", False)
+    assert fired and not rm.replica_ids()[0][1]
+    rm.set_health("host1-tpu-0", False)  # no change, no event
+    assert len(fired) == 1
+
+
+def test_registrar_publishes_annotations(mock_chips):
+    client = FakeKubeClient()
+    client.put_node({"metadata": {"name": "n1"}})
+    rm = TpuResourceManager(mock_chips, split_count=4)
+    Registrar(client, rm, "n1").register_once()
+    annos = annotations(client.get_node("n1"))
+    devices = codec.decode_node_devices(annos["vtpu.io/node-tpu-register"])
+    assert len(devices) == 8 and devices[0].count == 4
+    assert annos["vtpu.io/node-handshake-tpu"].startswith("Reported_")
+
+
+@pytest.fixture
+def served_plugin(mock_chips, tmp_path):
+    client = fake_cluster({"host1": v5e_devices(8, prefix="host1-tpu")})
+    rm = TpuResourceManager(mock_chips, split_count=4)
+    config = PluginConfig(node_name="host1", hook_path=str(tmp_path / "hook"))
+    plugin = TpuDevicePlugin(rm, client, config)
+    server = PluginServer(plugin, str(tmp_path / "vtpu.sock"))
+    server.start()
+    channel = grpc.insecure_channel(f"unix://{server.socket_path}")
+    yield client, rm, DevicePluginStub(channel), config
+    channel.close()
+    server.stop(grace=0.1)
+
+
+def test_grpc_list_and_watch_and_options(served_plugin):
+    _, rm, stub, _ = served_plugin
+    opts = stub.GetDevicePluginOptions(pb.Empty())
+    assert opts.get_preferred_allocation_available
+    stream = stub.ListAndWatch(pb.Empty())
+    first = next(stream)
+    assert len(first.devices) == 32
+    assert first.devices[0].health == "Healthy"
+    assert first.devices[0].topology.nodes[0].ID in (0, 1)
+    # flip health -> pushed update
+    rm.set_health("host1-tpu-2", False)
+    second = next(stream)
+    sick = [d for d in second.devices if d.ID.startswith("host1-tpu-2::")]
+    assert all(d.health == "Unhealthy" for d in sick) and len(sick) == 4
+
+
+def test_grpc_preferred_allocation_prefers_adjacent_chips(served_plugin):
+    _, rm, stub, _ = served_plugin
+    available = [rid for rid, _, _ in rm.replica_ids()]
+    resp = stub.GetPreferredAllocation(pb.PreferredAllocationRequest(
+        container_requests=[pb.ContainerPreferredAllocationRequest(
+            available_deviceIDs=available, allocation_size=2)]))
+    picked = list(resp.container_responses[0].deviceIDs)
+    assert len(picked) == 2
+    chips = {rm.chip_uuid_of(r) for r in picked}
+    if len(chips) == 2:  # two chips: must be ICI neighbors
+        a, b = (rm.chip_by_uuid(u) for u in chips)
+        assert a.ici.distance(b.ici) == 1
+
+
+def test_allocate_full_slice(served_plugin):
+    """scheduler Filter+Bind then kubelet Allocate: the minimum end-to-end
+    control-plane slice (SURVEY §7)."""
+    client, rm, stub, config = served_plugin
+    sched = Scheduler(client)
+    register_tpu_backend(quota=sched.quota_manager)
+    sched.start(register_interval=3600)
+
+    pod = client.put_pod(tpu_pod("infer", tpumem=4096, tpucores=25,
+                                 annotations={t.TASK_PRIORITY_ANNO: "1"}))
+    result = sched.filter({"Pod": pod, "NodeNames": ["host1"]})
+    assert result["NodeNames"] == ["host1"]
+    assert sched.bind({"PodName": "infer", "PodNamespace": "default",
+                       "Node": "host1"})["Error"] == ""
+
+    resp = stub.Allocate(pb.AllocateRequest(
+        container_requests=[pb.ContainerAllocateRequest(devicesIDs=["host1-tpu-0::0"])]))
+    assert len(resp.container_responses) == 1
+    ctr = resp.container_responses[0]
+    env = dict(ctr.envs)
+    assert env[envs.ENV_DEVICE_MEMORY_LIMIT.format(index=0)] == "4096m"
+    assert env[envs.ENV_CORE_LIMIT] == "25"
+    assert env[envs.ENV_TASK_PRIORITY] == "1"
+    assert env[envs.ENV_VISIBLE_CHIPS] != ""
+    mounts = {m.container_path: m.host_path for m in ctr.mounts}
+    assert mounts["/etc/ld.so.preload"].endswith("ld.so.preload")
+    assert "/usr/local/vtpu/libvtpu.so" in mounts
+    # shared-region host dir was created
+    region_host_dir = mounts[envs.CONTAINER_CACHE_DIR]
+    assert os.path.isdir(region_host_dir)
+
+    stored = client.get_pod("default", "infer")
+    annos = annotations(stored)
+    assert annos[t.BIND_PHASE] == t.BIND_PHASE_SUCCESS
+    assert "vtpu.io/tpu-devices-to-allocate" not in annos  # consumed
+    assert "vtpu.io/tpu-devices-allocated" in annos  # durable record
+    # node lock released
+    assert t.NODE_LOCK_ANNO not in annotations(client.get_node("host1"))
+    sched.stop()
+
+
+def test_allocate_without_pending_pod_fails(served_plugin):
+    _, _, stub, _ = served_plugin
+    with pytest.raises(grpc.RpcError) as exc:
+        stub.Allocate(pb.AllocateRequest(
+            container_requests=[pb.ContainerAllocateRequest(devicesIDs=["x"])]))
+    assert exc.value.code() == grpc.StatusCode.FAILED_PRECONDITION
+
+
+def test_allocate_multi_container_consumes_in_order(served_plugin):
+    client, rm, stub, config = served_plugin
+    sched = Scheduler(client)
+    register_tpu_backend(quota=sched.quota_manager)
+    sched.start(register_interval=3600)
+    pod = tpu_pod("multi", tpumem=2048)
+    pod["spec"]["containers"].append(
+        {"name": "second", "resources": {"limits": {"google.com/tpumem": "1024"}}})
+    pod = client.put_pod(pod)
+    assert sched.filter({"Pod": pod, "NodeNames": ["host1"]})["NodeNames"] == ["host1"]
+    assert sched.bind({"PodName": "multi", "PodNamespace": "default",
+                       "Node": "host1"})["Error"] == ""
+    resp = stub.Allocate(pb.AllocateRequest(container_requests=[
+        pb.ContainerAllocateRequest(devicesIDs=["a"]),
+        pb.ContainerAllocateRequest(devicesIDs=["b"]),
+    ]))
+    envs0 = dict(resp.container_responses[0].envs)
+    envs1 = dict(resp.container_responses[1].envs)
+    assert envs0[envs.ENV_DEVICE_MEMORY_LIMIT.format(index=0)] == "2048m"
+    assert envs1[envs.ENV_DEVICE_MEMORY_LIMIT.format(index=0)] == "1024m"
+    sched.stop()

@@ -162,3 +162,36 @@ def test_handshake_withdraws_dead_agent():
     sched.register_from_node_annotations()
     assert "node-a" not in sched.inspect_all_nodes_usage()
     sched.stop()
+
+
+def test_filter_retry_does_not_double_count_quota(cluster):
+    """Regression: re-Filter of a still-unbound pod supersedes the previous
+    decision instead of stacking quota usage."""
+    client, sched = cluster
+    sched.quota_manager.add_quota({
+        "metadata": {"name": "q", "namespace": "default"},
+        "spec": {"hard": {"limits.google.com/tpumem": 100000}}})
+    pod, _ = _filter(sched, client, tpu_pod("p1", tpumem=4096))
+    pod = client.get_pod("default", "p1")
+    sched.filter({"Pod": pod, "NodeNames": ["node-a", "node-b"]})  # retry
+    used = sched.quota_manager.snapshot()["default"]["google.com/tpumem"]["used"]
+    assert used == 4096
+    client.delete_pod("default", "p1")
+    used = sched.quota_manager.snapshot()["default"]["google.com/tpumem"]["used"]
+    assert used == 0
+
+
+def test_sidecar_before_device_container_keeps_slot_alignment(cluster):
+    """Regression: a deviceless container BEFORE the device container still
+    occupies annotation slot 0."""
+    from vtpu.device import codec as codec_mod
+    client, sched = cluster
+    pod = tpu_pod("sidecar-first", tpumem=1024)
+    pod["spec"]["containers"].insert(0, {"name": "sidecar", "resources": {}})
+    pod, result = _filter(sched, client, pod)
+    assert result["NodeNames"]
+    anno = annotations(client.get_pod("default", "sidecar-first"))[
+        "vtpu.io/tpu-devices-to-allocate"]
+    slots = codec_mod.decode_pod_single_device(anno)
+    assert len(slots) == 2
+    assert slots[0] == [] and len(slots[1]) == 1

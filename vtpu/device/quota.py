@@ -153,7 +153,9 @@ class QuotaManager:
 
     # ---------------------------------------------------------------- checks
 
-    def fit_quota(self, namespace: str, vendor: str, memreq: int, coresreq: int) -> bool:
+    def fit_quota(
+        self, namespace: str, vendor: str, memreq: int, coresreq: int, count: int = 0
+    ) -> bool:
         """Would this additional usage stay within the namespace quota?
         (reference FitQuota; called from vendor Fit paths)."""
         with self._lock:
@@ -166,9 +168,14 @@ class QuotaManager:
             for res, (word, role) in self._managed.items():
                 if word != vendor or res not in limits:
                     continue
-                add = memreq if role in ("mem", "memPercentage") else (
-                    coresreq if role == "cores" else 0
-                )
+                if role in ("mem", "memPercentage"):
+                    add = memreq
+                elif role == "cores":
+                    add = coresreq
+                elif role == "count":
+                    add = count
+                else:
+                    add = 0
                 if add and entry.used.get(res, 0) + add > limits[res]:
                     return False
             return True

@@ -54,6 +54,15 @@ def _parse_int(v) -> int:
 
 @dataclass
 class TpuConfig:
+    """Cluster-wide TPU section of device-config.yaml.
+
+    The split/scaling knobs are ENFORCED BY THE NODE AGENT (the plugin reads
+    the same device-config and bakes them into the node register annotation,
+    which is authoritative for scheduling) — the scheduler side only uses the
+    resource names and type selectors. Mirrors the reference where the shared
+    ConfigMap feeds both binaries (config.go:298-465, vgpucfg.go:34-71).
+    """
+
     resource_count_name: str = "google.com/tpu"
     resource_memory_name: str = "google.com/tpumem"
     resource_memory_percentage_name: str = "google.com/tpumem-percentage"
@@ -241,22 +250,29 @@ class TpuDevices(Devices):
             )
             return False, {}, f"{msg}; {detail}" if detail else msg
 
-        # Namespace device quota (reference fitQuota device.go:725-744).
-        if self.quota is not None:
-            ns = pod.get("metadata", {}).get("namespace", "default")
-            memsum = sum(
-                request.memreq
-                or d.totalmem * request.mem_percentage_req // 100
-                for d in candidates[: request.nums]
-            )
-            if not self.quota.fit_quota(ns, TPU_COMMON_WORD, memsum, request.coresreq * request.nums):
-                reasons[common.ALLOCATED_POD_OVERQUOTA] += 1
-                return False, {}, common.gen_reason(reasons, len(devices))
-
         chosen = topology.select_subslice(candidates, request.nums)
         if chosen is None:
             reasons[common.TOPOLOGY_NOT_FIT] += 1
             return False, {}, common.gen_reason(reasons, len(devices))
+
+        # Namespace device quota over the devices actually chosen — percentage
+        # asks resolve to different MiB on heterogeneous chips (reference
+        # fitQuota device.go:725-744).
+        if self.quota is not None:
+            ns = pod.get("metadata", {}).get("namespace", "default")
+            memsum = sum(
+                request.memreq or d.totalmem * request.mem_percentage_req // 100
+                for d in chosen
+            )
+            if not self.quota.fit_quota(
+                ns,
+                TPU_COMMON_WORD,
+                memsum,
+                request.coresreq * request.nums,
+                count=request.nums,
+            ):
+                reasons[common.ALLOCATED_POD_OVERQUOTA] += 1
+                return False, {}, common.gen_reason(reasons, len(devices))
 
         out: ContainerDevices = []
         for dev in chosen:

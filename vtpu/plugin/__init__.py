@@ -1,0 +1,7 @@
+"""TPU device plugin: the node agent.
+
+Parity: reference cmd/device-plugin/nvidia + pkg/device-plugin/nvidiadevice —
+kubelet DevicePlugin gRPC server, 30s register loop publishing node
+annotations, and the Allocate path that turns a scheduler decision into
+container envs/mounts consumed by libvtpu.
+"""

@@ -1,0 +1,111 @@
+"""TPU device plugin binary (reference cmd/device-plugin/nvidia/main.go).
+
+Serves the kubelet DevicePlugin API for google.com/tpu, registers the node's
+chips via annotations, and restarts its gRPC endpoint when kubelet's socket is
+recreated (kubelet restart), mirroring the reference's fsnotify loop
+(main.go:262-344) with mtime polling.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import time
+
+from vtpu.plugin.api import grpc_api
+from vtpu.plugin.register import Registrar
+from vtpu.plugin.rm import TpuResourceManager, discover_chips
+from vtpu.plugin.server import PluginConfig, PluginServer, TpuDevicePlugin
+from vtpu.util.k8sclient import RealKubeClient, init_global_client
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser("vtpu-device-plugin")
+    parser.add_argument("--node-name", default=os.environ.get("NODE_NAME", ""))
+    parser.add_argument("--device-split-count", type=int, default=4)
+    parser.add_argument("--device-memory-scaling", type=float, default=1.0)
+    parser.add_argument("--device-cores-scaling", type=float, default=1.0)
+    parser.add_argument("--resource-name", default="google.com/tpu")
+    parser.add_argument("--hook-path", default=os.environ.get("HOOK_PATH", "/usr/local/vtpu"))
+    parser.add_argument("--socket-dir", default=grpc_api.PLUGIN_SOCKET_DIR)
+    parser.add_argument("--kubelet-socket", default=grpc_api.KUBELET_SOCKET)
+    parser.add_argument("--register-interval", type=float, default=30.0)
+    parser.add_argument("--device-config", default="",
+                        help="device-config.yaml (same ConfigMap as the scheduler); "
+                        "its tpu section provides split/scaling defaults, CLI flags win")
+    parser.add_argument("--kube-api", default="")
+    parser.add_argument("--mode", default="", choices=["", "exclusive"])
+    parser.add_argument("-v", "--verbose", action="count", default=0)
+    args = parser.parse_args()
+
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
+    if not args.node_name:
+        parser.error("--node-name (or NODE_NAME env) is required")
+
+    if args.device_config:
+        from vtpu.scheduler.config import load_device_config
+
+        tpu_cfg = load_device_config(args.device_config).get("tpu", {}) or {}
+        defaults = parser.parse_args([a for a in ["--node-name", args.node_name]])
+        if args.device_split_count == defaults.device_split_count:
+            args.device_split_count = int(tpu_cfg.get("deviceSplitCount", args.device_split_count))
+        if args.device_memory_scaling == defaults.device_memory_scaling:
+            args.device_memory_scaling = float(tpu_cfg.get("deviceMemoryScaling", args.device_memory_scaling))
+        if args.device_cores_scaling == defaults.device_cores_scaling:
+            args.device_cores_scaling = float(tpu_cfg.get("deviceCoresScaling", args.device_cores_scaling))
+        if args.resource_name == defaults.resource_name:
+            args.resource_name = tpu_cfg.get("resourceCountName", args.resource_name)
+
+    client = RealKubeClient(base_url=args.kube_api)
+    init_global_client(client)
+
+    chips = discover_chips(
+        split_count=args.device_split_count,
+        memory_scaling=args.device_memory_scaling,
+        cores_scaling=args.device_cores_scaling,
+    )
+    logging.info("discovered %d TPU chips", len(chips))
+    rm = TpuResourceManager(chips, split_count=args.device_split_count)
+    registrar = Registrar(client, rm, args.node_name, mode=args.mode)
+    registrar.start_background(args.register_interval)
+
+    config = PluginConfig(
+        resource_name=args.resource_name,
+        node_name=args.node_name,
+        hook_path=args.hook_path,
+    )
+    socket_path = os.path.join(args.socket_dir, "vtpu.sock")
+
+    while True:
+        plugin = TpuDevicePlugin(rm, client, config)
+        server = PluginServer(plugin, socket_path)
+        server.start()
+        try:
+            server.register_with_kubelet(args.kubelet_socket)
+        except Exception:
+            logging.exception("kubelet registration failed; retrying in 5s")
+            server.stop()
+            time.sleep(5)
+            continue
+        # watch for kubelet restarts: socket inode change -> re-register
+        try:
+            start_stat = os.stat(args.kubelet_socket)
+            while True:
+                time.sleep(2)
+                cur = os.stat(args.kubelet_socket)
+                if (cur.st_ino, cur.st_dev) != (start_stat.st_ino, start_stat.st_dev):
+                    logging.info("kubelet restarted; re-serving")
+                    break
+        except FileNotFoundError:
+            logging.info("kubelet socket vanished; waiting for restart")
+            time.sleep(5)
+        finally:
+            server.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,9 @@
+"""kubelet DevicePlugin v1beta1 wire API.
+
+``deviceplugin_pb2`` is protoc-generated from ``deviceplugin.proto`` (checked
+in; regenerate with ``protoc --python_out=. deviceplugin.proto``). The gRPC
+service wiring lives in ``grpc_api.py`` — hand-written handler tables instead
+of grpcio-tools codegen (not available in this image).
+"""
+
+from vtpu.plugin.api import deviceplugin_pb2 as pb  # noqa: F401

@@ -1,0 +1,43 @@
+"""The env/mount contract between Allocate and libvtpu inside the container.
+
+Parity: reference plugin/server.go:660-711 (CUDA_DEVICE_MEMORY_LIMIT_<i>,
+CUDA_DEVICE_SM_LIMIT, shared-cache path, libvgpu.so + ld.so.preload mounts).
+The C++ side (libvtpu/src/limits.cc) parses exactly these names.
+"""
+
+from __future__ import annotations
+
+# HBM cap for the i-th visible chip, e.g. "4096m" (MiB) or plain bytes.
+ENV_DEVICE_MEMORY_LIMIT = "TPU_DEVICE_MEMORY_LIMIT_{index}"
+# TensorCore duty-cycle percent (0-100; 0/100 = unthrottled).
+ENV_CORE_LIMIT = "TPU_CORE_LIMIT"
+# Path of the mmap'ed shared usage region for this container.
+ENV_SHARED_REGION = "VTPU_SHARED_REGION"
+# Allow HBM oversubscription (libvtpu warns instead of failing the alloc).
+ENV_OVERSUBSCRIBE = "VTPU_OVERSUBSCRIBE"
+# Core-limit policy: default | force | disable (reference
+# GPU_CORE_UTILIZATION_POLICY).
+ENV_CORE_POLICY = "VTPU_CORE_UTILIZATION_POLICY"
+# Task priority (0 low / 1 high) for the monitor feedback loop.
+ENV_TASK_PRIORITY = "VTPU_TASK_PRIORITY"
+# libvtpu log level: 0 silent .. 4 trace.
+ENV_LOG_LEVEL = "LIBVTPU_LOG_LEVEL"
+# Chip indexes visible to the workload (comma-separated host indexes).
+ENV_VISIBLE_CHIPS = "TPU_VISIBLE_CHIPS"
+# Disable all enforcement (escape hatch; reference CUDA_DISABLE_CONTROL).
+ENV_DISABLE_CONTROL = "VTPU_DISABLE_CONTROL"
+
+# Node-host filesystem layout (reference /usr/local/vgpu + HOOK_PATH).
+DEFAULT_HOOK_PATH = "/usr/local/vtpu"
+LIBVTPU_SO = "libvtpu.so"
+LD_SO_PRELOAD = "ld.so.preload"
+CONTAINERS_DIR = "containers"  # <hook>/containers/<podUID>_<ctr>/<uuid>.cache
+CACHE_SUFFIX = ".cache"
+
+CONTAINER_LIB_PATH = "/usr/local/vtpu/libvtpu.so"
+CONTAINER_PRELOAD_PATH = "/etc/ld.so.preload"
+CONTAINER_CACHE_DIR = "/tmp/vtpu"
+
+
+def shared_region_dir(hook_path: str, pod_uid: str, container: str) -> str:
+    return f"{hook_path}/{CONTAINERS_DIR}/{pod_uid}_{container}"

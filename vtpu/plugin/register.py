@@ -1,0 +1,69 @@
+"""Node registration loop: publish the chip inventory as node annotations.
+
+Parity: reference plugin/register.go (WatchAndRegister:241-280 every 30s,
+RegisterInAnnotation:193-239). The handshake annotation is refreshed with a
+``Reported_<ts>`` mark each tick so the scheduler-side staleness check
+(devices.go:538-577 analog in device/base.py) sees a live agent.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from vtpu.device import codec
+from vtpu.plugin.rm import TpuResourceManager
+from vtpu.util import timeutil
+from vtpu.util import types as t
+from vtpu.util.k8sclient import ApiError, KubeClient
+
+log = logging.getLogger(__name__)
+
+REGISTER_ANNO = "vtpu.io/node-tpu-register"
+HANDSHAKE_ANNO = f"{t.NODE_HANDSHAKE_PREFIX}tpu"
+
+
+class Registrar:
+    def __init__(self, client: KubeClient, rm: TpuResourceManager, node_name: str, mode: str = ""):
+        self.client = client
+        self.rm = rm
+        self.node_name = node_name
+        self.mode = mode
+        self._stop = threading.Event()
+
+    def register_once(self) -> None:
+        infos = self.rm.device_infos(mode=self.mode)
+        self.client.patch_node_annotations(
+            self.node_name,
+            {
+                REGISTER_ANNO: codec.encode_node_devices(infos),
+                HANDSHAKE_ANNO: f"Reported_{timeutil.format_ts()}",
+            },
+        )
+        log.debug("registered %d chips on %s", len(infos), self.node_name)
+
+    def watch_and_register(self, interval: float = 30.0) -> None:
+        while not self._stop.is_set():
+            try:
+                self.register_once()
+            except ApiError:
+                log.exception("node registration")
+            self._stop.wait(interval)
+
+    def start_background(self, interval: float = 30.0) -> threading.Thread:
+        th = threading.Thread(
+            target=self.watch_and_register, args=(interval,), daemon=True
+        )
+        th.start()
+        return th
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self.client.patch_node_annotations(
+                self.node_name,
+                {HANDSHAKE_ANNO: codec.handshake_deleted_value()},
+            )
+        except ApiError:
+            log.exception("deregister handshake")

@@ -1,0 +1,310 @@
+"""kubelet DevicePlugin gRPC server + the Allocate path.
+
+Parity: reference pkg/device-plugin/nvidiadevice/nvinternal/plugin/server.go
+(:91-1002). The flow that matters (reference Allocate:593-732):
+
+1. kubelet calls Allocate with opaque replica IDs;
+2. the plugin ignores those IDs and instead resolves THE pending pod on this
+   node (bind-phase=allocating, guaranteed unique by the scheduler's node
+   lock), reads the scheduler's per-container device assignment annotation,
+3. emits the env/mount contract for libvtpu (envs.py),
+4. consumes the assignment annotation slot, and on completion marks the pod
+   bind-phase=success and releases the node lock.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from concurrent import futures
+from dataclasses import dataclass, field
+
+import grpc
+
+from vtpu.device import codec
+from vtpu.device.types import ContainerDevices
+from vtpu.plugin import envs
+from vtpu.plugin.api import deviceplugin_pb2 as pb
+from vtpu.plugin.api import grpc_api
+from vtpu.plugin.rm import TpuResourceManager
+from vtpu.util import nodelock
+from vtpu.util import types as t
+from vtpu.util.helpers import (
+    get_pending_pod,
+    pod_allocation_failed,
+    pod_allocation_try_success,
+    pod_annotations,
+)
+from vtpu.util.k8sclient import ApiError, KubeClient
+
+log = logging.getLogger(__name__)
+
+IN_REQUEST_ANNO = "vtpu.io/tpu-devices-to-allocate"
+
+
+@dataclass
+class PluginConfig:
+    resource_name: str = "google.com/tpu"
+    node_name: str = ""
+    hook_path: str = envs.DEFAULT_HOOK_PATH
+    core_policy: str = "default"
+    oversubscribe: bool = False
+    log_level: str = "1"
+    # extra passthrough envs (reference vgpucfg.go node overrides)
+    extra_envs: dict[str, str] = field(default_factory=dict)
+
+
+class TpuDevicePlugin:
+    """The v1beta1.DevicePlugin servicer for google.com/tpu."""
+
+    def __init__(self, rm: TpuResourceManager, client: KubeClient, config: PluginConfig):
+        self.rm = rm
+        self.client = client
+        self.config = config
+        self._update = threading.Event()
+        self._stop = threading.Event()
+        rm.on_health_change(self._update.set)
+
+    # --------------------------------------------------------------- servicer
+
+    def GetDevicePluginOptions(self, request, context):
+        return pb.DevicePluginOptions(
+            pre_start_required=False, get_preferred_allocation_available=True
+        )
+
+    def _device_list(self) -> pb.ListAndWatchResponse:
+        devices = []
+        for annotated_id, healthy, numa in self.rm.replica_ids():
+            devices.append(
+                pb.Device(
+                    ID=annotated_id,
+                    health="Healthy" if healthy else "Unhealthy",
+                    topology=pb.TopologyInfo(nodes=[pb.NUMANode(ID=numa)]),
+                )
+            )
+        return pb.ListAndWatchResponse(devices=devices)
+
+    def ListAndWatch(self, request, context):
+        """Initial device list, then a push on every health change (reference
+        ListAndWatch server.go:456-470)."""
+        yield self._device_list()
+        while not self._stop.is_set():
+            if self._update.wait(timeout=1.0):
+                self._update.clear()
+                yield self._device_list()
+
+    def GetPreferredAllocation(self, request, context):
+        """Prefer replicas on ICI-contiguous, least-shared chips (reference
+        distributedAlloc rm/allocate.go:43-96 + topology)."""
+        from vtpu.device.tpu.topology import select_subslice
+        from vtpu.device.types import DeviceUsage, IciCoord
+
+        responses = []
+        for creq in request.container_requests:
+            available = list(creq.available_deviceIDs)
+            must = list(creq.must_include_deviceIDs)
+            size = creq.allocation_size
+            # group replicas by chip; fewer free replicas = more shared
+            by_chip: dict[str, list[str]] = {}
+            for rid in available:
+                by_chip.setdefault(self.rm.chip_uuid_of(rid), []).append(rid)
+            usages = []
+            for uuid in by_chip:
+                chip = self.rm.chip_by_uuid(uuid)
+                if chip is None:
+                    continue
+                usages.append(
+                    DeviceUsage(
+                        id=uuid,
+                        used=self.rm.split_count - len(by_chip[uuid]),
+                        count=self.rm.split_count,
+                        totalmem=chip.devmem,
+                        totalcore=chip.devcore,
+                        ici=chip.ici or IciCoord(),
+                    )
+                )
+            picked: list[str] = must[:]
+            n_chips = min(max(1, size), len(usages)) if usages else 0
+            chosen = select_subslice(usages, n_chips) or []
+            for du in chosen:
+                for rid in by_chip[du.id]:
+                    if len(picked) < size and rid not in picked:
+                        picked.append(rid)
+            # pad from the remaining pool if chips < size replicas needed
+            for rid in available:
+                if len(picked) >= size:
+                    break
+                if rid not in picked:
+                    picked.append(rid)
+            responses.append(pb.ContainerPreferredAllocationResponse(deviceIDs=picked[:size]))
+        return pb.PreferredAllocationResponse(container_responses=responses)
+
+    def PreStartContainer(self, request, context):
+        return pb.PreStartContainerResponse()
+
+    # --------------------------------------------------------------- allocate
+
+    def Allocate(self, request, context):
+        node = self.config.node_name
+        pod = get_pending_pod(self.client, node)
+        if pod is None:
+            context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION,
+                f"no pod with bind-phase=allocating on node {node}",
+            )
+        try:
+            response = self._allocate_pending(pod, request)
+            pod_allocation_try_success(self.client, pod)
+            return response
+        except Exception as e:
+            log.exception("allocate failed for %s", pod["metadata"].get("name"))
+            try:
+                pod_allocation_failed(self.client, pod)
+            except ApiError:
+                log.exception("marking allocation failed")
+            context.abort(grpc.StatusCode.INTERNAL, f"allocate: {e}")
+        finally:
+            try:
+                nodelock.release_node_lock(self.client, node, pod)
+            except ApiError:
+                log.exception("release node lock after allocate")
+
+    def _allocate_pending(self, pod: dict, request) -> pb.AllocateResponse:
+        annos = pod_annotations(pod)
+        raw = annos.get(IN_REQUEST_ANNO, "")
+        if not raw:
+            raise RuntimeError(f"pod has no {IN_REQUEST_ANNO} annotation")
+        slots = codec.decode_pod_single_device(raw)
+        containers = pod.get("spec", {}).get("containers", [])
+        # non-empty slots pair up, in order, with kubelet's container_requests
+        pending = [(i, slot) for i, slot in enumerate(slots) if slot]
+        if len(request.container_requests) > len(pending):
+            raise RuntimeError(
+                f"kubelet asked for {len(request.container_requests)} containers "
+                f"but only {len(pending)} assignments remain"
+            )
+        responses = []
+        consumed: list[int] = []
+        for creq, (slot_idx, devices) in zip(request.container_requests, pending):
+            ctr_name = (
+                containers[slot_idx].get("name", f"ctr{slot_idx}")
+                if slot_idx < len(containers)
+                else f"ctr{slot_idx}"
+            )
+            responses.append(self._container_response(pod, ctr_name, devices))
+            consumed.append(slot_idx)
+        # consume the assignment (reference eraseNextDeviceTypeFromAnnotation
+        # plugin/util.go:96-122): drop used slots, keep the rest
+        remaining = [slot for i, slot in enumerate(slots) if i not in consumed]
+        self.client.patch_pod_annotations(
+            pod["metadata"].get("namespace", "default"),
+            pod["metadata"]["name"],
+            {
+                IN_REQUEST_ANNO: codec.encode_pod_single_device(remaining)
+                if any(remaining)
+                else None
+            },
+        )
+        return pb.AllocateResponse(container_responses=responses)
+
+    def _container_response(
+        self, pod: dict, ctr_name: str, devices: ContainerDevices
+    ) -> pb.ContainerAllocateResponse:
+        cfg = self.config
+        pod_uid = pod["metadata"].get("uid", "nouid")
+        region_dir = envs.shared_region_dir(cfg.hook_path, pod_uid, ctr_name)
+        os.makedirs(region_dir, exist_ok=True)
+
+        env: dict[str, str] = dict(cfg.extra_envs)
+        visible: list[str] = []
+        core_limit = 0
+        device_specs = []
+        for i, dev in enumerate(devices):
+            env[envs.ENV_DEVICE_MEMORY_LIMIT.format(index=i)] = f"{dev.usedmem}m"
+            core_limit = max(core_limit, dev.usedcores)
+            chip = self.rm.chip_by_uuid(dev.uuid)
+            if chip is not None:
+                visible.append(str(chip.index))
+                for path in chip.device_paths:
+                    device_specs.append(
+                        pb.DeviceSpec(container_path=path, host_path=path, permissions="rw")
+                    )
+        env[envs.ENV_CORE_LIMIT] = str(core_limit)
+        env[envs.ENV_VISIBLE_CHIPS] = ",".join(visible)
+        env[envs.ENV_SHARED_REGION] = f"{envs.CONTAINER_CACHE_DIR}/{pod_uid[:12]}.cache"
+        env[envs.ENV_CORE_POLICY] = cfg.core_policy
+        env[envs.ENV_LOG_LEVEL] = cfg.log_level
+        if cfg.oversubscribe:
+            env[envs.ENV_OVERSUBSCRIBE] = "true"
+        prio = pod_annotations(pod).get(t.TASK_PRIORITY_ANNO, "")
+        if prio:
+            env[envs.ENV_TASK_PRIORITY] = prio
+
+        mounts = [
+            pb.Mount(
+                container_path=envs.CONTAINER_LIB_PATH,
+                host_path=f"{cfg.hook_path}/{envs.LIBVTPU_SO}",
+                read_only=True,
+            ),
+            pb.Mount(
+                container_path=envs.CONTAINER_PRELOAD_PATH,
+                host_path=f"{cfg.hook_path}/{envs.LD_SO_PRELOAD}",
+                read_only=True,
+            ),
+            pb.Mount(
+                container_path=envs.CONTAINER_CACHE_DIR,
+                host_path=region_dir,
+                read_only=False,
+            ),
+        ]
+        return pb.ContainerAllocateResponse(envs=env, mounts=mounts, devices=device_specs)
+
+    # -------------------------------------------------------------- lifecycle
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+class PluginServer:
+    """Serves the plugin on a unix socket and registers with kubelet
+    (reference Serve/Register server.go:367-445)."""
+
+    def __init__(self, plugin: TpuDevicePlugin, socket_path: str):
+        self.plugin = plugin
+        self.socket_path = socket_path
+        self.server = grpc.server(futures.ThreadPoolExecutor(max_workers=8))
+        grpc_api.add_device_plugin_servicer(self.server, plugin)
+
+    def start(self) -> None:
+        if os.path.exists(self.socket_path):
+            os.unlink(self.socket_path)
+        self.server.add_insecure_port(f"unix://{self.socket_path}")
+        self.server.start()
+        log.info("device plugin serving on %s", self.socket_path)
+
+    def register_with_kubelet(self, kubelet_socket: str = grpc_api.KUBELET_SOCKET) -> None:
+        with grpc.insecure_channel(f"unix://{kubelet_socket}") as channel:
+            stub = grpc_api.RegistrationStub(channel)
+            stub.Register(
+                pb.RegisterRequest(
+                    version=grpc_api.API_VERSION,
+                    endpoint=os.path.basename(self.socket_path),
+                    resource_name=self.plugin.config.resource_name,
+                    options=pb.DevicePluginOptions(
+                        get_preferred_allocation_available=True
+                    ),
+                ),
+                timeout=10,
+            )
+        log.info("registered %s with kubelet", self.plugin.config.resource_name)
+
+    def stop(self, grace: float = 1.0) -> None:
+        self.plugin.stop()
+        self.server.stop(grace)
+        if os.path.exists(self.socket_path):
+            try:
+                os.unlink(self.socket_path)
+            except OSError:
+                pass

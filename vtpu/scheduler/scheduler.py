@@ -155,12 +155,22 @@ class Scheduler:
         with self._lock:
             for node in self.client.list_nodes():
                 name = node["metadata"]["name"]
+                annos = node.get("metadata", {}).get("annotations") or {}
                 for vendor, backend in DEVICES_MAP.items():
                     try:
                         healthy, _ = backend.check_health(node, self.client)
                         if not healthy:
-                            log.warning("node %s vendor %s unhealthy; withdrawing", name, vendor)
-                            backend.node_cleanup(name, self.client)
+                            already_withdrawn = (
+                                backend.register_annotation() not in annos
+                                and annos.get(backend.handshake_annotation(), "").startswith(
+                                    t.HANDSHAKE_DELETED
+                                )
+                            )
+                            if not already_withdrawn:
+                                log.warning(
+                                    "node %s vendor %s unhealthy; withdrawing", name, vendor
+                                )
+                                backend.node_cleanup(name, self.client)
                             self.node_manager.rm_node_devices(name, vendor)
                             continue
                         devices = backend.get_node_devices(node)
@@ -279,6 +289,11 @@ class Scheduler:
         }
         for backend in DEVICES_MAP.values():
             backend.patch_annotations(pod, patch, winner.devices)
+        # A Filter retry for a still-unbound pod must supersede, not stack on,
+        # the previous decision (else quota usage double-counts and leaks).
+        prev = self.pod_manager.take_and_delete_pod(pod["metadata"]["uid"])
+        if prev is not None:
+            self.quota_manager.rm_usage(pod, prev.devices)
         self.pod_manager.add_pod(pod, winner.node_name, winner.devices)
         self.quota_manager.add_usage(pod, winner.devices)
         try:

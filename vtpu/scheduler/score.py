@@ -25,9 +25,21 @@ log = logging.getLogger(__name__)
 ContainerRequests = dict[str, ContainerDeviceRequest]
 
 
+def _pad_slots(score: NodeScore, vendor: str, upto: int) -> list:
+    """Keep per-vendor slot lists aligned with container indexes: a vendor
+    first requested by container k still gets k leading empty slots, so the
+    devices-to-allocate annotation's positional encoding stays true to the
+    pod spec (the plugin consumes slots by container index)."""
+    slots = score.devices.setdefault(vendor, [])
+    while len(slots) < upto:
+        slots.append([])
+    return slots
+
+
 def fit_in_devices(
     score: NodeScore,
     requests: ContainerRequests,
+    ctr_index: int,
     pod: dict,
     node_info: NodeInfo,
     device_policy: str,
@@ -37,7 +49,7 @@ def fit_in_devices(
     fitInDevices score.go:52-99)."""
     for vendor, request in requests.items():
         if request.empty():
-            score.devices.setdefault(vendor, []).append([])
+            _pad_slots(score, vendor, ctr_index).append([])
             continue
         backend = DEVICES_MAP.get(vendor)
         if backend is None:
@@ -53,11 +65,10 @@ def fit_in_devices(
                     if dev.id == cd.uuid:
                         DEVICES_MAP[res_vendor].add_resource_usage(pod, dev, cd)
                         break
-            score.devices.setdefault(res_vendor, []).append(ctr_devices)
+            _pad_slots(score, res_vendor, ctr_index).append(ctr_devices)
     # vendors not requested by this container still need their slot recorded
     for vendor in score.devices:
-        if vendor not in requests:
-            score.devices[vendor].append([])
+        _pad_slots(score, vendor, ctr_index + 1)
     return True, ""
 
 
@@ -82,8 +93,8 @@ def calc_score(
         ns = NodeScore(node_name=node_name, snapshot=snapshot)
         ns.score = policy_mod.compute_default_node_score(snapshot)
         node_info = node_infos.get(node_name) or NodeInfo(node_name=node_name)
-        for requests in per_container_requests:
-            ok, reason = fit_in_devices(ns, requests, pod, node_info, device_policy)
+        for ctr_index, requests in enumerate(per_container_requests):
+            ok, reason = fit_in_devices(ns, requests, ctr_index, pod, node_info, device_policy)
             if not ok:
                 return None, reason
         # vendor ScoreNode overrides stack on the default (reference

@@ -87,8 +87,15 @@ class WebHook:
                 req = backend.generate_resource_requests(ctr)
                 if req.empty():
                     continue
+                # Percentage-based memory resolves to MiB only against a
+                # concrete chip; at admission we can check explicit mem, cores
+                # and count, and leave percentage asks to scheduler-side Fit.
                 if not self.quota_manager.fit_quota(
-                    ns, vendor, req.memreq * req.nums, req.coresreq * req.nums
+                    ns,
+                    vendor,
+                    req.memreq * req.nums,
+                    req.coresreq * req.nums,
+                    count=req.nums,
                 ):
                     return False
         return True
