@@ -1,0 +1,72 @@
+/* vtpu shared usage region: the mmap'ed contract between libvtpu (writer,
+ * inside every workload container) and the node monitor (reader + QoS
+ * feedback writer).
+ *
+ * Parity: reference HAMi shared region (pkg/monitor/nvidia/v1/spec.go:21-77 —
+ * magic, versioned header, per-device slots, per-process slots, priority,
+ * recentKernel, utilizationSwitch). Redesigned for TPU: byte-denominated HBM
+ * accounting, nanosecond kernel timestamps, fixed plain-C layout with no
+ * implicit padding so the Python monitor can mirror it with struct offsets.
+ *
+ * Concurrency: single-writer-per-process fields are updated with C11/C++11
+ * atomics on the raw integers; the monitor only does racy reads (metrics) and
+ * owns `recent_kernel` / `utilization_switch` writes (feedback loop).
+ */
+#ifndef VTPU_SHARED_REGION_H_
+#define VTPU_SHARED_REGION_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#define VTPU_REGION_MAGIC 0x56545055u /* "VTPU" */
+#define VTPU_REGION_VERSION 1u
+#define VTPU_MAX_DEVICES 16
+#define VTPU_MAX_PROCS 64
+#define VTPU_UUID_LEN 64
+
+typedef struct vtpu_device_slot {
+  char uuid[VTPU_UUID_LEN];
+  uint64_t hbm_limit_bytes;   /* 0 = unlimited */
+  uint64_t hbm_used_bytes;    /* live device-buffer bytes (atomic add/sub) */
+  uint64_t hbm_peak_bytes;
+  int32_t core_limit_percent; /* 0 or 100 = unthrottled */
+  int32_t core_util_percent;  /* recent duty-cycle estimate (writer-side) */
+  uint64_t last_kernel_ns;    /* CLOCK_REALTIME ns of last execute submit */
+  uint64_t kernel_count;      /* total execute submissions */
+  uint64_t throttle_wait_ns;  /* cumulative ns slept in the limiter */
+} vtpu_device_slot;
+
+typedef struct vtpu_proc_slot {
+  int32_t pid;
+  int32_t active;
+  uint64_t hbm_used_bytes[VTPU_MAX_DEVICES];
+} vtpu_proc_slot;
+
+typedef struct vtpu_shared_region {
+  uint32_t magic;
+  uint32_t version;
+  int32_t num_devices;
+  int32_t priority;            /* task priority: 0 low, 1 high */
+  int32_t recent_kernel;       /* monitor: >0 active credit, -1 = blocked */
+  int32_t utilization_switch;  /* monitor: 1 = enforce core limit, 0 = off */
+  uint64_t heartbeat_ns;       /* writer liveness */
+  uint64_t owner_init_ns;      /* region creation time */
+  vtpu_device_slot devices[VTPU_MAX_DEVICES];
+  int32_t num_procs;
+  int32_t _pad0;
+  vtpu_proc_slot procs[VTPU_MAX_PROCS];
+} vtpu_shared_region;
+
+#ifdef __cplusplus
+} /* extern "C" */
+
+static_assert(sizeof(vtpu_device_slot) == 64 + 8 * 3 + 4 * 2 + 8 * 3,
+              "vtpu_device_slot layout drifted");
+static_assert(sizeof(vtpu_proc_slot) == 8 + 8 * VTPU_MAX_DEVICES,
+              "vtpu_proc_slot layout drifted");
+#endif
+
+#endif /* VTPU_SHARED_REGION_H_ */

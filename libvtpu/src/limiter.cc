@@ -1,0 +1,82 @@
+#include "limiter.h"
+
+#include <time.h>
+
+#include <algorithm>
+
+namespace vtpu {
+
+static void sleep_ns(uint64_t ns) {
+  struct timespec ts;
+  ts.tv_sec = ns / 1000000000ull;
+  ts.tv_nsec = ns % 1000000000ull;
+  nanosleep(&ts, nullptr);
+}
+
+static uint64_t mono_now_ns() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return uint64_t(ts.tv_sec) * 1000000000ull + ts.tv_nsec;
+}
+
+void DutyCycleLimiter::refill(uint64_t now_ns) {
+  if (last_refill_ns_ == 0) {
+    last_refill_ns_ = now_ns;
+    tokens_ns_ = (int64_t)(window_ns_ * limit_percent_ / 100);  // initial burst
+    return;
+  }
+  if (now_ns <= last_refill_ns_) return;
+  uint64_t elapsed = now_ns - last_refill_ns_;
+  last_refill_ns_ = now_ns;
+  int64_t burst_cap = (int64_t)(window_ns_ * limit_percent_ / 100);
+  tokens_ns_ += (int64_t)(elapsed * limit_percent_ / 100);
+  tokens_ns_ = std::min(tokens_ns_, burst_cap);
+}
+
+uint64_t DutyCycleLimiter::admit(uint64_t now_ns) {
+  if (limit_percent_ <= 0 || limit_percent_ >= 100) return 0;
+  uint64_t waited = 0;
+  std::unique_lock<std::mutex> lock(mu_);
+  for (;;) {
+    refill(mono_now_ns());
+    if (tokens_ns_ >= (int64_t)est_ns_) {
+      tokens_ns_ -= (int64_t)est_ns_;  // pre-charge; settle() corrects later
+      return waited;
+    }
+    uint64_t deficit = (uint64_t)((int64_t)est_ns_ - tokens_ns_);
+    uint64_t delay = std::max<uint64_t>(
+        deficit * 100 / std::max(1, limit_percent_), 200'000ull);
+    delay = std::min(delay, window_ns_);
+    lock.unlock();
+    sleep_ns(delay);
+    lock.lock();
+    waited += delay;
+  }
+}
+
+void DutyCycleLimiter::settle(uint64_t busy_ns, uint64_t now_ns, bool precharged) {
+  std::lock_guard<std::mutex> lock(mu_);
+  if (precharged && limit_percent_ > 0 && limit_percent_ < 100) {
+    refill(mono_now_ns());
+    // Replace the pre-charged estimate with the observed cost.
+    tokens_ns_ += (int64_t)est_ns_;
+    tokens_ns_ -= (int64_t)busy_ns;
+  }
+  est_ns_ = (est_ns_ * 7 + busy_ns) / 8;  // EMA, 1/8 weight
+  // util reporting window
+  if (busy_epoch_ns_ == 0 || now_ns - busy_epoch_ns_ > 10 * window_ns_) {
+    busy_epoch_ns_ = now_ns;
+    busy_accum_ns_ = 0;
+  }
+  busy_accum_ns_ += busy_ns;
+}
+
+int DutyCycleLimiter::current_util_percent(uint64_t now_ns) {
+  std::lock_guard<std::mutex> lock(mu_);
+  if (busy_epoch_ns_ == 0 || now_ns <= busy_epoch_ns_) return 0;
+  uint64_t span = now_ns - busy_epoch_ns_;
+  uint64_t pct = busy_accum_ns_ * 100 / std::max<uint64_t>(span, 1);
+  return (int)std::min<uint64_t>(pct, 100);
+}
+
+}  // namespace vtpu

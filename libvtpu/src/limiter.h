@@ -1,0 +1,56 @@
+// TensorCore duty-cycle limiter: queue-level pacing of PJRT executions.
+//
+// TPUs have no SM-mask analog — the enforceable knob is WHEN work is enqueued.
+// Implemented as a busy-time token bucket: allowance accrues at limit% of wall
+// time (burst-capped at one window's budget); every execution pre-charges an
+// estimated busy time at submit and settles the difference when its completion
+// event fires (caller-requested events), or keeps the EMA estimate otherwise.
+// admit() sleeps until the allowance covers the next execution, which pins the
+// long-run duty cycle at the limit.
+// This is the TPU-first re-design of the reference's SM throttle
+// (HAMi-core CUDA_DEVICE_SM_LIMIT; SURVEY §2.4 "queue-level pacing").
+#ifndef VTPU_LIMITER_H_
+#define VTPU_LIMITER_H_
+
+#include <cstdint>
+#include <mutex>
+
+namespace vtpu {
+
+class DutyCycleLimiter {
+ public:
+  explicit DutyCycleLimiter(int limit_percent, uint64_t window_ns = 100'000'000ull)
+      : limit_percent_(limit_percent), window_ns_(window_ns) {}
+
+  // Block until the allowance covers the next execution, then pre-charge the
+  // current estimate. Returns the nanoseconds waited.
+  uint64_t admit(uint64_t now_ns);
+
+  // Settle a completed execution: when it was pre-charged by admit(), replace
+  // the estimate with the observed busy time; otherwise only update the EMA
+  // and util window (no token debt for unenforced submissions).
+  void settle(uint64_t busy_ns, uint64_t now_ns, bool precharged);
+
+  bool enforcing() const { return limit_percent_ > 0 && limit_percent_ < 100; }
+
+  int current_util_percent(uint64_t now_ns);
+
+  uint64_t estimate_ns() const { return est_ns_; }
+
+ private:
+  void refill(uint64_t now_ns);
+
+  int limit_percent_;
+  uint64_t window_ns_;
+  std::mutex mu_;
+  int64_t tokens_ns_ = 0;     // accrued busy allowance (may go negative)
+  uint64_t last_refill_ns_ = 0;
+  uint64_t est_ns_ = 1'000'000ull;  // 1ms initial per-execute estimate
+  // recent-busy tracking for util reporting
+  uint64_t busy_accum_ns_ = 0;
+  uint64_t busy_epoch_ns_ = 0;
+};
+
+}  // namespace vtpu
+
+#endif  // VTPU_LIMITER_H_

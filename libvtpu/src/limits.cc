@@ -1,0 +1,65 @@
+#include "limits.h"
+
+#include <cctype>
+#include <cstdlib>
+#include <cstring>
+
+#include "log.h"
+
+namespace vtpu {
+
+uint64_t parse_mem_value(const char* s) {
+  if (s == nullptr || *s == '\0') return 0;
+  char* end = nullptr;
+  unsigned long long v = std::strtoull(s, &end, 10);
+  if (end == s) return 0;
+  switch (std::tolower(end[0])) {
+    case 'k':
+      return v << 10;
+    case 'm':
+      return v << 20;
+    case 'g':
+      return v << 30;
+    case 't':
+      return v << 40;
+    case '\0':
+      return v;  // plain bytes
+    default:
+      VTPU_WARN("unknown memory suffix in %s; treating as bytes", s);
+      return v;
+  }
+}
+
+Limits parse_limits_from_env() {
+  Limits limits;
+  for (int i = 0; i < 64; i++) {
+    char key[64];
+    std::snprintf(key, sizeof(key), "TPU_DEVICE_MEMORY_LIMIT_%d", i);
+    const char* v = std::getenv(key);
+    if (v == nullptr) break;
+    limits.hbm_limit_bytes.push_back(parse_mem_value(v));
+  }
+  if (const char* v = std::getenv("TPU_CORE_LIMIT")) {
+    limits.core_limit_percent = std::atoi(v);
+    if (limits.core_limit_percent < 0) limits.core_limit_percent = 0;
+    if (limits.core_limit_percent > 100) limits.core_limit_percent = 100;
+  }
+  if (const char* v = std::getenv("VTPU_CORE_UTILIZATION_POLICY")) {
+    limits.core_policy = v;
+  }
+  if (const char* v = std::getenv("VTPU_OVERSUBSCRIBE")) {
+    limits.oversubscribe = (std::strcmp(v, "true") == 0 || std::strcmp(v, "1") == 0);
+  }
+  if (const char* v = std::getenv("VTPU_DISABLE_CONTROL")) {
+    limits.disable_control = (std::strcmp(v, "true") == 0 || std::strcmp(v, "1") == 0);
+  }
+  if (const char* v = std::getenv("VTPU_TASK_PRIORITY")) {
+    limits.task_priority = std::atoi(v);
+  }
+  if (const char* v = std::getenv("VTPU_SHARED_REGION")) {
+    limits.region_path = v;
+  }
+  return limits;
+}
+
+}  // namespace vtpu

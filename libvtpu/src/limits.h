@@ -1,0 +1,43 @@
+// Env-protocol parsing: the contract written by the device plugin's Allocate
+// (vtpu/plugin/envs.py; reference server.go:660-673).
+#ifndef VTPU_LIMITS_H_
+#define VTPU_LIMITS_H_
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+namespace vtpu {
+
+struct Limits {
+  // Per visible-chip HBM caps in bytes; index = visible device order. 0 = none.
+  std::vector<uint64_t> hbm_limit_bytes;
+  int core_limit_percent = 0;  // 0 or 100 = unthrottled
+  std::string core_policy = "default";  // default | force | disable
+  bool oversubscribe = false;  // warn instead of failing over-cap allocs
+  bool disable_control = false;
+  int task_priority = 0;
+  std::string region_path;  // VTPU_SHARED_REGION
+
+  bool mem_enforced() const { return !disable_control; }
+  bool core_enforced() const {
+    if (disable_control || core_policy == "disable") return false;
+    if (core_policy == "force") return core_limit_percent > 0;
+    return core_limit_percent > 0 && core_limit_percent < 100;
+  }
+  uint64_t limit_for(size_t device_index) const {
+    if (device_index < hbm_limit_bytes.size()) return hbm_limit_bytes[device_index];
+    // More visible devices than limits: reuse the last limit (all chips of a
+    // multi-chip assignment get the same per-chip cap).
+    return hbm_limit_bytes.empty() ? 0 : hbm_limit_bytes.back();
+  }
+};
+
+// Parse "4096m" / "2g" / "1048576k" / plain bytes.
+uint64_t parse_mem_value(const char* s);
+
+Limits parse_limits_from_env();
+
+}  // namespace vtpu
+
+#endif  // VTPU_LIMITS_H_

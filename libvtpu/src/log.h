@@ -1,0 +1,33 @@
+// Leveled stderr logging controlled by LIBVTPU_LOG_LEVEL (0 silent .. 4 trace).
+#ifndef VTPU_LOG_H_
+#define VTPU_LOG_H_
+
+#include <cstdio>
+#include <cstdlib>
+
+namespace vtpu {
+
+inline int log_level() {
+  static int level = [] {
+    const char* e = std::getenv("LIBVTPU_LOG_LEVEL");
+    return e ? std::atoi(e) : 1;
+  }();
+  return level;
+}
+
+}  // namespace vtpu
+
+#define VTPU_LOG(lvl, fmt, ...)                                       \
+  do {                                                                \
+    if (vtpu::log_level() >= (lvl)) {                                 \
+      std::fprintf(stderr, "[libvtpu] " fmt "\n", ##__VA_ARGS__);     \
+    }                                                                 \
+  } while (0)
+
+#define VTPU_ERR(fmt, ...) VTPU_LOG(1, "ERROR: " fmt, ##__VA_ARGS__)
+#define VTPU_WARN(fmt, ...) VTPU_LOG(1, "WARN: " fmt, ##__VA_ARGS__)
+#define VTPU_INFO(fmt, ...) VTPU_LOG(2, fmt, ##__VA_ARGS__)
+#define VTPU_DEBUG(fmt, ...) VTPU_LOG(3, fmt, ##__VA_ARGS__)
+#define VTPU_TRACE(fmt, ...) VTPU_LOG(4, fmt, ##__VA_ARGS__)
+
+#endif  // VTPU_LOG_H_

@@ -1,0 +1,144 @@
+#include "region.h"
+
+#include <errno.h>
+#include <fcntl.h>
+#include <signal.h>
+#include <sys/file.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstring>
+
+#include "log.h"
+
+namespace vtpu {
+
+uint64_t now_ns() {
+  struct timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  return uint64_t(ts.tv_sec) * 1000000000ull + ts.tv_nsec;
+}
+
+static std::atomic<uint64_t>* as_atomic(uint64_t* p) {
+  return reinterpret_cast<std::atomic<uint64_t>*>(p);
+}
+static std::atomic<int32_t>* as_atomic(int32_t* p) {
+  return reinterpret_cast<std::atomic<int32_t>*>(p);
+}
+
+Region* Region::open(const std::string& path, int priority) {
+  if (path.empty()) return nullptr;
+  int fd = ::open(path.c_str(), O_RDWR | O_CREAT, 0666);
+  if (fd < 0) {
+    VTPU_WARN("cannot open shared region %s: %s", path.c_str(), strerror(errno));
+    return nullptr;
+  }
+  // Serialize initialization between processes sharing the container.
+  flock(fd, LOCK_EX);
+  struct stat st;
+  fstat(fd, &st);
+  bool init = st.st_size < (off_t)sizeof(vtpu_shared_region);
+  if (init && ftruncate(fd, sizeof(vtpu_shared_region)) != 0) {
+    VTPU_WARN("ftruncate %s failed: %s", path.c_str(), strerror(errno));
+    flock(fd, LOCK_UN);
+    close(fd);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, sizeof(vtpu_shared_region), PROT_READ | PROT_WRITE,
+                   MAP_SHARED, fd, 0);
+  if (mem == MAP_FAILED) {
+    VTPU_WARN("mmap %s failed: %s", path.c_str(), strerror(errno));
+    flock(fd, LOCK_UN);
+    close(fd);
+    return nullptr;
+  }
+  auto* region = static_cast<vtpu_shared_region*>(mem);
+  auto* self = new Region();
+  self->region_ = region;
+  // Initialization and slot claiming happen under the file lock so two
+  // processes starting concurrently can't both memset or share a slot.
+  if (region->magic != VTPU_REGION_MAGIC) {
+    std::memset(region, 0, sizeof(*region));
+    region->magic = VTPU_REGION_MAGIC;
+    region->version = VTPU_REGION_VERSION;
+    region->recent_kernel = 0;
+    region->utilization_switch = 1;
+    region->owner_init_ns = now_ns();
+  }
+  if (priority > region->priority) region->priority = priority;
+  int32_t pid = (int32_t)getpid();
+  for (int i = 0; i < VTPU_MAX_PROCS; i++) {
+    auto& slot = region->procs[i];
+    bool dead = slot.active != 0 && slot.pid != pid && slot.pid > 0 &&
+                kill(slot.pid, 0) != 0 && errno == ESRCH;
+    if (slot.active == 0 || slot.pid == pid || dead) {
+      if (dead) std::memset(&slot, 0, sizeof(slot));  // reclaim dead pid's slot
+      slot.pid = pid;
+      slot.active = 1;
+      self->pid_slot_ = i;
+      if (i >= region->num_procs) region->num_procs = i + 1;
+      break;
+    }
+  }
+  region->heartbeat_ns = now_ns();
+  flock(fd, LOCK_UN);
+  close(fd);  // mapping persists
+  VTPU_INFO("shared region %s mapped (init=%d, proc slot %d)", path.c_str(),
+            (int)init, self->pid_slot_);
+  return self;
+}
+
+void Region::set_device(size_t index, const char* uuid, uint64_t hbm_limit_bytes,
+                        int core_limit_percent) {
+  if (!region_ || index >= VTPU_MAX_DEVICES) return;
+  auto& slot = region_->devices[index];
+  std::snprintf(slot.uuid, VTPU_UUID_LEN, "%s", uuid ? uuid : "");
+  slot.hbm_limit_bytes = hbm_limit_bytes;
+  slot.core_limit_percent = core_limit_percent;
+  if ((int32_t)index >= region_->num_devices) region_->num_devices = index + 1;
+}
+
+void Region::add_used(size_t index, int64_t delta) {
+  if (!region_ || index >= VTPU_MAX_DEVICES) return;
+  auto& slot = region_->devices[index];
+  uint64_t now = as_atomic(&slot.hbm_used_bytes)->fetch_add(delta) + delta;
+  uint64_t peak = slot.hbm_peak_bytes;
+  if (now > peak) slot.hbm_peak_bytes = now;
+  if (pid_slot_ >= 0) {
+    as_atomic(&region_->procs[pid_slot_].hbm_used_bytes[index])->fetch_add(delta);
+  }
+}
+
+void Region::record_kernel(size_t index, uint64_t wait_ns) {
+  if (!region_ || index >= VTPU_MAX_DEVICES) return;
+  auto& slot = region_->devices[index];
+  slot.last_kernel_ns = now_ns();
+  as_atomic(&slot.kernel_count)->fetch_add(1);
+  as_atomic(&slot.throttle_wait_ns)->fetch_add(wait_ns);
+  // consume one unit of monitor credit (priority scheme: monitor refills)
+  int32_t rk = region_->recent_kernel;
+  if (rk > 0) as_atomic(&region_->recent_kernel)->fetch_sub(1);
+  region_->heartbeat_ns = slot.last_kernel_ns;
+}
+
+void Region::set_core_util(size_t index, int percent) {
+  if (!region_ || index >= VTPU_MAX_DEVICES) return;
+  region_->devices[index].core_util_percent = percent;
+}
+
+void Region::heartbeat() {
+  if (region_) region_->heartbeat_ns = now_ns();
+}
+
+bool Region::blocked() const {
+  return region_ && region_->recent_kernel < 0 && region_->priority <= 0;
+}
+
+bool Region::utilization_enforced() const {
+  return !region_ || region_->utilization_switch != 0;
+}
+
+}  // namespace vtpu

@@ -1,0 +1,40 @@
+// Shared-region lifecycle: create/open the mmap'ed usage file and update it.
+#ifndef VTPU_REGION_H_
+#define VTPU_REGION_H_
+
+#include <cstdint>
+#include <string>
+
+#include "vtpu/shared_region.h"
+
+namespace vtpu {
+
+class Region {
+ public:
+  // mmap (creating + initializing if needed) the region at `path`.
+  // Returns nullptr region on failure (enforcement continues without it).
+  static Region* open(const std::string& path, int priority);
+
+  vtpu_shared_region* data() { return region_; }
+
+  void set_device(size_t index, const char* uuid, uint64_t hbm_limit_bytes,
+                  int core_limit_percent);
+  void add_used(size_t index, int64_t delta_bytes);
+  void record_kernel(size_t index, uint64_t wait_ns);
+  void set_core_util(size_t index, int percent);
+  void heartbeat();
+
+  // QoS gates written by the monitor.
+  bool blocked() const;             // low-priority kernels suspended
+  bool utilization_enforced() const;  // core limiting currently on
+
+ private:
+  vtpu_shared_region* region_ = nullptr;
+  int pid_slot_ = -1;
+};
+
+uint64_t now_ns();
+
+}  // namespace vtpu
+
+#endif  // VTPU_REGION_H_

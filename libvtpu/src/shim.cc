@@ -1,0 +1,626 @@
+// libvtpu: PJRT-level HBM-cap + core-duty-cycle enforcement for shared TPUs.
+//
+// The TPU-native re-design of the reference's HAMi-core CUDA intercept
+// (SURVEY §2.4): instead of hooking cuMemAlloc/NVML via LD_PRELOAD symbol
+// interposition, vtpu wraps the PJRT C API function table that every modern
+// TPU workload (JAX/XLA via libtpu) goes through:
+//
+//   - delivery A (LD_PRELOAD): interpose dlopen/dlsym; when anything resolves
+//     "GetPjrtApi" we hand out our wrapper (jax loads libtpu with
+//     dlopen+dlsym, so this catches unmodified workloads);
+//   - delivery B (plugin shadowing): libvtpu.so itself exports GetPjrtApi and
+//     loads the real plugin from $VTPU_REAL_LIBTPU — point TPU_LIBRARY_PATH
+//     at libvtpu.so and no preload is needed.
+//
+// Enforcement:
+//   - HBM cap: every BufferFromHostBuffer is size-estimated (dtype x dims)
+//     and rejected with a tagged RESOURCE_EXHAUSTED PJRT_Error once the
+//     per-device cap (TPU_DEVICE_MEMORY_LIMIT_<i>) would be exceeded;
+//     execute outputs are accounted from their real on-device sizes;
+//     Buffer_Destroy releases accounting.
+//   - Core percent: DutyCycleLimiter paces LoadedExecutable_Execute
+//     submissions (queue-level pacing; TPUs have no SM-mask analog).
+//   - QoS: priority gate + usage telemetry via the mmap'ed shared region the
+//     node monitor reads (vtpu/monitor).
+//
+// ABI safety: the PJRT_Api struct is append-only; every wrapped field offset
+// is bounds-checked against the runtime struct_size before being touched.
+
+#include <dlfcn.h>
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+#include "limits.h"
+#include "limiter.h"
+#include "log.h"
+#include "region.h"
+#include "pjrt_c_api.h"
+
+namespace vtpu {
+namespace {
+
+// ---------------------------------------------------------------- tagged errors
+
+struct VtpuError {
+  PJRT_Error_Code code;
+  std::string message;
+};
+
+std::mutex g_err_mu;
+std::unordered_set<void*> g_live_errors;
+
+PJRT_Error* make_error(PJRT_Error_Code code, std::string msg) {
+  auto* e = new VtpuError{code, std::move(msg)};
+  std::lock_guard<std::mutex> lock(g_err_mu);
+  g_live_errors.insert(e);
+  return reinterpret_cast<PJRT_Error*>(e);
+}
+
+VtpuError* as_vtpu_error(const PJRT_Error* err) {
+  void* p = const_cast<PJRT_Error*>(err);
+  std::lock_guard<std::mutex> lock(g_err_mu);
+  return g_live_errors.count(p) ? reinterpret_cast<VtpuError*>(p) : nullptr;
+}
+
+// ---------------------------------------------------------------- global state
+
+struct DeviceState {
+  uint64_t used_bytes = 0;
+  uint64_t limit_bytes = 0;
+  DutyCycleLimiter* limiter = nullptr;
+};
+
+struct State {
+  Limits limits;
+  Region* region = nullptr;
+  const PJRT_Api* real = nullptr;
+  PJRT_Api wrapped;
+  std::mutex mu;
+  std::vector<DeviceState> devices;
+  std::unordered_map<PJRT_Device*, size_t> device_index;
+  // buffer -> (device index, bytes)
+  std::unordered_map<PJRT_Buffer*, std::pair<size_t, uint64_t>> buffers;
+
+  DeviceState& dev(size_t i) {
+    if (i >= devices.size()) devices.resize(i + 1);
+    auto& d = devices[i];
+    if (d.limiter == nullptr) {
+      d.limit_bytes = limits.limit_for(i);
+      d.limiter = new DutyCycleLimiter(limits.core_limit_percent);
+    }
+    return d;
+  }
+};
+
+State& S() {
+  static State* s = [] {
+    auto* st = new State();
+    st->limits = parse_limits_from_env();
+    st->region = Region::open(st->limits.region_path, st->limits.task_priority);
+    if (st->region) {
+      for (size_t i = 0; i < st->limits.hbm_limit_bytes.size(); i++) {
+        char name[32];
+        std::snprintf(name, sizeof(name), "device-%zu", i);
+        st->region->set_device(i, name, st->limits.hbm_limit_bytes[i],
+                               st->limits.core_limit_percent);
+      }
+    }
+    VTPU_INFO("init: %zu HBM limits, core=%d%%, policy=%s, region=%s",
+              st->limits.hbm_limit_bytes.size(), st->limits.core_limit_percent,
+              st->limits.core_policy.c_str(),
+              st->limits.region_path.empty() ? "<none>" : st->limits.region_path.c_str());
+    return st;
+  }();
+  return *s;
+}
+
+uint64_t dtype_bits(PJRT_Buffer_Type t) {
+  switch (t) {
+    case PJRT_Buffer_Type_PRED:
+    case PJRT_Buffer_Type_S8:
+    case PJRT_Buffer_Type_U8:
+    case PJRT_Buffer_Type_F8E5M2:
+    case PJRT_Buffer_Type_F8E4M3FN:
+    case PJRT_Buffer_Type_F8E4M3B11FNUZ:
+    case PJRT_Buffer_Type_F8E5M2FNUZ:
+    case PJRT_Buffer_Type_F8E4M3FNUZ:
+      return 8;
+    case PJRT_Buffer_Type_S16:
+    case PJRT_Buffer_Type_U16:
+    case PJRT_Buffer_Type_F16:
+    case PJRT_Buffer_Type_BF16:
+      return 16;
+    case PJRT_Buffer_Type_S32:
+    case PJRT_Buffer_Type_U32:
+    case PJRT_Buffer_Type_F32:
+      return 32;
+    case PJRT_Buffer_Type_S64:
+    case PJRT_Buffer_Type_U64:
+    case PJRT_Buffer_Type_F64:
+    case PJRT_Buffer_Type_C64:
+      return 64;
+    case PJRT_Buffer_Type_C128:
+      return 128;
+    case PJRT_Buffer_Type_S4:
+    case PJRT_Buffer_Type_U4:
+      return 4;
+    default:
+      return 32;
+  }
+}
+
+uint64_t estimate_bytes(PJRT_Buffer_Type type, const int64_t* dims, size_t n) {
+  uint64_t elems = 1;
+  for (size_t i = 0; i < n; i++) elems *= (dims[i] > 0 ? (uint64_t)dims[i] : 1);
+  uint64_t bits = elems * dtype_bits(type);
+  return (bits + 7) / 8;
+}
+
+size_t device_index_of(PJRT_Device* device) {
+  auto& s = S();
+  std::lock_guard<std::mutex> lock(s.mu);
+  auto it = s.device_index.find(device);
+  if (it != s.device_index.end()) return it->second;
+  size_t idx = s.device_index.size();
+  s.device_index.emplace(device, idx);
+  return idx;
+}
+
+void refresh_device_map(PJRT_Client* client) {
+  // Stable device indexes: position in the client's addressable-device list
+  // maps 1:1 to TPU_DEVICE_MEMORY_LIMIT_<i> order.
+  auto& s = S();
+  if (s.real == nullptr || s.real->PJRT_Client_AddressableDevices == nullptr) return;
+  PJRT_Client_AddressableDevices_Args args;
+  std::memset(&args, 0, sizeof(args));
+  args.struct_size = PJRT_Client_AddressableDevices_Args_STRUCT_SIZE;
+  args.client = client;
+  PJRT_Error* err = s.real->PJRT_Client_AddressableDevices(&args);
+  if (err != nullptr) {
+    PJRT_Error_Destroy_Args d{PJRT_Error_Destroy_Args_STRUCT_SIZE, nullptr, err};
+    s.real->PJRT_Error_Destroy(&d);
+    return;
+  }
+  std::lock_guard<std::mutex> lock(s.mu);
+  for (size_t i = 0; i < args.num_addressable_devices; i++) {
+    s.device_index[args.addressable_devices[i]] = i;
+  }
+  VTPU_INFO("mapped %zu addressable devices", args.num_addressable_devices);
+}
+
+uint64_t buffer_device_size(PJRT_Buffer* buffer) {
+  auto& s = S();
+  if (s.real->PJRT_Buffer_OnDeviceSizeInBytes == nullptr) return 0;
+  PJRT_Buffer_OnDeviceSizeInBytes_Args args;
+  std::memset(&args, 0, sizeof(args));
+  args.struct_size = PJRT_Buffer_OnDeviceSizeInBytes_Args_STRUCT_SIZE;
+  args.buffer = buffer;
+  PJRT_Error* err = s.real->PJRT_Buffer_OnDeviceSizeInBytes(&args);
+  if (err != nullptr) {
+    PJRT_Error_Destroy_Args d{PJRT_Error_Destroy_Args_STRUCT_SIZE, nullptr, err};
+    s.real->PJRT_Error_Destroy(&d);
+    return 0;
+  }
+  return args.on_device_size_in_bytes;
+}
+
+std::mutex g_numout_mu;
+std::unordered_map<PJRT_LoadedExecutable*, size_t> g_numout_cache;
+
+size_t executable_num_outputs(PJRT_LoadedExecutable* loaded) {
+  auto& s = S();
+  {
+    // Hot path: one lookup instead of three PJRT round-trips per execute.
+    std::lock_guard<std::mutex> lock(g_numout_mu);
+    auto it = g_numout_cache.find(loaded);
+    if (it != g_numout_cache.end()) return it->second;
+  }
+  if (s.real->PJRT_LoadedExecutable_GetExecutable == nullptr ||
+      s.real->PJRT_Executable_NumOutputs == nullptr) {
+    return 0;
+  }
+  PJRT_LoadedExecutable_GetExecutable_Args ge;
+  std::memset(&ge, 0, sizeof(ge));
+  ge.struct_size = PJRT_LoadedExecutable_GetExecutable_Args_STRUCT_SIZE;
+  ge.loaded_executable = loaded;
+  if (PJRT_Error* err = s.real->PJRT_LoadedExecutable_GetExecutable(&ge)) {
+    PJRT_Error_Destroy_Args d{PJRT_Error_Destroy_Args_STRUCT_SIZE, nullptr, err};
+    s.real->PJRT_Error_Destroy(&d);
+    return 0;
+  }
+  PJRT_Executable_NumOutputs_Args no;
+  std::memset(&no, 0, sizeof(no));
+  no.struct_size = PJRT_Executable_NumOutputs_Args_STRUCT_SIZE;
+  no.executable = ge.executable;
+  size_t n = 0;
+  if (PJRT_Error* err = s.real->PJRT_Executable_NumOutputs(&no)) {
+    PJRT_Error_Destroy_Args d{PJRT_Error_Destroy_Args_STRUCT_SIZE, nullptr, err};
+    s.real->PJRT_Error_Destroy(&d);
+  } else {
+    n = no.num_outputs;
+  }
+  if (s.real->PJRT_Executable_Destroy != nullptr && ge.executable != nullptr) {
+    PJRT_Executable_Destroy_Args ed;
+    std::memset(&ed, 0, sizeof(ed));
+    ed.struct_size = PJRT_Executable_Destroy_Args_STRUCT_SIZE;
+    ed.executable = ge.executable;
+    if (PJRT_Error* err = s.real->PJRT_Executable_Destroy(&ed)) {
+      PJRT_Error_Destroy_Args d{PJRT_Error_Destroy_Args_STRUCT_SIZE, nullptr, err};
+      s.real->PJRT_Error_Destroy(&d);
+    }
+  }
+  {
+    std::lock_guard<std::mutex> lock(g_numout_mu);
+    g_numout_cache[loaded] = n;
+  }
+  return n;
+}
+
+void account_alloc(PJRT_Buffer* buffer, size_t dev_idx, uint64_t bytes) {
+  auto& s = S();
+  {
+    std::lock_guard<std::mutex> lock(s.mu);
+    s.dev(dev_idx).used_bytes += bytes;
+    s.buffers[buffer] = {dev_idx, bytes};
+  }
+  if (s.region) s.region->add_used(dev_idx, (int64_t)bytes);
+  VTPU_TRACE("alloc dev%zu %lu bytes (used=%lu)", dev_idx, (unsigned long)bytes,
+             (unsigned long)s.devices[dev_idx].used_bytes);
+}
+
+// ---------------------------------------------------------------- wrappers
+
+void wrapped_error_destroy(PJRT_Error_Destroy_Args* args) {
+  if (auto* e = as_vtpu_error(args->error)) {
+    {
+      std::lock_guard<std::mutex> lock(g_err_mu);
+      g_live_errors.erase(args->error);
+    }
+    delete e;
+    return;
+  }
+  S().real->PJRT_Error_Destroy(args);
+}
+
+void wrapped_error_message(PJRT_Error_Message_Args* args) {
+  if (auto* e = as_vtpu_error(args->error)) {
+    args->message = e->message.c_str();
+    args->message_size = e->message.size();
+    return;
+  }
+  S().real->PJRT_Error_Message(args);
+}
+
+PJRT_Error* wrapped_error_getcode(PJRT_Error_GetCode_Args* args) {
+  if (auto* e = as_vtpu_error(args->error)) {
+    args->code = e->code;
+    return nullptr;
+  }
+  return S().real->PJRT_Error_GetCode(args);
+}
+
+PJRT_Error* wrapped_client_create(PJRT_Client_Create_Args* args) {
+  auto& s = S();
+  PJRT_Error* err = s.real->PJRT_Client_Create(args);
+  if (err == nullptr && args->client != nullptr) {
+    refresh_device_map(args->client);
+  }
+  return err;
+}
+
+PJRT_Error* wrapped_buffer_from_host(PJRT_Client_BufferFromHostBuffer_Args* args) {
+  auto& s = S();
+  size_t dev_idx = args->device ? device_index_of(args->device) : 0;
+  uint64_t est = estimate_bytes(args->type, args->dims, args->num_dims);
+  bool reserved = false;
+  if (s.limits.mem_enforced()) {
+    // Reserve under the lock BEFORE the real allocation so two racing
+    // threads can't both pass the check and jointly blow the cap.
+    std::unique_lock<std::mutex> lock(s.mu);
+    auto& dev = s.dev(dev_idx);
+    if (dev.limit_bytes > 0 && dev.used_bytes + est > dev.limit_bytes) {
+      uint64_t used = dev.used_bytes, limit = dev.limit_bytes;
+      lock.unlock();
+      if (!s.limits.oversubscribe) {
+        char msg[256];
+        std::snprintf(msg, sizeof(msg),
+                      "vtpu: HBM limit exceeded on device %zu: "
+                      "used %lu + request %lu > limit %lu bytes "
+                      "(TPU_DEVICE_MEMORY_LIMIT_%zu)",
+                      dev_idx, (unsigned long)used, (unsigned long)est,
+                      (unsigned long)limit, dev_idx);
+        VTPU_WARN("%s", msg);
+        return make_error(PJRT_Error_Code_RESOURCE_EXHAUSTED, msg);
+      }
+      VTPU_WARN("oversubscribe: dev%zu exceeding cap (used=%lu est=%lu limit=%lu)",
+                dev_idx, (unsigned long)used, (unsigned long)est,
+                (unsigned long)limit);
+    } else {
+      dev.used_bytes += est;
+      reserved = true;
+    }
+  }
+  PJRT_Error* err = s.real->PJRT_Client_BufferFromHostBuffer(args);
+  if (err != nullptr || args->buffer == nullptr) {
+    if (reserved) {
+      std::lock_guard<std::mutex> lock(s.mu);
+      auto& dev = s.dev(dev_idx);
+      dev.used_bytes = dev.used_bytes >= est ? dev.used_bytes - est : 0;
+    }
+    return err;
+  }
+  uint64_t real_size = buffer_device_size(args->buffer);
+  uint64_t bytes = real_size ? real_size : est;
+  {
+    std::lock_guard<std::mutex> lock(s.mu);
+    auto& dev = s.dev(dev_idx);
+    if (reserved) {
+      // settle the reservation against the real on-device size
+      dev.used_bytes = dev.used_bytes >= est ? dev.used_bytes - est : 0;
+    }
+    dev.used_bytes += bytes;
+    s.buffers[args->buffer] = {dev_idx, bytes};
+  }
+  if (s.region) s.region->add_used(dev_idx, (int64_t)bytes);
+  return nullptr;
+}
+
+PJRT_Error* wrapped_buffer_destroy(PJRT_Buffer_Destroy_Args* args) {
+  auto& s = S();
+  size_t dev_idx = 0;
+  uint64_t bytes = 0;
+  {
+    std::lock_guard<std::mutex> lock(s.mu);
+    auto it = s.buffers.find(args->buffer);
+    if (it != s.buffers.end()) {
+      dev_idx = it->second.first;
+      bytes = it->second.second;
+      s.buffers.erase(it);
+      auto& dev = s.dev(dev_idx);
+      dev.used_bytes = dev.used_bytes >= bytes ? dev.used_bytes - bytes : 0;
+    }
+  }
+  if (bytes && s.region) s.region->add_used(dev_idx, -(int64_t)bytes);
+  return s.real->PJRT_Buffer_Destroy(args);
+}
+
+struct ExecDoneCtx {
+  size_t dev_idx;
+  uint64_t submit_ns;
+  bool precharged;
+};
+
+void exec_done_cb(PJRT_Error* error, void* user_arg) {
+  auto* ctx = static_cast<ExecDoneCtx*>(user_arg);
+  auto& s = S();
+  uint64_t now = now_ns();
+  uint64_t busy = now > ctx->submit_ns ? now - ctx->submit_ns : 0;
+  {
+    std::lock_guard<std::mutex> lock(s.mu);
+    s.dev(ctx->dev_idx).limiter->settle(busy, now, ctx->precharged);
+  }
+  if (s.region) {
+    std::lock_guard<std::mutex> lock(s.mu);
+    s.region->set_core_util(
+        ctx->dev_idx, s.dev(ctx->dev_idx).limiter->current_util_percent(now));
+  }
+  if (error != nullptr) {
+    PJRT_Error_Destroy_Args d{PJRT_Error_Destroy_Args_STRUCT_SIZE, nullptr, error};
+    s.real->PJRT_Error_Destroy(&d);
+  }
+  delete ctx;
+}
+
+PJRT_Error* wrapped_execute(PJRT_LoadedExecutable_Execute_Args* args) {
+  auto& s = S();
+  size_t dev_idx =
+      args->execute_device ? device_index_of(args->execute_device) : 0;
+
+  // Priority gate: the monitor suspends low-priority work by writing
+  // recent_kernel = -1 (reference feedback.go:104-134 semantics).
+  if (s.region != nullptr) {
+    int spins = 0;
+    while (s.region->blocked() && spins < 10000) {
+      struct timespec ts{0, 1000000};  // 1ms
+      nanosleep(&ts, nullptr);
+      spins++;
+    }
+  }
+
+  uint64_t waited = 0;
+  bool enforce = s.limits.core_enforced() &&
+                 (s.region == nullptr || s.region->utilization_enforced());
+  DutyCycleLimiter* limiter;
+  {
+    std::lock_guard<std::mutex> lock(s.mu);
+    limiter = s.dev(dev_idx).limiter;
+  }
+  bool precharged = false;
+  if (enforce) {
+    waited = limiter->admit(now_ns());
+    precharged = limiter->enforcing();
+  }
+
+  uint64_t submit_ns = now_ns();
+  PJRT_Error* err = s.real->PJRT_LoadedExecutable_Execute(args);
+  if (s.region) s.region->record_kernel(dev_idx, waited);
+  if (err != nullptr) return err;
+
+  // Busy-time feedback: ride the caller's device_complete_events when
+  // requested; otherwise charge the EMA estimate.
+  bool hooked = false;
+  if (args->device_complete_events != nullptr && args->num_devices >= 1 &&
+      args->device_complete_events[0] != nullptr &&
+      s.real->PJRT_Event_OnReady != nullptr) {
+    auto* ctx = new ExecDoneCtx{dev_idx, submit_ns, precharged};
+    PJRT_Event_OnReady_Args on;
+    std::memset(&on, 0, sizeof(on));
+    on.struct_size = PJRT_Event_OnReady_Args_STRUCT_SIZE;
+    on.event = args->device_complete_events[0];
+    on.callback = exec_done_cb;
+    on.user_arg = ctx;
+    PJRT_Error* oerr = s.real->PJRT_Event_OnReady(&on);
+    if (oerr == nullptr) {
+      hooked = true;
+    } else {
+      delete ctx;
+      PJRT_Error_Destroy_Args d{PJRT_Error_Destroy_Args_STRUCT_SIZE, nullptr, oerr};
+      s.real->PJRT_Error_Destroy(&d);
+    }
+  }
+  if (!hooked) {
+    // No completion signal: the pre-charged estimate stands as the cost.
+    limiter->settle(limiter->estimate_ns(), submit_ns, precharged);
+  }
+
+  // Account execute outputs so the cap covers results, not just host uploads.
+  if (args->output_lists != nullptr) {
+    size_t num_outputs = executable_num_outputs(args->executable);
+    for (size_t d = 0; d < args->num_devices; d++) {
+      PJRT_Buffer** outs = args->output_lists[d];
+      if (outs == nullptr) continue;
+      // Multi-device launches (execute_device == null) place row d's outputs
+      // on addressable device d; a pinned launch puts them on dev_idx.
+      size_t out_dev = args->execute_device ? dev_idx : d;
+      for (size_t o = 0; o < num_outputs; o++) {
+        PJRT_Buffer* buf = outs[o];
+        if (buf == nullptr) continue;
+        account_alloc(buf, out_dev, buffer_device_size(buf));
+      }
+    }
+  }
+  return nullptr;
+}
+
+// ---------------------------------------------------------------- api table
+
+template <typename F>
+void replace_field(F** slot, const PJRT_Api* real, F* replacement) {
+  // Only wrap fields that exist within the runtime struct_size.
+  auto offset = reinterpret_cast<const char*>(slot) -
+                reinterpret_cast<const char*>(&S().wrapped);
+  if (offset + (ptrdiff_t)sizeof(void*) <= (ptrdiff_t)real->struct_size) {
+    *slot = replacement;
+  }
+}
+
+const PJRT_Api* wrap_api(const PJRT_Api* real) {
+  auto& s = S();
+  if (s.real == real) return &s.wrapped;
+  s.real = real;
+  std::memset(&s.wrapped, 0, sizeof(s.wrapped));
+  std::memcpy(&s.wrapped, real,
+              real->struct_size < sizeof(s.wrapped) ? real->struct_size
+                                                    : sizeof(s.wrapped));
+  s.wrapped.struct_size = real->struct_size < sizeof(s.wrapped)
+                              ? real->struct_size
+                              : sizeof(s.wrapped);
+  replace_field(&s.wrapped.PJRT_Error_Destroy, real, wrapped_error_destroy);
+  replace_field(&s.wrapped.PJRT_Error_Message, real, wrapped_error_message);
+  replace_field(&s.wrapped.PJRT_Error_GetCode, real, wrapped_error_getcode);
+  replace_field(&s.wrapped.PJRT_Client_Create, real, wrapped_client_create);
+  replace_field(&s.wrapped.PJRT_Client_BufferFromHostBuffer, real,
+                wrapped_buffer_from_host);
+  replace_field(&s.wrapped.PJRT_Buffer_Destroy, real, wrapped_buffer_destroy);
+  replace_field(&s.wrapped.PJRT_LoadedExecutable_Execute, real, wrapped_execute);
+  VTPU_INFO("wrapped PJRT api (struct_size=%zu, version %d.%d)",
+            real->struct_size, real->pjrt_api_version.major_version,
+            real->pjrt_api_version.minor_version);
+  return &s.wrapped;
+}
+
+}  // namespace
+}  // namespace vtpu
+
+// ------------------------------------------------------------------ exports
+
+extern "C" {
+
+typedef const PJRT_Api* (*GetPjrtApiFn)();
+
+// Delivery B: libvtpu.so IS the PJRT plugin; real one comes from
+// VTPU_REAL_LIBTPU (default /lib/libtpu.so, the TPU VM location).
+const PJRT_Api* GetPjrtApi() {
+  static const PJRT_Api* api = []() -> const PJRT_Api* {
+    const char* path = std::getenv("VTPU_REAL_LIBTPU");
+    if (path == nullptr) path = "/lib/libtpu.so";
+    void* handle = dlopen(path, RTLD_NOW | RTLD_LOCAL);
+    if (handle == nullptr) {
+      VTPU_ERR("cannot dlopen real plugin %s: %s", path, dlerror());
+      return nullptr;
+    }
+    auto fn = (GetPjrtApiFn)dlsym(handle, "GetPjrtApi");
+    if (fn == nullptr) {
+      VTPU_ERR("no GetPjrtApi in %s", path);
+      return nullptr;
+    }
+    return vtpu::wrap_api(fn());
+  }();
+  return api;
+}
+
+// Test/introspection hooks (also used by the Python ctypes tests).
+uint64_t vtpu_device_used_bytes(size_t idx) {
+  auto& s = vtpu::S();
+  std::lock_guard<std::mutex> lock(s.mu);
+  return idx < s.devices.size() ? s.devices[idx].used_bytes : 0;
+}
+uint64_t vtpu_device_limit_bytes(size_t idx) {
+  return vtpu::S().limits.limit_for(idx);
+}
+const PJRT_Api* vtpu_wrap_api_for_test(const PJRT_Api* real) {
+  return vtpu::wrap_api(real);
+}
+
+// Delivery A: dlsym interposition. Any GetPjrtApi resolution in the process
+// returns a trampoline that wraps the real table.
+static const PJRT_Api* trampoline_get_pjrt_api();
+static GetPjrtApiFn g_real_get_pjrt_api = nullptr;
+
+static const PJRT_Api* trampoline_get_pjrt_api() {
+  if (g_real_get_pjrt_api == nullptr) return nullptr;
+  return vtpu::wrap_api(g_real_get_pjrt_api());
+}
+
+typedef void* (*DlsymFn)(void*, const char*);
+
+static DlsymFn real_dlsym_resolver() {
+  static DlsymFn real = []() -> DlsymFn {
+    // dlvsym is itself safe to call; glibc symbol versions vary by arch.
+    for (const char* ver :
+         {"GLIBC_2.2.5", "GLIBC_2.17", "GLIBC_2.27", "GLIBC_2.34",
+          "GLIBC_2.4", "GLIBC_2.0"}) {
+      if (void* p = dlvsym(RTLD_NEXT, "dlsym", ver)) return (DlsymFn)p;
+    }
+    // Silently breaking every dlsym in the process would be far worse than
+    // crashing loudly: bail with an actionable message (use the plugin-
+    // shadowing delivery instead of LD_PRELOAD on this libc).
+    std::fprintf(stderr,
+                 "[libvtpu] FATAL: cannot resolve the real dlsym on this libc; "
+                 "remove libvtpu from LD_PRELOAD and use TPU_LIBRARY_PATH="
+                 "libvtpu.so with VTPU_REAL_LIBTPU instead\n");
+    std::abort();
+  }();
+  return real;
+}
+
+void* dlsym(void* handle, const char* name) {
+  DlsymFn real = real_dlsym_resolver();
+  void* sym = real(handle, name);
+  if (name != nullptr && std::strcmp(name, "GetPjrtApi") == 0 && sym != nullptr) {
+    // Do not re-wrap our own export (delivery B handles itself).
+    if (sym == (void*)&GetPjrtApi) return sym;
+    g_real_get_pjrt_api = (GetPjrtApiFn)sym;
+    VTPU_INFO("intercepted GetPjrtApi resolution");
+    return (void*)&trampoline_get_pjrt_api;
+  }
+  return sym;
+}
+
+}  // extern "C"

@@ -1,0 +1,227 @@
+// Minimal fake PJRT plugin: just enough of the C API for libvtpu's tests to
+// drive allocation, destruction, and execution through the shim without TPU
+// hardware (the reference's rm_mock.go idea at the PJRT layer).
+//
+// Behavior knobs (env):
+//   FAKE_PJRT_EXEC_NS      simulated device-busy ns per execute (default 2ms)
+//   FAKE_PJRT_NUM_OUTPUTS  outputs per execute (default 1, 1KiB each)
+
+#include <string.h>
+#include <time.h>
+
+#include <atomic>
+#include <cstdint>
+#include <cstdlib>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "pjrt_c_api.h"
+
+namespace {
+
+struct FakeError {
+  PJRT_Error_Code code;
+  std::string message;
+};
+
+struct FakeBuffer {
+  uint64_t size;
+};
+
+struct FakeEvent {
+  uint64_t ready_ns;  // monotonic deadline
+};
+
+struct FakeDevice {
+  int id;
+};
+
+FakeDevice g_devices[2] = {{0}, {1}};
+PJRT_Device* g_device_ptrs[2] = {
+    reinterpret_cast<PJRT_Device*>(&g_devices[0]),
+    reinterpret_cast<PJRT_Device*>(&g_devices[1]),
+};
+
+uint64_t mono_ns() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return uint64_t(ts.tv_sec) * 1000000000ull + ts.tv_nsec;
+}
+
+uint64_t exec_ns() {
+  const char* e = std::getenv("FAKE_PJRT_EXEC_NS");
+  return e ? std::strtoull(e, nullptr, 10) : 2'000'000ull;
+}
+
+size_t num_outputs() {
+  const char* e = std::getenv("FAKE_PJRT_NUM_OUTPUTS");
+  return e ? std::strtoull(e, nullptr, 10) : 1;
+}
+
+[[maybe_unused]] static PJRT_Error* err(PJRT_Error_Code code, std::string msg) {
+  return reinterpret_cast<PJRT_Error*>(new FakeError{code, std::move(msg)});
+}
+
+// ------------------------------------------------------------- error fns
+
+void ErrorDestroy(PJRT_Error_Destroy_Args* args) {
+  delete reinterpret_cast<const FakeError*>(args->error);
+}
+void ErrorMessage(PJRT_Error_Message_Args* args) {
+  auto* e = reinterpret_cast<const FakeError*>(args->error);
+  args->message = e->message.c_str();
+  args->message_size = e->message.size();
+}
+PJRT_Error* ErrorGetCode(PJRT_Error_GetCode_Args* args) {
+  args->code = reinterpret_cast<const FakeError*>(args->error)->code;
+  return nullptr;
+}
+
+// ------------------------------------------------------------- client fns
+
+PJRT_Error* ClientCreate(PJRT_Client_Create_Args* args) {
+  args->client = reinterpret_cast<PJRT_Client*>(new int(42));
+  return nullptr;
+}
+PJRT_Error* ClientDestroy(PJRT_Client_Destroy_Args* args) {
+  delete reinterpret_cast<int*>(args->client);
+  return nullptr;
+}
+PJRT_Error* ClientAddressableDevices(PJRT_Client_AddressableDevices_Args* args) {
+  args->addressable_devices = g_device_ptrs;
+  args->num_addressable_devices = 2;
+  return nullptr;
+}
+
+// ------------------------------------------------------------- buffer fns
+
+uint64_t dtype_bytes(PJRT_Buffer_Type t) {
+  switch (t) {
+    case PJRT_Buffer_Type_F32:
+    case PJRT_Buffer_Type_S32:
+      return 4;
+    case PJRT_Buffer_Type_BF16:
+    case PJRT_Buffer_Type_F16:
+      return 2;
+    case PJRT_Buffer_Type_F64:
+    case PJRT_Buffer_Type_S64:
+      return 8;
+    default:
+      return 1;
+  }
+}
+
+PJRT_Error* BufferFromHostBuffer(PJRT_Client_BufferFromHostBuffer_Args* args) {
+  uint64_t n = 1;
+  for (size_t i = 0; i < args->num_dims; i++) n *= args->dims[i];
+  auto* buf = new FakeBuffer{n * dtype_bytes(args->type)};
+  args->buffer = reinterpret_cast<PJRT_Buffer*>(buf);
+  args->done_with_host_buffer = nullptr;
+  return nullptr;
+}
+PJRT_Error* BufferDestroy(PJRT_Buffer_Destroy_Args* args) {
+  delete reinterpret_cast<FakeBuffer*>(args->buffer);
+  return nullptr;
+}
+PJRT_Error* BufferOnDeviceSize(PJRT_Buffer_OnDeviceSizeInBytes_Args* args) {
+  args->on_device_size_in_bytes =
+      reinterpret_cast<FakeBuffer*>(args->buffer)->size;
+  return nullptr;
+}
+
+// ------------------------------------------------------------- event fns
+
+PJRT_Error* EventDestroy(PJRT_Event_Destroy_Args* args) {
+  delete reinterpret_cast<FakeEvent*>(args->event);
+  return nullptr;
+}
+PJRT_Error* EventOnReady(PJRT_Event_OnReady_Args* args) {
+  auto* ev = reinterpret_cast<FakeEvent*>(args->event);
+  auto cb = args->callback;
+  void* user = args->user_arg;
+  uint64_t deadline = ev->ready_ns;
+  std::thread([cb, user, deadline] {
+    uint64_t now = mono_ns();
+    if (deadline > now) {
+      struct timespec ts;
+      uint64_t wait = deadline - now;
+      ts.tv_sec = wait / 1000000000ull;
+      ts.tv_nsec = wait % 1000000000ull;
+      nanosleep(&ts, nullptr);
+    }
+    cb(nullptr, user);
+  }).detach();
+  return nullptr;
+}
+
+// ------------------------------------------------------------- executable fns
+
+PJRT_Error* LoadedGetExecutable(PJRT_LoadedExecutable_GetExecutable_Args* args) {
+  args->executable = reinterpret_cast<PJRT_Executable*>(new int(7));
+  return nullptr;
+}
+PJRT_Error* ExecutableDestroy(PJRT_Executable_Destroy_Args* args) {
+  delete reinterpret_cast<int*>(args->executable);
+  return nullptr;
+}
+PJRT_Error* ExecutableNumOutputs(PJRT_Executable_NumOutputs_Args* args) {
+  args->num_outputs = num_outputs();
+  return nullptr;
+}
+
+std::atomic<uint64_t> g_exec_count{0};
+
+PJRT_Error* Execute(PJRT_LoadedExecutable_Execute_Args* args) {
+  g_exec_count.fetch_add(1);
+  uint64_t done = mono_ns() + exec_ns();
+  if (args->device_complete_events != nullptr) {
+    for (size_t d = 0; d < args->num_devices; d++) {
+      args->device_complete_events[d] =
+          reinterpret_cast<PJRT_Event*>(new FakeEvent{done});
+    }
+  }
+  if (args->output_lists != nullptr) {
+    for (size_t d = 0; d < args->num_devices; d++) {
+      for (size_t o = 0; o < num_outputs(); o++) {
+        args->output_lists[d][o] =
+            reinterpret_cast<PJRT_Buffer*>(new FakeBuffer{1024});
+      }
+    }
+  }
+  return nullptr;
+}
+
+PJRT_Api g_api;
+
+}  // namespace
+
+extern "C" const PJRT_Api* GetPjrtApi() {
+  static bool init = [] {
+    memset(&g_api, 0, sizeof(g_api));
+    g_api.struct_size = PJRT_Api_STRUCT_SIZE;
+    g_api.pjrt_api_version.struct_size = PJRT_Api_Version_STRUCT_SIZE;
+    g_api.pjrt_api_version.major_version = PJRT_API_MAJOR;
+    g_api.pjrt_api_version.minor_version = PJRT_API_MINOR;
+    g_api.PJRT_Error_Destroy = ErrorDestroy;
+    g_api.PJRT_Error_Message = ErrorMessage;
+    g_api.PJRT_Error_GetCode = ErrorGetCode;
+    g_api.PJRT_Client_Create = ClientCreate;
+    g_api.PJRT_Client_Destroy = ClientDestroy;
+    g_api.PJRT_Client_AddressableDevices = ClientAddressableDevices;
+    g_api.PJRT_Client_BufferFromHostBuffer = BufferFromHostBuffer;
+    g_api.PJRT_Buffer_Destroy = BufferDestroy;
+    g_api.PJRT_Buffer_OnDeviceSizeInBytes = BufferOnDeviceSize;
+    g_api.PJRT_Event_Destroy = EventDestroy;
+    g_api.PJRT_Event_OnReady = EventOnReady;
+    g_api.PJRT_LoadedExecutable_GetExecutable = LoadedGetExecutable;
+    g_api.PJRT_Executable_Destroy = ExecutableDestroy;
+    g_api.PJRT_Executable_NumOutputs = ExecutableNumOutputs;
+    g_api.PJRT_LoadedExecutable_Execute = Execute;
+    return true;
+  }();
+  (void)init;
+  return &g_api;
+}
+
+extern "C" uint64_t fake_pjrt_exec_count() { return g_exec_count.load(); }

@@ -1,0 +1,191 @@
+// pjrt_smoke: a stand-in PJRT consumer (what jax does, minus XLA) used to
+// drive libvtpu end-to-end: dlopen a plugin, resolve GetPjrtApi, create a
+// client, allocate buffers until the cap bites, free, and execute in a loop.
+//
+// Usage: pjrt_smoke <plugin.so> [alloc_mb=64] [n_allocs=100] [n_execs=50]
+// Prints one "RESULT {...}" line for easy assertions.
+
+#include <dlfcn.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <time.h>
+
+#include <string>
+#include <vector>
+
+#include "pjrt_c_api.h"
+
+typedef const PJRT_Api* (*GetPjrtApiFn)();
+
+static double now_s() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return ts.tv_sec + ts.tv_nsec / 1e9;
+}
+
+static std::string error_text(const PJRT_Api* api, PJRT_Error* err) {
+  PJRT_Error_Message_Args margs;
+  memset(&margs, 0, sizeof(margs));
+  margs.struct_size = PJRT_Error_Message_Args_STRUCT_SIZE;
+  margs.error = err;
+  api->PJRT_Error_Message(&margs);
+  PJRT_Error_GetCode_Args cargs;
+  memset(&cargs, 0, sizeof(cargs));
+  cargs.struct_size = PJRT_Error_GetCode_Args_STRUCT_SIZE;
+  cargs.error = err;
+  api->PJRT_Error_GetCode(&cargs);
+  std::string out = "code=" + std::to_string(cargs.code) + " msg=" +
+                    std::string(margs.message, margs.message_size);
+  PJRT_Error_Destroy_Args dargs;
+  memset(&dargs, 0, sizeof(dargs));
+  dargs.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+  dargs.error = err;
+  api->PJRT_Error_Destroy(&dargs);
+  return out;
+}
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    fprintf(stderr, "usage: %s <plugin.so> [alloc_mb] [n_allocs] [n_execs]\n",
+            argv[0]);
+    return 2;
+  }
+  size_t alloc_mb = argc > 2 ? atoi(argv[2]) : 64;
+  int n_allocs = argc > 3 ? atoi(argv[3]) : 100;
+  int n_execs = argc > 4 ? atoi(argv[4]) : 50;
+
+  void* handle = dlopen(argv[1], RTLD_NOW);
+  if (!handle) {
+    fprintf(stderr, "dlopen: %s\n", dlerror());
+    return 1;
+  }
+  auto get_api = (GetPjrtApiFn)dlsym(handle, "GetPjrtApi");
+  if (!get_api) {
+    fprintf(stderr, "dlsym GetPjrtApi: %s\n", dlerror());
+    return 1;
+  }
+  const PJRT_Api* api = get_api();
+  printf("api struct_size=%zu version=%d.%d\n", api->struct_size,
+         api->pjrt_api_version.major_version,
+         api->pjrt_api_version.minor_version);
+
+  PJRT_Client_Create_Args cargs;
+  memset(&cargs, 0, sizeof(cargs));
+  cargs.struct_size = PJRT_Client_Create_Args_STRUCT_SIZE;
+  if (PJRT_Error* err = api->PJRT_Client_Create(&cargs)) {
+    fprintf(stderr, "client create: %s\n", error_text(api, err).c_str());
+    return 1;
+  }
+  PJRT_Client_AddressableDevices_Args dargs;
+  memset(&dargs, 0, sizeof(dargs));
+  dargs.struct_size = PJRT_Client_AddressableDevices_Args_STRUCT_SIZE;
+  dargs.client = cargs.client;
+  api->PJRT_Client_AddressableDevices(&dargs);
+
+  // Allocate alloc_mb MiB f32 buffers until failure (HBM cap probe).
+  std::vector<float> host(alloc_mb * 1024 * 1024 / 4, 1.0f);
+  int64_t dims[1] = {(int64_t)host.size()};
+  std::vector<PJRT_Buffer*> buffers;
+  std::string first_error;
+  for (int i = 0; i < n_allocs; i++) {
+    PJRT_Client_BufferFromHostBuffer_Args bargs;
+    memset(&bargs, 0, sizeof(bargs));
+    bargs.struct_size = PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE;
+    bargs.client = cargs.client;
+    bargs.data = host.data();
+    bargs.type = PJRT_Buffer_Type_F32;
+    bargs.dims = dims;
+    bargs.num_dims = 1;
+    bargs.host_buffer_semantics =
+        PJRT_HostBufferSemantics_kImmutableUntilTransferCompletes;
+    bargs.device = dargs.num_addressable_devices ? dargs.addressable_devices[0]
+                                                 : nullptr;
+    if (PJRT_Error* err = api->PJRT_Client_BufferFromHostBuffer(&bargs)) {
+      first_error = error_text(api, err);
+      break;
+    }
+    buffers.push_back(bargs.buffer);
+  }
+  size_t allocated = buffers.size();
+
+  // Free half, then confirm allocation works again.
+  size_t freed = 0;
+  for (size_t i = 0; i + 1 < buffers.size(); i += 2) {
+    PJRT_Buffer_Destroy_Args del;
+    memset(&del, 0, sizeof(del));
+    del.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+    del.buffer = buffers[i];
+    api->PJRT_Buffer_Destroy(&del);
+    freed++;
+  }
+  int realloc_ok = 0;
+  {
+    PJRT_Client_BufferFromHostBuffer_Args bargs;
+    memset(&bargs, 0, sizeof(bargs));
+    bargs.struct_size = PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE;
+    bargs.client = cargs.client;
+    bargs.data = host.data();
+    bargs.type = PJRT_Buffer_Type_F32;
+    bargs.dims = dims;
+    bargs.num_dims = 1;
+    bargs.host_buffer_semantics =
+        PJRT_HostBufferSemantics_kImmutableUntilTransferCompletes;
+    bargs.device = dargs.num_addressable_devices ? dargs.addressable_devices[0]
+                                                 : nullptr;
+    if (PJRT_Error* err = api->PJRT_Client_BufferFromHostBuffer(&bargs)) {
+      error_text(api, err);
+    } else {
+      realloc_ok = 1;
+    }
+  }
+
+  // Execute loop (core-throttle probe): measure wall time of n_execs.
+  size_t n_out = 1;
+  std::vector<PJRT_Buffer*> out_row(n_out, nullptr);
+  PJRT_Buffer** output_lists[1] = {out_row.data()};
+  PJRT_Event* events[1] = {nullptr};
+  double t0 = now_s();
+  int execs_ok = 0;
+  for (int i = 0; i < n_execs; i++) {
+    PJRT_LoadedExecutable_Execute_Args eargs;
+    memset(&eargs, 0, sizeof(eargs));
+    eargs.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
+    eargs.executable = reinterpret_cast<PJRT_LoadedExecutable*>(&eargs);  // fake
+    eargs.num_devices = 1;
+    eargs.num_args = 0;
+    eargs.output_lists = output_lists;
+    eargs.device_complete_events = events;
+    if (PJRT_Error* err = api->PJRT_LoadedExecutable_Execute(&eargs)) {
+      fprintf(stderr, "execute: %s\n", error_text(api, err).c_str());
+      break;
+    }
+    execs_ok++;
+    for (size_t o = 0; o < n_out; o++) {
+      if (out_row[o]) {
+        PJRT_Buffer_Destroy_Args del;
+        memset(&del, 0, sizeof(del));
+        del.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+        del.buffer = out_row[o];
+        api->PJRT_Buffer_Destroy(&del);
+        out_row[o] = nullptr;
+      }
+    }
+    if (events[0]) {
+      PJRT_Event_Destroy_Args del;
+      memset(&del, 0, sizeof(del));
+      del.struct_size = PJRT_Event_Destroy_Args_STRUCT_SIZE;
+      del.event = events[0];
+      api->PJRT_Event_Destroy(&del);
+      events[0] = nullptr;
+    }
+  }
+  double exec_elapsed = now_s() - t0;
+
+  printf(
+      "RESULT {\"allocated\": %zu, \"freed\": %zu, \"realloc_ok\": %d, "
+      "\"alloc_error\": \"%s\", \"execs\": %d, \"exec_seconds\": %.3f}\n",
+      allocated, freed, realloc_ok, first_error.c_str(), execs_ok,
+      exec_elapsed);
+  return 0;
+}

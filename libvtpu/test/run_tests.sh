@@ -1,0 +1,83 @@
+#!/usr/bin/env bash
+# libvtpu C-level smoke checks over the fake PJRT plugin.
+# Covers both delivery modes (LD_PRELOAD dlsym interposition; plugin
+# shadowing via VTPU_REAL_LIBTPU) plus cap, release, throttle and region.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+B=build
+TMP=$(mktemp -d)
+trap 'rm -rf "$TMP"' EXIT
+
+fail() { echo "FAIL: $*" >&2; exit 1; }
+
+result_field() { # file field
+  python3 -c "
+import json,sys
+line=[l for l in open('$1') if l.startswith('RESULT ')][-1]
+print(json.loads(line[7:])['$2'])"
+}
+
+echo "== 1. baseline: no shim, no limits =="
+$B/pjrt_smoke $B/fake_pjrt.so 64 10 5 > "$TMP/base.out"
+[ "$(result_field "$TMP/base.out" allocated)" = 10 ] || fail "baseline alloc"
+
+echo "== 2. delivery B (plugin shadowing): 256m cap bites at 4 allocs =="
+env VTPU_REAL_LIBTPU=$PWD/$B/fake_pjrt.so TPU_DEVICE_MEMORY_LIMIT_0=256m \
+    $B/pjrt_smoke $B/libvtpu.so 64 10 0 > "$TMP/capb.out"
+[ "$(result_field "$TMP/capb.out" allocated)" = 4 ] || fail "cap B alloc count"
+result_field "$TMP/capb.out" alloc_error | grep -q "code=8" || fail "cap B code"
+result_field "$TMP/capb.out" alloc_error | grep -q "HBM limit exceeded" || fail "cap B msg"
+[ "$(result_field "$TMP/capb.out" realloc_ok)" = 1 ] || fail "cap B realloc after free"
+
+echo "== 3. delivery A (LD_PRELOAD): same caps via dlsym interposition =="
+env LD_PRELOAD=$PWD/$B/libvtpu.so TPU_DEVICE_MEMORY_LIMIT_0=256m \
+    $B/pjrt_smoke $B/fake_pjrt.so 64 10 0 > "$TMP/capa.out"
+[ "$(result_field "$TMP/capa.out" allocated)" = 4 ] || fail "cap A alloc count"
+result_field "$TMP/capa.out" alloc_error | grep -q "code=8" || fail "cap A code"
+
+echo "== 4. oversubscribe: cap warns but allows =="
+env VTPU_REAL_LIBTPU=$PWD/$B/fake_pjrt.so TPU_DEVICE_MEMORY_LIMIT_0=256m \
+    VTPU_OVERSUBSCRIBE=true \
+    $B/pjrt_smoke $B/libvtpu.so 64 10 0 > "$TMP/over.out"
+[ "$(result_field "$TMP/over.out" allocated)" = 10 ] || fail "oversubscribe alloc"
+
+echo "== 5. core throttle: 20% duty over 2ms execs stretches wall time =="
+env VTPU_REAL_LIBTPU=$PWD/$B/fake_pjrt.so TPU_CORE_LIMIT=20 \
+    FAKE_PJRT_EXEC_NS=2000000 \
+    $B/pjrt_smoke $B/libvtpu.so 1 1 50 > "$TMP/thr.out"
+THR=$(result_field "$TMP/thr.out" exec_seconds)
+env VTPU_REAL_LIBTPU=$PWD/$B/fake_pjrt.so \
+    FAKE_PJRT_EXEC_NS=2000000 \
+    $B/pjrt_smoke $B/libvtpu.so 1 1 50 > "$TMP/unthr.out"
+UNTHR=$(result_field "$TMP/unthr.out" exec_seconds)
+python3 -c "
+thr, unthr = float('$THR'), float('$UNTHR')
+# 50 x 2ms busy at 20% duty needs >= ~0.35s; unthrottled submits are ~instant
+assert thr >= 0.35, f'throttled too fast: {thr}'
+assert unthr < thr / 3, f'unthrottled not faster: {unthr} vs {thr}'
+print(f'   throttled={thr}s unthrottled={unthr}s')"
+
+echo "== 6. shared region file is created and stamped =="
+env VTPU_REAL_LIBTPU=$PWD/$B/fake_pjrt.so TPU_DEVICE_MEMORY_LIMIT_0=256m \
+    VTPU_SHARED_REGION="$TMP/usage.cache" VTPU_TASK_PRIORITY=1 \
+    $B/pjrt_smoke $B/libvtpu.so 64 3 5 > "$TMP/region.out"
+python3 - "$TMP/usage.cache" <<'EOF'
+import struct, sys
+data = open(sys.argv[1], "rb").read()
+magic, version, num_devices, priority = struct.unpack_from("<IIii", data, 0)
+assert magic == 0x56545055, hex(magic)
+assert version == 1, version
+assert num_devices >= 1, num_devices
+assert priority == 1, priority
+# device slot 0: uuid[64] + hbm_limit
+off = 40
+uuid = data[off:off+64].split(b"\0")[0].decode()
+limit, used, peak = struct.unpack_from("<QQQ", data, off+64)
+kernel_count = struct.unpack_from("<Q", data, off+64+24+8+8)[0]
+assert limit == 256*1024*1024, limit
+assert peak > 0, peak
+assert kernel_count == 5, kernel_count
+print(f"   region ok: dev0={uuid} limit={limit>>20}MiB peak={peak>>20}MiB kernels={kernel_count}")
+EOF
+
+echo "ALL LIBVTPU TESTS PASSED"

@@ -1,0 +1,62 @@
+"""libvtpu (C++) — build and drive the PJRT shim against the fake plugin.
+
+The heavy lifting lives in libvtpu/test/run_tests.sh (both delivery modes,
+cap enforcement + release, oversubscribe, duty-cycle throttle, shared region);
+this wrapper builds and runs it so `pytest tests/` covers the native layer.
+"""
+
+import shutil
+import subprocess
+from pathlib import Path
+
+import pytest
+
+LIBVTPU = Path(__file__).resolve().parent.parent / "libvtpu"
+
+
+@pytest.fixture(scope="session")
+def libvtpu_build():
+    if shutil.which("g++") is None:
+        pytest.skip("no g++ toolchain")
+    r = subprocess.run(["make", "-C", str(LIBVTPU)], capture_output=True, text=True)
+    assert r.returncode == 0, f"libvtpu build failed:\n{r.stdout}\n{r.stderr}"
+    return LIBVTPU / "build"
+
+
+def test_libvtpu_smoke_suite(libvtpu_build):
+    r = subprocess.run(
+        [str(LIBVTPU / "test" / "run_tests.sh")], capture_output=True, text=True
+    )
+    assert r.returncode == 0, f"libvtpu tests failed:\n{r.stdout}\n{r.stderr}"
+    assert "ALL LIBVTPU TESTS PASSED" in r.stdout
+
+
+def test_region_layout_matches_python_mirror(libvtpu_build, tmp_path):
+    """The C++ region written by the shim parses with the Python monitor's
+    struct mirror (single source of truth check)."""
+    import os
+    import subprocess as sp
+
+    from vtpu.monitor.region import RegionReader
+
+    region = tmp_path / "usage.cache"
+    env = dict(os.environ)
+    env.update({
+        "VTPU_REAL_LIBTPU": str(libvtpu_build / "fake_pjrt.so"),
+        "TPU_DEVICE_MEMORY_LIMIT_0": "128m",
+        "VTPU_SHARED_REGION": str(region),
+        "VTPU_TASK_PRIORITY": "1",
+    })
+    r = sp.run(
+        [str(libvtpu_build / "pjrt_smoke"), str(libvtpu_build / "libvtpu.so"),
+         "16", "4", "3"],
+        env=env, capture_output=True, text=True,
+    )
+    assert r.returncode == 0, r.stderr
+    reader = RegionReader(str(region))
+    snap = reader.read()
+    assert snap.priority == 1
+    assert snap.devices[0].hbm_limit_bytes == 128 * 1024 * 1024
+    assert snap.devices[0].kernel_count == 3
+    assert snap.devices[0].hbm_peak_bytes >= 3 * 16 * 1024 * 1024
+    assert any(p.active for p in snap.procs)

@@ -1,0 +1,5 @@
+"""Node monitor: shared-region lister, Prometheus metrics, QoS feedback.
+
+Parity: reference cmd/vGPUmonitor + pkg/monitor/nvidia (cudevshr.go lister,
+metrics.go collector, feedback.go priority loop).
+"""

@@ -1,0 +1,150 @@
+"""Python mirror of the libvtpu shared region (libvtpu/include/vtpu/shared_region.h).
+
+Parity: reference pkg/monitor/nvidia/v1/spec.go (mmap'ed C layout mirrored in
+Go). The monitor reads usage fields and owns the two QoS gates
+(``recent_kernel``, ``utilization_switch``) the C side polls.
+
+Layout (little-endian, no implicit padding — verified against the C++
+static_asserts and the cross-language test in tests/test_libvtpu.py):
+
+    header:  magic u32 | version u32 | num_devices i32 | priority i32 |
+             recent_kernel i32 | utilization_switch i32 | heartbeat_ns u64 |
+             owner_init_ns u64                                   (40 bytes)
+    devices: 16 x { uuid[64] | hbm_limit u64 | hbm_used u64 | hbm_peak u64 |
+             core_limit i32 | core_util i32 | last_kernel_ns u64 |
+             kernel_count u64 | throttle_wait_ns u64 }          (120 bytes)
+    procs:   num_procs i32 | pad i32 |
+             64 x { pid i32 | active i32 | hbm_used u64[16] }   (136 bytes)
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import struct
+from dataclasses import dataclass, field
+
+MAGIC = 0x56545055
+VERSION = 1
+MAX_DEVICES = 16
+MAX_PROCS = 64
+UUID_LEN = 64
+
+HEADER_FMT = "<IIiiiiQQ"
+HEADER_SIZE = struct.calcsize(HEADER_FMT)  # 40
+DEVICE_FMT = f"<{UUID_LEN}sQQQiiQQQ"
+DEVICE_SIZE = struct.calcsize(DEVICE_FMT)  # 120
+DEVICES_OFF = HEADER_SIZE
+NUM_PROCS_OFF = DEVICES_OFF + MAX_DEVICES * DEVICE_SIZE  # 1960
+PROCS_OFF = NUM_PROCS_OFF + 8
+PROC_FMT = f"<ii{MAX_DEVICES}Q"
+PROC_SIZE = struct.calcsize(PROC_FMT)  # 136
+REGION_SIZE = PROCS_OFF + MAX_PROCS * PROC_SIZE
+
+# header field offsets for point writes
+OFF_RECENT_KERNEL = 16
+OFF_UTILIZATION_SWITCH = 20
+OFF_HEARTBEAT = 24
+
+
+@dataclass
+class DeviceSnapshot:
+    uuid: str = ""
+    hbm_limit_bytes: int = 0
+    hbm_used_bytes: int = 0
+    hbm_peak_bytes: int = 0
+    core_limit_percent: int = 0
+    core_util_percent: int = 0
+    last_kernel_ns: int = 0
+    kernel_count: int = 0
+    throttle_wait_ns: int = 0
+
+
+@dataclass
+class ProcSnapshot:
+    pid: int = 0
+    active: bool = False
+    hbm_used_bytes: list[int] = field(default_factory=list)
+
+
+@dataclass
+class RegionSnapshot:
+    magic: int = 0
+    version: int = 0
+    num_devices: int = 0
+    priority: int = 0
+    recent_kernel: int = 0
+    utilization_switch: int = 0
+    heartbeat_ns: int = 0
+    owner_init_ns: int = 0
+    devices: list[DeviceSnapshot] = field(default_factory=list)
+    procs: list[ProcSnapshot] = field(default_factory=list)
+
+    @property
+    def valid(self) -> bool:
+        return self.magic == MAGIC and self.version == VERSION
+
+
+class BadRegion(ValueError):
+    pass
+
+
+class RegionReader:
+    """mmap a shared region read-write (feedback gates are written back)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        size = os.path.getsize(path)
+        if size < REGION_SIZE:
+            raise BadRegion(f"{path}: {size} bytes < expected {REGION_SIZE}")
+        self._f = open(path, "r+b")
+        self._mm = mmap.mmap(self._f.fileno(), REGION_SIZE)
+        snap = self.read()
+        if not snap.valid:
+            self.close()
+            raise BadRegion(f"{path}: bad magic {snap.magic:#x} / version {snap.version}")
+
+    def close(self) -> None:
+        try:
+            self._mm.close()
+        finally:
+            self._f.close()
+
+    # ------------------------------------------------------------------ read
+
+    def read(self) -> RegionSnapshot:
+        mm = self._mm
+        hdr = struct.unpack_from(HEADER_FMT, mm, 0)
+        snap = RegionSnapshot(
+            magic=hdr[0], version=hdr[1], num_devices=hdr[2], priority=hdr[3],
+            recent_kernel=hdr[4], utilization_switch=hdr[5],
+            heartbeat_ns=hdr[6], owner_init_ns=hdr[7],
+        )
+        n_dev = min(max(snap.num_devices, 0), MAX_DEVICES)
+        for i in range(n_dev):
+            f = struct.unpack_from(DEVICE_FMT, mm, DEVICES_OFF + i * DEVICE_SIZE)
+            snap.devices.append(
+                DeviceSnapshot(
+                    uuid=f[0].split(b"\0")[0].decode(errors="replace"),
+                    hbm_limit_bytes=f[1], hbm_used_bytes=f[2], hbm_peak_bytes=f[3],
+                    core_limit_percent=f[4], core_util_percent=f[5],
+                    last_kernel_ns=f[6], kernel_count=f[7], throttle_wait_ns=f[8],
+                )
+            )
+        (num_procs,) = struct.unpack_from("<i", mm, NUM_PROCS_OFF)
+        for i in range(min(max(num_procs, 0), MAX_PROCS)):
+            f = struct.unpack_from(PROC_FMT, mm, PROCS_OFF + i * PROC_SIZE)
+            snap.procs.append(
+                ProcSnapshot(pid=f[0], active=bool(f[1]), hbm_used_bytes=list(f[2:]))
+            )
+        return snap
+
+    # -------------------------------------------------------------- feedback
+
+    def set_recent_kernel(self, value: int) -> None:
+        """-1 blocks low-priority kernels; >0 grants credit (reference
+        feedback.go SetRecentKernel)."""
+        struct.pack_into("<i", self._mm, OFF_RECENT_KERNEL, value)
+
+    def set_utilization_switch(self, value: int) -> None:
+        struct.pack_into("<i", self._mm, OFF_UTILIZATION_SWITCH, value)
