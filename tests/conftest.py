@@ -9,10 +9,25 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
+import shutil  # noqa: E402
+import subprocess  # noqa: E402
+from pathlib import Path  # noqa: E402
+
 import pytest  # noqa: E402
 
 from vtpu.device.registry import reset_registry  # noqa: E402
 from vtpu.util import nodelock  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def libvtpu_build():
+    """Build libvtpu once per session; shared by the native and monitor tests."""
+    libvtpu = Path(__file__).resolve().parent.parent / "libvtpu"
+    if shutil.which("g++") is None:
+        pytest.skip("no g++ toolchain")
+    r = subprocess.run(["make", "-C", str(libvtpu)], capture_output=True, text=True)
+    assert r.returncode == 0, f"libvtpu build failed:\n{r.stdout}\n{r.stderr}"
+    return libvtpu / "build"
 
 
 @pytest.fixture(autouse=True)

@@ -5,22 +5,10 @@ cap enforcement + release, oversubscribe, duty-cycle throttle, shared region);
 this wrapper builds and runs it so `pytest tests/` covers the native layer.
 """
 
-import shutil
 import subprocess
 from pathlib import Path
 
-import pytest
-
 LIBVTPU = Path(__file__).resolve().parent.parent / "libvtpu"
-
-
-@pytest.fixture(scope="session")
-def libvtpu_build():
-    if shutil.which("g++") is None:
-        pytest.skip("no g++ toolchain")
-    r = subprocess.run(["make", "-C", str(LIBVTPU)], capture_output=True, text=True)
-    assert r.returncode == 0, f"libvtpu build failed:\n{r.stdout}\n{r.stderr}"
-    return LIBVTPU / "build"
 
 
 def test_libvtpu_smoke_suite(libvtpu_build):

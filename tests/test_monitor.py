@@ -1,0 +1,130 @@
+"""Monitor: lister + feedback + metrics over REAL regions written by libvtpu
+(cross-stack: C++ writer, Python reader/feedback — reference feedback_test.go)."""
+
+import os
+import subprocess
+import time
+from pathlib import Path
+
+import pytest
+
+from vtpu.monitor.feedback import apply_feedback, census
+from vtpu.monitor.lister import ContainerLister
+from vtpu.monitor.metrics import MonitorCollector
+
+LIBVTPU = Path(__file__).resolve().parent.parent / "libvtpu"
+
+
+def _run_workload(build, region_path, priority, execs=3):
+    env = dict(os.environ)
+    env.update({
+        "VTPU_REAL_LIBTPU": str(build / "fake_pjrt.so"),
+        "TPU_DEVICE_MEMORY_LIMIT_0": "64m",
+        "VTPU_SHARED_REGION": str(region_path),
+        "VTPU_TASK_PRIORITY": str(priority),
+    })
+    r = subprocess.run(
+        [str(build / "pjrt_smoke"), str(build / "libvtpu.so"), "4", "2", str(execs)],
+        env=env, capture_output=True, text=True,
+    )
+    assert r.returncode == 0, r.stderr
+
+
+@pytest.fixture
+def hook(libvtpu_build, tmp_path):
+    base = tmp_path / "hook" / "containers"
+    dirs = {}
+    for pod_uid, ctr, prio in [("poda", "main", 0), ("podb", "main", 1)]:
+        d = base / f"{pod_uid}_{ctr}"
+        d.mkdir(parents=True)
+        _run_workload(libvtpu_build, d / "usage.cache", prio)
+        dirs[pod_uid] = d
+    return tmp_path / "hook", dirs
+
+
+def test_lister_finds_and_snapshots(hook):
+    hook_path, _ = hook
+    lister = ContainerLister(str(hook_path))
+    entries = lister.update()
+    assert {e.pod_uid for e in entries} == {"poda", "podb"}
+    by_uid = {e.pod_uid: e for e in entries}
+    assert by_uid["poda"].snapshot.priority == 0
+    assert by_uid["podb"].snapshot.priority == 1
+    assert by_uid["poda"].snapshot.devices[0].kernel_count == 3
+
+
+def test_feedback_blocks_low_priority_when_high_active(hook):
+    hook_path, _ = hook
+    lister = ContainerLister(str(hook_path))
+    entries = lister.update()
+    c = census(entries, time.time_ns())
+    assert c["device-0"].high_active == 1 and c["device-0"].low_active == 1
+    apply_feedback(entries)
+    entries = lister.update()
+    by_uid = {e.pod_uid: e for e in entries}
+    assert by_uid["poda"].snapshot.recent_kernel == -1  # low blocked
+    assert by_uid["podb"].snapshot.recent_kernel > 0  # high granted
+    # both share device-0 -> core limiting stays on
+    assert by_uid["poda"].snapshot.utilization_switch == 1
+
+
+def test_feedback_unblocks_when_high_goes_idle(hook):
+    hook_path, _ = hook
+    lister = ContainerLister(str(hook_path))
+    entries = lister.update()
+    # pretend the high-priority pod went idle long ago
+    old = time.time_ns() + int(60e9)
+    apply_feedback(entries, now_ns=old)
+    entries = lister.update()
+    by_uid = {e.pod_uid: e for e in entries}
+    assert by_uid["poda"].snapshot.recent_kernel > 0  # unblocked
+    # nobody active -> each is sole tenant -> limiter relaxed
+    assert by_uid["poda"].snapshot.utilization_switch == 0
+
+
+def test_lister_gc_removes_dead_pod_dirs(hook):
+    hook_path, dirs = hook
+    lister = ContainerLister(str(hook_path), pod_checker=lambda uid: uid != "poda")
+    entries = lister.update()
+    assert {e.pod_uid for e in entries} == {"podb"}
+    assert not dirs["poda"].exists()
+    assert dirs["podb"].exists()
+
+
+def test_monitor_collector_exports(hook):
+    hook_path, _ = hook
+    lister = ContainerLister(str(hook_path))
+    collector = MonitorCollector(lister, node_name="n1")
+    metrics = {m.name: m for m in collector.collect()}
+    assert "vtpu_memory_limit_bytes" in metrics
+    limits = {
+        tuple(s.labels.values()): s.value
+        for s in metrics["vtpu_memory_limit_bytes"].samples
+    }
+    assert ("poda", "main", "device-0", "n1") in limits
+    assert limits[("poda", "main", "device-0", "n1")] == 64 * 1024 * 1024
+    kernel_samples = metrics["vtpu_container_kernels"].samples
+    assert any(s.value == 3 for s in kernel_samples)
+
+
+def test_scheduler_collector_exports():
+    from prometheus_client.core import CollectorRegistry
+    from vtpu.scheduler.metrics import SchedulerCollector
+    from vtpu.scheduler.scheduler import Scheduler
+    from tests.helpers import fake_cluster, register_tpu_backend, tpu_pod, v5e_devices
+
+    client = fake_cluster({"node-a": v5e_devices(2, prefix="a")})
+    sched = Scheduler(client)
+    register_tpu_backend(quota=sched.quota_manager)
+    sched.start(register_interval=3600)
+    pod = client.put_pod(tpu_pod("p1", tpumem=4096, tpucores=25))
+    sched.filter({"Pod": pod, "NodeNames": ["node-a"]})
+    metrics = {m.name: m for m in SchedulerCollector(sched).collect()}
+    alloc = metrics["vtpu_tpu_memory_allocated_bytes"].samples
+    assert sum(s.value for s in alloc) == 4096 * 1024 * 1024
+    overview = metrics["vtpu_node_tpu_overview"].samples
+    assert overview[0].labels == {"nodeid": "node-a", "devicetype": "TPU-v5e"}
+    assert overview[0].value == 2
+    pod_mem = metrics["vtpu_container_vtpu_allocated_memory_bytes"].samples
+    assert pod_mem[0].labels["podname"] == "p1"
+    sched.stop()

@@ -42,6 +42,10 @@ class PodManager:
                 uid=meta["uid"],
                 node_id=node_id,
                 devices=devices,
+                ctr_ids=[
+                    c.get("name", f"ctr{i}")
+                    for i, c in enumerate(pod.get("spec", {}).get("containers") or [])
+                ],
             )
 
     def del_pod(self, pod: dict) -> None:

@@ -1,0 +1,84 @@
+"""vTPUmonitor binary (reference cmd/vGPUmonitor/main.go): validates the hook
+path, runs the container lister + Prometheus endpoint + feedback loop."""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+
+from prometheus_client import start_http_server
+from prometheus_client.core import REGISTRY
+
+import time
+
+from vtpu.monitor.feedback import FeedbackLoop
+from vtpu.monitor.lister import ContainerLister
+from vtpu.monitor.metrics import MonitorCollector
+from vtpu.util.k8sclient import RealKubeClient
+
+
+class PodSetChecker:
+    """pod_checker backed by ONE cached pods LIST per TTL window; any API
+    failure fails safe (never GC on trouble)."""
+
+    def __init__(self, client: RealKubeClient, node_name: str, ttl: float = 10.0):
+        self.client = client
+        self.selector = f"spec.nodeName={node_name}" if node_name else ""
+        self.ttl = ttl
+        self._uids: set[str] = set()
+        self._fetched_at = 0.0
+        self._suspended_until = float("inf")  # until the first successful LIST
+
+    def __call__(self, pod_uid: str) -> bool:
+        now = time.monotonic()
+        if now - self._fetched_at > self.ttl:
+            self._fetched_at = now
+            try:
+                pods = self.client.list_pods(field_selector=self.selector)
+                self._uids = {p.get("metadata", {}).get("uid", "") for p in pods}
+                self._suspended_until = 0.0
+            except Exception:
+                logging.getLogger(__name__).warning(
+                    "pods LIST failed; suspending GC", exc_info=True
+                )
+                self._suspended_until = now + 10 * self.ttl
+        if now < self._suspended_until:
+            return True  # fail safe: never GC on API trouble or stale data
+        return pod_uid in self._uids
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser("vtpu-monitor")
+    parser.add_argument("--hook-path", default=os.environ.get("HOOK_PATH", "/usr/local/vtpu"))
+    parser.add_argument("--node-name", default=os.environ.get("NODE_NAME", ""))
+    parser.add_argument("--metrics-port", type=int, default=9394)
+    parser.add_argument("--feedback-interval", type=float, default=5.0)
+    parser.add_argument("--kube-api", default="")
+    parser.add_argument("--no-gc", action="store_true",
+                        help="disable dead-pod cache GC (no API access needed)")
+    parser.add_argument("-v", "--verbose", action="count", default=0)
+    args = parser.parse_args()
+
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
+    if not os.path.isdir(args.hook_path):
+        parser.error(f"hook path {args.hook_path} does not exist")
+
+    pod_checker = None
+    if not args.no_gc:
+        client = RealKubeClient(base_url=args.kube_api)
+        pod_checker = PodSetChecker(client, args.node_name)
+
+    lister = ContainerLister(args.hook_path, pod_checker=pod_checker)
+    REGISTRY.register(MonitorCollector(lister, node_name=args.node_name))
+    start_http_server(args.metrics_port)
+    logging.info("vtpu-monitor metrics on :%d, watching %s", args.metrics_port,
+                 args.hook_path)
+    FeedbackLoop(lister, interval=args.feedback_interval).run_forever()
+
+
+if __name__ == "__main__":
+    main()

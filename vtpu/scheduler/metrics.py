@@ -1,0 +1,87 @@
+"""Scheduler-side Prometheus metrics: the allocation view from the caches.
+
+Parity: reference cmd/scheduler/metrics.go:54-398 — per-chip limit/allocated
+HBM+core, shared-pod counts, per-pod-container allocations, node overview,
+namespace quota usage. (The monitor exposes the *real* usage; this is the
+scheduler's book-keeping.)
+"""
+
+from __future__ import annotations
+
+from prometheus_client.core import GaugeMetricFamily
+from prometheus_client.registry import Collector
+
+from vtpu.scheduler.scheduler import Scheduler
+
+
+class SchedulerCollector(Collector):
+    def __init__(self, scheduler: Scheduler):
+        self.scheduler = scheduler
+
+    def collect(self):
+        dev_labels = ["nodeid", "deviceuuid", "devicetype"]
+        mem_limit = GaugeMetricFamily(
+            "vtpu_tpu_memory_limit_bytes", "Chip HBM capacity", labels=dev_labels
+        )
+        mem_alloc = GaugeMetricFamily(
+            "vtpu_tpu_memory_allocated_bytes", "Scheduler-allocated HBM",
+            labels=dev_labels,
+        )
+        core_alloc = GaugeMetricFamily(
+            "vtpu_tpu_core_allocated_ratio", "Scheduler-allocated core percent",
+            labels=dev_labels,
+        )
+        shared = GaugeMetricFamily(
+            "vtpu_tpu_shared_containers", "Containers sharing the chip",
+            labels=dev_labels,
+        )
+        overview = GaugeMetricFamily(
+            "vtpu_node_tpu_overview", "Chips registered per node",
+            labels=["nodeid", "devicetype"],
+        )
+        for node, usage in self.scheduler.inspect_all_nodes_usage().items():
+            type_counts: dict[str, int] = {}
+            for vendor, devices in usage.items():
+                for d in devices:
+                    lv = [node, d.id, d.type]
+                    mem_limit.add_metric(lv, d.totalmem * 1024 * 1024)
+                    mem_alloc.add_metric(lv, d.usedmem * 1024 * 1024)
+                    core_alloc.add_metric(lv, d.usedcores)
+                    shared.add_metric(lv, d.used)
+                    type_counts[d.type] = type_counts.get(d.type, 0) + 1
+            for dtype, n in type_counts.items():
+                overview.add_metric([node, dtype], n)
+
+        pod_labels = ["podnamespace", "podname", "ctrname", "deviceuuid"]
+        pod_mem = GaugeMetricFamily(
+            "vtpu_container_vtpu_allocated_memory_bytes",
+            "Per-container scheduler-allocated HBM", labels=pod_labels,
+        )
+        pod_core = GaugeMetricFamily(
+            "vtpu_container_vtpu_allocated_core_ratio",
+            "Per-container scheduler-allocated core percent", labels=pod_labels,
+        )
+        for info in self.scheduler.pod_manager.list_pods_info():
+            for vendor, single in info.devices.items():
+                for ctr_idx, ctr in enumerate(single):
+                    ctr_name = (
+                        info.ctr_ids[ctr_idx]
+                        if ctr_idx < len(info.ctr_ids)
+                        else f"ctr{ctr_idx}"
+                    )
+                    for dev in ctr:
+                        lv = [info.namespace, info.name, ctr_name, dev.uuid]
+                        pod_mem.add_metric(lv, dev.usedmem * 1024 * 1024)
+                        pod_core.add_metric(lv, dev.usedcores)
+
+        quota = GaugeMetricFamily(
+            "vtpu_namespace_quota", "Namespace device quota limit/used",
+            labels=["namespace", "resource", "kind"],
+        )
+        for ns, resources in self.scheduler.quota_manager.snapshot().items():
+            for res, vals in resources.items():
+                quota.add_metric([ns, res, "limit"], vals["limit"])
+                quota.add_metric([ns, res, "used"], vals["used"])
+
+        yield from (mem_limit, mem_alloc, core_alloc, shared, overview,
+                    pod_mem, pod_core, quota)
