@@ -4,10 +4,19 @@ host devices; the driver's dryrun does the same)."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even when the ambient env pins a real TPU platform (the driver env
+# sets JAX_PLATFORMS=axon and a sitecustomize imports jax at interpreter start,
+# so env vars alone are read too early to override -- go through jax.config):
+# unit tests need deterministic f32 math and 8 virtual devices for the
+# sharding suite.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import shutil  # noqa: E402
 import subprocess  # noqa: E402

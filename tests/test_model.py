@@ -1,0 +1,57 @@
+"""Model tests: prefill/decode consistency, generation, static-shape caching."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from vtpu.models import ModelConfig, init_params, prefill, decode_step, greedy_generate
+
+TINY = ModelConfig(
+    vocab=128, d_model=64, n_heads=4, n_layers=2, d_ff=128,
+    max_seq=64, head_dim=32, dtype=jnp.float32, use_pallas=False,
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(jax.random.key(0), TINY)
+
+
+def test_prefill_shapes(params):
+    tokens = jax.random.randint(jax.random.key(1), (2, 16), 0, TINY.vocab)
+    logits, cache = prefill(params, TINY, tokens)
+    assert logits.shape == (2, 16, TINY.vocab)
+    assert cache["k"].shape == (TINY.n_layers, 2, TINY.max_seq, TINY.n_heads, TINY.head_dim)
+    assert int(cache["len"][0]) == 16
+
+
+def test_decode_matches_prefill(params):
+    """Logits from incremental decode must match full-prefill logits."""
+    tokens = jax.random.randint(jax.random.key(2), (1, 9), 0, TINY.vocab)
+    full_logits, _ = prefill(params, TINY, tokens)
+    _, cache = prefill(params, TINY, tokens[:, :8])
+    step_logits, cache = decode_step(params, TINY, cache, tokens[:, 8])
+    np.testing.assert_allclose(
+        np.asarray(step_logits), np.asarray(full_logits[:, 8]), atol=2e-4
+    )
+    assert int(cache["len"][0]) == 9
+
+
+def test_greedy_generate_deterministic(params):
+    tokens = jax.random.randint(jax.random.key(3), (2, 8), 0, TINY.vocab)
+    out1 = greedy_generate(params, TINY, tokens, steps=5)
+    out2 = greedy_generate(params, TINY, tokens, steps=5)
+    assert out1.shape == (2, 5)
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+
+
+def test_prefill_pallas_path_matches_xla():
+    cfg = dataclasses.replace(TINY, max_seq=128)
+    params = init_params(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(4), (1, 128), 0, cfg.vocab)
+    logits_xla, _ = prefill(params, cfg, tokens)
+    logits_pl, _ = prefill(params, dataclasses.replace(cfg, use_pallas=True), tokens)
+    np.testing.assert_allclose(np.asarray(logits_pl), np.asarray(logits_xla), atol=2e-3)

@@ -1,0 +1,56 @@
+"""Unit tests for vtpu.ops (run on CPU; Pallas in interpret mode)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vtpu.ops import rms_norm, rope_angles, apply_rope, causal_attention, flash_attention
+
+
+def test_rms_norm_matches_manual():
+    x = jax.random.normal(jax.random.key(0), (2, 5, 16), jnp.float32)
+    w = jax.random.normal(jax.random.key(1), (16,), jnp.float32)
+    got = rms_norm(x, w)
+    want = x / np.sqrt(np.mean(np.square(np.asarray(x)), axis=-1, keepdims=True) + 1e-6) * np.asarray(w)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4)
+
+
+def test_rope_position_zero_is_identity():
+    cos, sin = rope_angles(8, 16)
+    x = jax.random.normal(jax.random.key(0), (1, 1, 2, 16), jnp.float32)
+    pos = jnp.zeros((1, 1), jnp.int32)
+    np.testing.assert_allclose(np.asarray(apply_rope(x, cos, sin, pos)), np.asarray(x), atol=1e-6)
+
+
+def test_rope_preserves_norm():
+    cos, sin = rope_angles(32, 16)
+    x = jax.random.normal(jax.random.key(0), (2, 7, 2, 16), jnp.float32)
+    pos = jnp.broadcast_to(jnp.arange(7, dtype=jnp.int32), (2, 7))
+    rot = apply_rope(x, cos, sin, pos)
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(rot), axis=-1),
+        np.linalg.norm(np.asarray(x), axis=-1),
+        rtol=1e-5,
+    )
+
+
+def test_flash_attention_matches_reference():
+    k1, k2, k3 = jax.random.split(jax.random.key(42), 3)
+    shape = (2, 256, 2, 64)
+    q = jax.random.normal(k1, shape, jnp.float32)
+    k = jax.random.normal(k2, shape, jnp.float32)
+    v = jax.random.normal(k3, shape, jnp.float32)
+    want = causal_attention(q, k, v)
+    got = flash_attention(q, k, v, block_q=128, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+def test_causal_attention_respects_kv_len():
+    k1, k2, k3 = jax.random.split(jax.random.key(7), 3)
+    q = jax.random.normal(k1, (2, 1, 2, 16), jnp.float32)
+    k = jax.random.normal(k2, (2, 8, 2, 16), jnp.float32)
+    v = jax.random.normal(k3, (2, 8, 2, 16), jnp.float32)
+    # masking the tail to length 4 == truncating the cache to 4
+    got = causal_attention(q, k, v, kv_len=jnp.array([4, 4]))
+    want = causal_attention(q, k[:, :4], v[:, :4], kv_len=jnp.array([4, 4]))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-6)

@@ -1,0 +1,26 @@
+"""Flagship benchmark model: a decoder-only transformer served under vTPU limits.
+
+The middleware itself is model-free (like the reference, SURVEY.md §2.6); this
+package is the JAX/XLA inference workload that `bench.py` and `benchmarks/`
+run inside isolated containers to measure TTFT degradation under sharing --
+the TPU-native counterpart of the reference's vLLM/Qwen3-8B harness workload
+(reference benchmarks/README.md:1-100).
+"""
+
+from vtpu.models.transformer import (
+    ModelConfig,
+    init_params,
+    init_kv_cache,
+    prefill,
+    decode_step,
+    greedy_generate,
+)
+
+__all__ = [
+    "ModelConfig",
+    "init_params",
+    "init_kv_cache",
+    "prefill",
+    "decode_step",
+    "greedy_generate",
+]

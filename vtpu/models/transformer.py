@@ -1,0 +1,165 @@
+"""Decoder-only transformer (LLaMA-style) in pure functional JAX.
+
+TPU-first choices:
+- layer parameters are STACKED along a leading axis and the layer loop is a
+  single `lax.scan` -- one trace, one compiled body, no Python unrolling;
+- bf16 params/activations, f32 softmax/normalization accumulators (MXU native);
+- head_dim 128 so attention tiles land on the (8,128) vector lanes exactly;
+- the KV cache is a static-shape ring buffer updated with dynamic_update_slice
+  so decode steps compile once and reuse the executable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from vtpu.ops import rms_norm, apply_rope, rope_angles, causal_attention, flash_attention
+
+Params = dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    vocab: int = 2048
+    d_model: int = 512
+    n_heads: int = 4
+    n_layers: int = 4
+    d_ff: int = 1408
+    max_seq: int = 1024
+    head_dim: int = 128
+    dtype: Any = jnp.bfloat16
+    use_pallas: bool = True
+
+    @property
+    def qkv_dim(self) -> int:
+        return self.n_heads * self.head_dim
+
+
+def init_params(rng: jax.Array, cfg: ModelConfig) -> Params:
+    """Scaled-normal init; per-layer tensors stacked on axis 0 for lax.scan."""
+    keys = jax.random.split(rng, 8)
+    d, f, l, qd = cfg.d_model, cfg.d_ff, cfg.n_layers, cfg.qkv_dim
+
+    def w(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32) / math.sqrt(fan_in)).astype(cfg.dtype)
+
+    return {
+        "embed": w(keys[0], (cfg.vocab, d), d),
+        "layers": {
+            "wq": w(keys[1], (l, d, qd), d),
+            "wk": w(keys[2], (l, d, qd), d),
+            "wv": w(keys[3], (l, d, qd), d),
+            "wo": w(keys[4], (l, qd, d), qd),
+            "w_gate": w(keys[5], (l, d, f), d),
+            "w_up": w(keys[6], (l, d, f), d),
+            "w_down": w(keys[7], (l, f, d), f),
+            "attn_norm": jnp.ones((l, d), cfg.dtype),
+            "mlp_norm": jnp.ones((l, d), cfg.dtype),
+        },
+        "final_norm": jnp.ones((d,), cfg.dtype),
+    }
+
+
+def init_kv_cache(cfg: ModelConfig, batch: int) -> dict[str, jax.Array]:
+    shape = (cfg.n_layers, batch, cfg.max_seq, cfg.n_heads, cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, cfg.dtype),
+        "v": jnp.zeros(shape, cfg.dtype),
+        "len": jnp.zeros((batch,), jnp.int32),
+    }
+
+
+def _qkv(cfg, lp, x, cos, sin, positions):
+    """Project to rotated q/k/v heads: [B, S, H, Dh] each."""
+    b, s, _ = x.shape
+    h, dh = cfg.n_heads, cfg.head_dim
+    normed = rms_norm(x, lp["attn_norm"])
+    q = (normed @ lp["wq"]).reshape(b, s, h, dh)
+    k = (normed @ lp["wk"]).reshape(b, s, h, dh)
+    v = (normed @ lp["wv"]).reshape(b, s, h, dh)
+    return apply_rope(q, cos, sin, positions), apply_rope(k, cos, sin, positions), v
+
+
+def _mlp_block(lp, x):
+    normed = rms_norm(x, lp["mlp_norm"])
+    gate = jax.nn.silu((normed @ lp["w_gate"]).astype(jnp.float32)).astype(x.dtype)
+    return (gate * (normed @ lp["w_up"])) @ lp["w_down"]
+
+
+def prefill(
+    params: Params, cfg: ModelConfig, tokens: jax.Array
+) -> tuple[jax.Array, dict[str, jax.Array]]:
+    """Full-sequence forward. tokens: [B, S] int32. Returns (logits, kv_cache)."""
+    b, s = tokens.shape
+    cos, sin = rope_angles(cfg.max_seq, cfg.head_dim)
+    positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+    x = params["embed"][tokens].astype(cfg.dtype)
+
+    def layer(x, lp):
+        q, k, v = _qkv(cfg, lp, x, cos, sin, positions)
+        if cfg.use_pallas and s % 128 == 0:
+            attn = flash_attention(q, k, v)
+        else:
+            attn = causal_attention(q, k, v)
+        x = x + attn.reshape(b, s, cfg.qkv_dim) @ lp["wo"]
+        x = x + _mlp_block(lp, x)
+        return x, (k, v)
+
+    x, (ks, vs) = jax.lax.scan(layer, x, params["layers"])
+    x = rms_norm(x, params["final_norm"])
+    logits = (x @ params["embed"].T).astype(jnp.float32)
+
+    cache = init_kv_cache(cfg, b)
+    cache["k"] = jax.lax.dynamic_update_slice(cache["k"], ks, (0, 0, 0, 0, 0))
+    cache["v"] = jax.lax.dynamic_update_slice(cache["v"], vs, (0, 0, 0, 0, 0))
+    cache["len"] = jnp.full((b,), s, jnp.int32)
+    return logits, cache
+
+
+def decode_step(
+    params: Params, cfg: ModelConfig, cache: dict[str, jax.Array], token: jax.Array
+) -> tuple[jax.Array, dict[str, jax.Array]]:
+    """One autoregressive step. token: [B] int32. Static shapes throughout."""
+    b = token.shape[0]
+    cos, sin = rope_angles(cfg.max_seq, cfg.head_dim)
+    positions = cache["len"][:, None]  # [B, 1]
+    x = params["embed"][token[:, None]].astype(cfg.dtype)
+    pos0 = cache["len"][0]  # uniform batch position (benchmark decodes in lockstep)
+
+    def layer(x, inp):
+        lp, layer_k, layer_v = inp
+        q, k, v = _qkv(cfg, lp, x, cos, sin, positions)
+        full_k = jax.lax.dynamic_update_slice(layer_k, k, (0, pos0, 0, 0))
+        full_v = jax.lax.dynamic_update_slice(layer_v, v, (0, pos0, 0, 0))
+        attn = causal_attention(q, full_k, full_v, kv_len=cache["len"] + 1)
+        x = x + attn.reshape(b, 1, cfg.qkv_dim) @ lp["wo"]
+        x = x + _mlp_block(lp, x)
+        return x, (full_k, full_v)
+
+    x, (new_ks, new_vs) = jax.lax.scan(layer, x, (params["layers"], cache["k"], cache["v"]))
+    x = rms_norm(x, params["final_norm"])
+    logits = (x[:, 0] @ params["embed"].T).astype(jnp.float32)
+    new_cache = {"k": new_ks, "v": new_vs, "len": cache["len"] + 1}
+    return logits, new_cache
+
+
+def greedy_generate(
+    params: Params, cfg: ModelConfig, tokens: jax.Array, steps: int
+) -> jax.Array:
+    """Prefill + `steps` greedy decode steps; returns [B, steps] generated ids."""
+    logits, cache = prefill(params, cfg, tokens)
+    tok = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+
+    def step(carry, _):
+        tok, cache = carry
+        logits, cache = decode_step(params, cfg, cache, tok)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return (nxt, cache), nxt
+
+    (_, _), out = jax.lax.scan(step, (tok, cache), None, length=steps)
+    return out.T  # [B, steps]

@@ -1,0 +1,20 @@
+"""TPU-first primitive ops for the benchmark data plane.
+
+These are the hot ops of the flagship inference workload scheduled by the
+middleware (the reference repo has no tensor ops -- SURVEY.md §2.6; this layer
+exists so the TTFT benchmark in `benchmarks/` and `bench.py` exercises a real
+JAX/XLA model under vTPU isolation, mirroring the reference's vLLM harness,
+reference benchmarks/ai-benchmark/benchmark.py:1-50).
+"""
+
+from vtpu.ops.norms import rms_norm
+from vtpu.ops.rope import apply_rope, rope_angles
+from vtpu.ops.attention import causal_attention, flash_attention
+
+__all__ = [
+    "rms_norm",
+    "apply_rope",
+    "rope_angles",
+    "causal_attention",
+    "flash_attention",
+]

@@ -1,0 +1,17 @@
+"""Normalization ops (bf16-safe: accumulate in f32, emit in input dtype)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float = 1e-6) -> jax.Array:
+    """RMSNorm with f32 variance accumulation so bf16 inputs stay stable.
+
+    XLA fuses this into neighbouring matmuls; no custom kernel needed (the
+    MXU-bound matmuls dominate, this is VPU work riding the same HBM read).
+    """
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    normed = x.astype(jnp.float32) * jax.lax.rsqrt(var + eps)
+    return (normed * weight.astype(jnp.float32)).astype(x.dtype)

@@ -1,0 +1,30 @@
+"""Rotary position embeddings, precomputed-table style (static shapes for jit)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rope_angles(max_seq: int, head_dim: int, base: float = 10000.0) -> tuple[jax.Array, jax.Array]:
+    """Precompute (cos, sin) tables of shape [max_seq, head_dim//2] in f32."""
+    half = head_dim // 2
+    freqs = 1.0 / (base ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    pos = jnp.arange(max_seq, dtype=jnp.float32)
+    angles = jnp.outer(pos, freqs)  # [S, half]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array, positions: jax.Array) -> jax.Array:
+    """Rotate pairs (x_even, x_odd) by the per-position angle.
+
+    x: [B, S, H, Dh]; positions: [B, S] int32 absolute positions (supports both
+    prefill, where positions = arange, and decode, where it is the cache index).
+    """
+    half = x.shape[-1] // 2
+    c = cos[positions][:, :, None, :]  # [B, S, 1, half]
+    s = sin[positions][:, :, None, :]
+    x1 = x[..., :half]
+    x2 = x[..., half:]
+    rot = jnp.concatenate([x1 * c - x2 * s, x1 * s + x2 * c], axis=-1)
+    return rot.astype(x.dtype)

@@ -1,0 +1,24 @@
+"""SPMD parallelism for the benchmark data plane.
+
+The middleware's control plane never moves tensors (SURVEY.md §2.6/§5.8: the
+reference has no NCCL/MPI backend; ICI/DCN belongs to XLA). This package is
+where the scheduled *workload* does: a device `Mesh` with dp/tp/sp axes,
+NamedSharding rules for the transformer, a pjit train step whose collectives
+XLA lowers onto ICI, and a ring-attention sequence-parallel kernel built on
+`shard_map` + `ppermute`.
+"""
+
+from vtpu.parallel.mesh import make_mesh, mesh_shape_for
+from vtpu.parallel.sharding import param_shardings, shard_params
+from vtpu.parallel.ring import ring_attention
+from vtpu.parallel.train import make_train_step, init_train_state
+
+__all__ = [
+    "make_mesh",
+    "mesh_shape_for",
+    "param_shardings",
+    "shard_params",
+    "ring_attention",
+    "make_train_step",
+    "init_train_state",
+]

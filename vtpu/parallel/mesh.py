@@ -1,0 +1,50 @@
+"""Device-mesh construction for dp x tp (x sp) SPMD layouts.
+
+Axis order matters on hardware: the LAST mesh axis maps to the most tightly
+coupled devices, so tensor-parallel collectives (per-layer all-reduce) ride
+the shortest ICI links while data-parallel gradient reduction tolerates the
+longer hops. This mirrors what the middleware's ICI-topology Fit does at the
+placement level (vtpu/device/tpu/topology.py): keep the chatty axis contiguous.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def mesh_shape_for(n_devices: int, tp: int | None = None) -> tuple[int, int]:
+    """Pick a (dp, tp) factorization. Prefers the largest power-of-two tp that
+    divides n_devices, capped at 4 so dp stays >= 2 on an 8-chip host."""
+    if tp is None:
+        tp = 1
+        while tp * 2 <= min(4, n_devices) and n_devices % (tp * 2) == 0:
+            tp *= 2
+    if n_devices % tp:
+        raise ValueError(f"tp={tp} does not divide n_devices={n_devices}")
+    return n_devices // tp, tp
+
+
+def make_mesh(
+    n_devices: int | None = None,
+    tp: int | None = None,
+    devices: list | None = None,
+) -> Mesh:
+    """Build a 2D ('dp', 'tp') mesh over the first n_devices jax devices."""
+    devs = devices if devices is not None else jax.devices()
+    if n_devices is None:
+        n_devices = len(devs)
+    if n_devices > len(devs):
+        raise ValueError(f"requested {n_devices} devices, have {len(devs)}")
+    dp, tpn = mesh_shape_for(n_devices, tp)
+    grid = np.asarray(devs[:n_devices]).reshape(dp, tpn)
+    return Mesh(grid, ("dp", "tp"))
+
+
+def make_sp_mesh(n_devices: int | None = None, devices: list | None = None) -> Mesh:
+    """1D ('sp',) mesh for ring-attention sequence parallelism."""
+    devs = devices if devices is not None else jax.devices()
+    if n_devices is None:
+        n_devices = len(devs)
+    return Mesh(np.asarray(devs[:n_devices]), ("sp",))

@@ -1,0 +1,62 @@
+"""Sharded next-token training step for the benchmark model.
+
+Pure annotate-and-jit SPMD: params carry tp NamedShardings, the batch is
+dp-sharded, and jit's sharding propagation makes XLA emit the per-layer tp
+all-reduces and the dp gradient reduce-scatter on ICI. Used by the driver's
+multi-chip dryrun and the parallelism tests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from vtpu.models.transformer import ModelConfig, init_params, prefill
+from vtpu.parallel.sharding import shard_params, batch_sharding
+
+
+def next_token_loss(params: Any, cfg: ModelConfig, tokens: jax.Array) -> jax.Array:
+    logits, _ = prefill(params, cfg, tokens)  # [B, S, V] f32
+    logp = jax.nn.log_softmax(logits[:, :-1])
+    tgt = tokens[:, 1:]
+    nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)
+
+
+def init_train_state(rng: jax.Array, cfg: ModelConfig, mesh, lr: float = 1e-3):
+    """Init params on host, place per sharding rules, init optimizer sharded.
+
+    Optimizer moments inherit the param shardings because opt.init is jitted
+    over already-placed params.
+    """
+    opt = optax.adamw(lr)
+    params = shard_params(init_params(rng, cfg), mesh)
+    opt_state = jax.jit(opt.init)(params)
+    return {"params": params, "opt_state": opt_state}, opt
+
+
+def make_train_step(cfg: ModelConfig, opt: optax.GradientTransformation) -> Callable:
+    """Returns jitted step(state, tokens) -> (state, loss).
+
+    Training always uses the XLA attention path: the Pallas prefill kernel is
+    forward-only (no VJP registered), and XLA's fused attention is what we
+    want under autodiff anyway.
+    """
+    train_cfg = dataclasses.replace(cfg, use_pallas=False)
+
+    @jax.jit
+    def step(state, tokens):
+        loss, grads = jax.value_and_grad(next_token_loss)(state["params"], train_cfg, tokens)
+        updates, opt_state = opt.update(grads, state["opt_state"], state["params"])
+        params = optax.apply_updates(state["params"], updates)
+        return {"params": params, "opt_state": opt_state}, loss
+
+    return step
+
+
+def place_batch(tokens: jax.Array, mesh) -> jax.Array:
+    return jax.device_put(tokens, batch_sharding(mesh))
