@@ -1,0 +1,202 @@
+"""Generic config-driven device classes: the vendor-breadth matrix
+(reference pkg/device/{ascend,amd,awsneuron,metax,...}/device_test.go analogs)."""
+
+from vtpu.device import common
+from vtpu.device.generic import (
+    QOS_BEST_EFFORT,
+    QOS_BURST_SHARE,
+    QOS_POLICY_ANNO,
+    DeviceClassConfig,
+    GenericDevices,
+    PartitionTemplate,
+)
+from vtpu.device.types import DeviceInfo, DeviceUsage, NodeInfo
+from vtpu.scheduler.config import (
+    device_class_from_dict,
+    init_devices_with_config,
+    load_device_config,
+)
+from vtpu.device.registry import get_devices
+
+
+def _cls(**kw) -> DeviceClassConfig:
+    base = dict(
+        common_word="TPU-V5P",
+        resource_count_name="google.com/tpu-v5p",
+        resource_memory_name="google.com/tpu-v5p-mem",
+        resource_cores_name="google.com/tpu-v5p-cores",
+    )
+    base.update(kw)
+    return DeviceClassConfig(**base)
+
+
+def _usages(n=4, devmem=98304):
+    return [
+        DeviceUsage.from_info(
+            DeviceInfo(id=f"d{i}", count=4, devmem=devmem, devcore=100,
+                       type="TPU-V5P", index=i)
+        )
+        for i in range(n)
+    ]
+
+
+def _pod(annos=None, **limits):
+    return {
+        "metadata": {"name": "p", "namespace": "default",
+                     "annotations": dict(annos or {})},
+        "spec": {"containers": [{"name": "c", "resources": {"limits": limits}}]},
+    }
+
+
+def _fit(backend, devices, pod):
+    req = backend.generate_resource_requests(pod["spec"]["containers"][0])
+    return backend.fit(devices, req, pod, NodeInfo(node_name="n1"), {})
+
+
+def test_default_config_registers_device_classes():
+    init_devices_with_config(load_device_config())
+    words = set(get_devices())
+    assert {"TPU", "TPU-V4", "TPU-V5P", "TPU-V6E", "XLA-DEV"} <= words
+
+
+def test_template_rounding_ascend_style():
+    b = GenericDevices(_cls(templates=[
+        PartitionTemplate("1c.16g", 16384, 50),
+        PartitionTemplate("2c.32g", 32768, 100),
+    ]))
+    ok, result, reason = _fit(b, _usages(), _pod(**{
+        "google.com/tpu-v5p-mem": "10000", "google.com/tpu-v5p-cores": "30"}))
+    assert ok, reason
+    dev = result["TPU-V5P"][0]
+    # 10000 MB / 30% rounds UP to the 1c.16g template
+    assert (dev.usedmem, dev.usedcores) == (16384, 50)
+
+
+def test_core_level_allocation_neuron_style():
+    b = GenericDevices(_cls(
+        cores_per_device=2,
+        resource_core_unit_name="google.com/tpu-v5p-tensorcore",
+    ))
+    # asking 1 of 2 TensorCores -> 50% of one device
+    req = b.generate_resource_requests(
+        {"resources": {"limits": {"google.com/tpu-v5p-tensorcore": "1"}}})
+    assert (req.nums, req.coresreq) == (1, 50)
+    # percent-style cores resource keeps percent semantics alongside
+    req = b.generate_resource_requests(
+        {"resources": {"limits": {"google.com/tpu-v5p-cores": "30"}}})
+    assert (req.nums, req.coresreq) == (1, 30)
+
+
+def test_qos_burst_share_oversubscribes_cores():
+    b = GenericDevices(_cls(qos=True))
+    devices = _usages(1)
+    devices[0].usedcores = 80
+    devices[0].used = 1
+    ask = {"google.com/tpu-v5p-mem": "1024", "google.com/tpu-v5p-cores": "50"}
+    # fixed-share (default): 50 cores don't fit in the remaining 20
+    ok, _, reason = _fit(b, devices, _pod(**ask))
+    assert not ok and common.CARD_INSUFFICIENT_CORE in reason
+    # burst-share may oversubscribe
+    ok, _, reason = _fit(b, devices, _pod(annos={QOS_POLICY_ANNO: QOS_BURST_SHARE}, **ask))
+    assert ok, reason
+    # best-effort ignores core budget entirely
+    ok, _, reason = _fit(b, devices, _pod(annos={QOS_POLICY_ANNO: QOS_BEST_EFFORT}, **ask))
+    assert ok, reason
+
+
+def test_qos_env_injected_at_admission():
+    b = GenericDevices(_cls(qos=True))
+    pod = _pod(annos={QOS_POLICY_ANNO: QOS_BURST_SHARE},
+               **{"google.com/tpu-v5p-mem": "1024"})
+    ctr = pod["spec"]["containers"][0]
+    assert b.mutate_admission(ctr, pod)
+    assert {"name": "VTPU_QOS_POLICY", "value": QOS_BURST_SHARE} in ctr["env"]
+
+
+def test_count_only_amd_style_from_node_allocatable():
+    b = GenericDevices(DeviceClassConfig(
+        common_word="XLA-DEV", resource_count_name="example.com/xla-dev",
+        count_only=True,
+    ))
+    node = {"metadata": {"name": "n1", "annotations": {}},
+            "status": {"allocatable": {"example.com/xla-dev": "3"}}}
+    infos = b.get_node_devices(node)
+    assert len(infos) == 3 and all(d.devcore == 100 for d in infos)
+    devices = [DeviceUsage.from_info(d) for d in infos]
+    ok, result, reason = _fit(b, devices, _pod(**{"example.com/xla-dev": "2"}))
+    assert ok, reason
+    assert len(result["XLA-DEV"]) == 2
+
+
+def test_core_units_above_one_device_take_multiple_chips():
+    b = GenericDevices(_cls(
+        cores_per_device=2,
+        resource_core_unit_name="google.com/tpu-v5p-tensorcore",
+    ))
+    req = b.generate_resource_requests(
+        {"resources": {"limits": {"google.com/tpu-v5p-tensorcore": "4"}}})
+    assert (req.nums, req.coresreq) == (2, 100)
+    # non-multiple rounds up to whole chips
+    req = b.generate_resource_requests(
+        {"resources": {"limits": {"google.com/tpu-v5p-tensorcore": "3"}}})
+    assert (req.nums, req.coresreq) == (2, 100)
+
+
+def test_quota_checked_against_template_rounded_values():
+    from vtpu.device.quota import QuotaManager
+    from vtpu.device.registry import register_backend
+
+    quota = QuotaManager()
+    b = GenericDevices(_cls(templates=[PartitionTemplate("1c.16g", 16384, 50)]),
+                       quota=quota)
+    register_backend(b)
+    quota.refresh_managed_resources()
+    # namespace quota below the template floor but above the raw ask
+    quota.add_quota({
+        "metadata": {"namespace": "default", "name": "q"},
+        "spec": {"hard": {"limits.google.com/tpu-v5p-mem": "16000"}},
+    })
+    ok, _, reason = _fit(b, _usages(1), _pod(**{"google.com/tpu-v5p-mem": "10000"}))
+    assert not ok and common.ALLOCATED_POD_OVERQUOTA in reason
+
+
+def test_count_only_class_enforces_count_quota():
+    from vtpu.device.quota import QuotaManager
+    from vtpu.device.registry import register_backend
+
+    quota = QuotaManager()
+    b = GenericDevices(DeviceClassConfig(
+        common_word="XLA-DEV", resource_count_name="example.com/xla-dev",
+        count_only=True,
+    ), quota=quota)
+    register_backend(b)
+    quota.refresh_managed_resources()
+    quota.add_quota({
+        "metadata": {"namespace": "default", "name": "q"},
+        "spec": {"hard": {"limits.example.com/xla-dev": "1"}},
+    })
+    node = {"metadata": {"name": "n1", "annotations": {}},
+            "status": {"allocatable": {"example.com/xla-dev": "3"}}}
+    devices = [DeviceUsage.from_info(d) for d in b.get_node_devices(node)]
+    ok, _, reason = _fit(b, devices, _pod(**{"example.com/xla-dev": "2"}))
+    assert not ok and common.ALLOCATED_POD_OVERQUOTA in reason
+
+
+def test_exclusive_ask_rejects_shared_device():
+    b = GenericDevices(_cls())
+    devices = _usages(1)
+    devices[0].used = 1
+    ok, _, reason = _fit(b, devices, _pod(**{
+        "google.com/tpu-v5p": "1", "google.com/tpu-v5p-cores": "100"}))
+    assert not ok and common.EXCLUSIVE_DEVICE_ALLOCATE_CONFLICT in reason
+
+
+def test_device_class_from_dict_roundtrip():
+    d = {
+        "commonWord": "TPU-V4", "resourceCountName": "google.com/tpu-v4",
+        "coresPerDevice": 2, "qos": True, "countOnly": False,
+        "templates": [{"name": "1c.16g", "memoryMB": 16384, "cores": 50}],
+    }
+    cfg = device_class_from_dict(d)
+    assert cfg.cores_per_device == 2 and cfg.qos
+    assert cfg.templates[0].memory_mb == 16384

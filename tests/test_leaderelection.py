@@ -1,0 +1,57 @@
+"""Leader-election observer semantics (reference leaderelection_test analog)."""
+
+import time
+
+from vtpu.util.k8sclient import FakeKubeClient
+from vtpu.util.leaderelection import (
+    DummyLeaderManager,
+    LeaderManager,
+    new_leader_manager,
+)
+
+
+def _lease(holder, renew=None, duration=15):
+    return {
+        "metadata": {"namespace": "vtpu-system", "name": "vtpu-scheduler"},
+        "spec": {
+            "holderIdentity": holder,
+            "renewTime": time.time() if renew is None else renew,
+            "leaseDurationSeconds": duration,
+        },
+    }
+
+
+def test_observer_follows_holder_identity():
+    client = FakeKubeClient()
+    mgr = LeaderManager(client, identity="sched-a")
+    assert mgr.refresh() is False  # no lease -> not leading
+    client.put_lease(_lease("sched-a"))
+    assert mgr.refresh() is True
+    client.put_lease(_lease("sched-b"))
+    assert mgr.refresh() is False
+
+
+def test_expired_lease_counts_as_vacant():
+    client = FakeKubeClient()
+    client.put_lease(_lease("sched-a", renew=time.time() - 60, duration=15))
+    mgr = LeaderManager(client, identity="sched-a")
+    assert mgr.refresh() is False
+
+
+def test_dummy_manager_always_leads():
+    assert isinstance(new_leader_manager(FakeKubeClient(), False, "x"), DummyLeaderManager)
+    assert new_leader_manager(FakeKubeClient(), False, "x").is_leader()
+
+
+def test_background_loop_updates_state():
+    client = FakeKubeClient()
+    mgr = LeaderManager(client, identity="sched-a", poll_interval=0.05)
+    mgr.start()
+    try:
+        client.put_lease(_lease("sched-a"))
+        deadline = time.time() + 2
+        while time.time() < deadline and not mgr.is_leader():
+            time.sleep(0.02)
+        assert mgr.is_leader()
+    finally:
+        mgr.stop()

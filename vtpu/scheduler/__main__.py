@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import argparse
 import logging
+import socket
 
 from vtpu.device import codec
 from vtpu.device.types import DeviceInfo
@@ -83,6 +84,12 @@ def main() -> None:
     parser.add_argument("--kube-api", default="", help="API server URL (else in-cluster)")
     parser.add_argument("--fake-cluster", type=int, default=0,
                         help="serve over an in-memory cluster of N v5e-8 nodes")
+    parser.add_argument("--profiling", action="store_true",
+                        help="expose /debug/threads (reference --profiling pprof)")
+    parser.add_argument("--leader-election", action="store_true",
+                        help="observe the scheduler Lease; only the holder registers nodes")
+    parser.add_argument("--leader-identity", default="",
+                        help="holder identity to match (default: hostname)")
     parser.add_argument("-v", "--verbose", action="count", default=0)
     args = parser.parse_args()
 
@@ -97,9 +104,19 @@ def main() -> None:
         client = RealKubeClient(base_url=args.kube_api)
     init_global_client(client)
 
+    from vtpu.util.leaderelection import new_leader_manager
+
+    leader = new_leader_manager(
+        client, args.leader_election, args.leader_identity or socket.gethostname()
+    )
+    leader.start()
+
     scheduler_cls = _DemoScheduler if args.fake_cluster else Scheduler
     scheduler = scheduler_cls(
-        client, node_policy=args.node_policy, device_policy=args.device_policy
+        client,
+        node_policy=args.node_policy,
+        device_policy=args.device_policy,
+        leader_check=leader.is_leader,
     )
     init_devices_with_config(
         load_device_config(args.device_config), scheduler.quota_manager
@@ -112,6 +129,7 @@ def main() -> None:
         port=args.port,
         tls_cert=args.tls_cert,
         tls_key=args.tls_key,
+        profiling=args.profiling,
     )
     logging.info("vtpu-scheduler serving on :%d", server.port)
     server.serve_forever()

@@ -12,6 +12,7 @@ from dataclasses import dataclass, field
 
 import yaml
 
+from vtpu.device.generic import DeviceClassConfig, GenericDevices, PartitionTemplate
 from vtpu.device.mock.device import MockDevices
 from vtpu.device.quota import QuotaManager
 from vtpu.device.registry import register_backend
@@ -31,6 +32,37 @@ tpu:
   deviceCoresScaling: 1.0
   defaultMemory: 0
   defaultCores: 0
+# Parametric accelerator classes (reference: 13 sibling vendor packages,
+# pkg/device/*; here one GenericDevices backend per YAML stanza -- see
+# vtpu/device/generic.py for the capability mapping table).
+deviceClasses:
+  - commonWord: TPU-V4
+    resourceCountName: google.com/tpu-v4
+    resourceMemoryName: google.com/tpu-v4-mem
+    resourceCoresName: google.com/tpu-v4-cores
+    resourceCoreUnitName: google.com/tpu-v4-tensorcore
+    coresPerDevice: 2          # two TensorCores per v4 chip (core-level asks)
+    templates:                 # fixed partition geometries (vNPU/MIG analog)
+      - {name: 1c.16g, memoryMB: 16384, cores: 50}
+      - {name: 2c.32g, memoryMB: 32768, cores: 100}
+  - commonWord: TPU-V5P
+    resourceCountName: google.com/tpu-v5p
+    resourceMemoryName: google.com/tpu-v5p-mem
+    resourceCoresName: google.com/tpu-v5p-cores
+    resourceCoreUnitName: google.com/tpu-v5p-tensorcore
+    coresPerDevice: 2
+    qos: true                  # best-effort / fixed-share / burst-share
+    templates:
+      - {name: 1c.47g, memoryMB: 48128, cores: 50}
+      - {name: 2c.95g, memoryMB: 97280, cores: 100}
+  - commonWord: TPU-V6E
+    resourceCountName: google.com/tpu-v6e
+    resourceMemoryName: google.com/tpu-v6e-mem
+    resourceCoresName: google.com/tpu-v6e-cores
+    qos: true
+  - commonWord: XLA-DEV        # count-only class for unmanaged accelerators
+    resourceCountName: example.com/xla-dev
+    countOnly: true
 """
 
 
@@ -71,6 +103,31 @@ def tpu_config_from_dict(d: dict) -> TpuConfig:
     )
 
 
+def device_class_from_dict(d: dict) -> DeviceClassConfig:
+    return DeviceClassConfig(
+        common_word=d["commonWord"],
+        resource_count_name=d["resourceCountName"],
+        resource_memory_name=d.get("resourceMemoryName", ""),
+        resource_memory_percentage_name=d.get("resourceMemoryPercentageName", ""),
+        resource_cores_name=d.get("resourceCoresName", ""),
+        device_split_count=int(d.get("deviceSplitCount", 4)),
+        default_memory=int(d.get("defaultMemory", 0)),
+        default_cores=int(d.get("defaultCores", 0)),
+        count_only=bool(d.get("countOnly", False)),
+        cores_per_device=int(d.get("coresPerDevice", 1)),
+        resource_core_unit_name=d.get("resourceCoreUnitName", ""),
+        qos=bool(d.get("qos", False)),
+        topology_aware=bool(d.get("topologyAware", True)),
+        templates=[
+            PartitionTemplate(
+                name=tp["name"], memory_mb=int(tp["memoryMB"]), cores=int(tp["cores"])
+            )
+            for tp in (d.get("templates") or [])
+        ],
+        allowed_types=list(d.get("allowedTypes", []) or []),
+    )
+
+
 def init_devices_with_config(
     config: dict, quota_manager: QuotaManager | None = None, mock_devices: bool = False
 ) -> None:
@@ -78,6 +135,8 @@ def init_devices_with_config(
     InitDevicesWithConfig config.go:107-251)."""
     tpu_section = config.get("tpu", {}) or {}
     register_backend(TpuDevices(tpu_config_from_dict(tpu_section), quota=quota_manager))
+    for cls in config.get("deviceClasses") or []:
+        register_backend(GenericDevices(device_class_from_dict(cls), quota=quota_manager))
     if mock_devices or config.get("mock"):
         mock_section = config.get("mock") or {}
         register_backend(

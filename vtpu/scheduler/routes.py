@@ -32,7 +32,7 @@ except Exception:  # pragma: no cover - prometheus always present in this image
     FILTER_LATENCY = BIND_LATENCY = None
 
 
-def make_handler(scheduler: Scheduler, webhook: WebHook):
+def make_handler(scheduler: Scheduler, webhook: WebHook, profiling: bool = False):
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
 
@@ -77,6 +77,22 @@ def make_handler(scheduler: Scheduler, webhook: WebHook):
                     self.wfile.write(body)
                 except Exception as e:  # pragma: no cover
                     self._reply(500, {"Error": str(e)})
+            elif self.path == "/version":
+                from vtpu.version import build_info
+
+                self._reply(200, build_info())
+            elif profiling and self.path == "/debug/threads":
+                # Python analog of pprof's goroutine dump (reference opt-in
+                # --profiling, cmd/scheduler/main.go:93-110)
+                import sys
+                import traceback
+
+                frames = sys._current_frames()
+                dump = {
+                    str(tid): "".join(traceback.format_stack(frame))
+                    for tid, frame in frames.items()
+                }
+                self._reply(200, dump)
             else:
                 self._reply(404, {"Error": "not found"})
 
@@ -118,8 +134,11 @@ class SchedulerServer:
         port: int = 9395,
         tls_cert: str = "",
         tls_key: str = "",
+        profiling: bool = False,
     ) -> None:
-        self.httpd = ThreadingHTTPServer((host, port), make_handler(scheduler, webhook))
+        self.httpd = ThreadingHTTPServer(
+            (host, port), make_handler(scheduler, webhook, profiling=profiling)
+        )
         if tls_cert and tls_key:
             ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
             ctx.load_cert_chain(tls_cert, tls_key)

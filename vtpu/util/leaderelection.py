@@ -1,0 +1,127 @@
+"""Leader election: lease-observer manager for active/passive scheduler pairs.
+
+Parity: reference pkg/util/leaderelection/leaderelection.go:57-208 -- the
+scheduler does NOT campaign here; an external elector (the controller-runtime
+manager in the reference, a sidecar or the k8s leader-elect machinery for us)
+owns the Lease. This manager only OBSERVES the Lease and answers is_leader()
+from the holder identity, with a dummy variant when election is disabled.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Optional
+
+from vtpu.util.k8sclient import KubeClient
+
+log = logging.getLogger(__name__)
+
+DEFAULT_LEASE_NS = "vtpu-system"
+DEFAULT_LEASE_NAME = "vtpu-scheduler"
+
+
+class LeaderManager:
+    """Watches a coordination.k8s.io Lease and reports whether *identity*
+    currently holds it. A vacant or expired lease counts as NOT leading
+    (fail-closed, like the reference's observer)."""
+
+    def __init__(
+        self,
+        client: KubeClient,
+        identity: str,
+        lease_namespace: str = DEFAULT_LEASE_NS,
+        lease_name: str = DEFAULT_LEASE_NAME,
+        poll_interval: float = 2.0,
+    ) -> None:
+        self.client = client
+        self.identity = identity
+        self.lease_namespace = lease_namespace
+        self.lease_name = lease_name
+        self.poll_interval = poll_interval
+        self._is_leader = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ----------------------------------------------------------------- state
+
+    def _holder(self) -> str:
+        lease = self.client.get_lease(self.lease_namespace, self.lease_name)
+        if not lease:
+            return ""
+        spec = lease.get("spec", {}) or {}
+        holder = spec.get("holderIdentity") or ""
+        # expired lease -> nobody leads (renewTime is epoch seconds in our
+        # fake; production adapters normalize RFC3339 to epoch on read)
+        renew = spec.get("renewTime")
+        duration = spec.get("leaseDurationSeconds")
+        if renew is not None and duration is not None:
+            try:
+                if float(renew) + float(duration) < time.time():
+                    return ""
+            except (TypeError, ValueError):
+                pass
+        return holder
+
+    def refresh(self) -> bool:
+        holder = self._holder()
+        now_leader = holder == self.identity
+        if now_leader != self._is_leader:
+            log.info(
+                "leader transition: %s (holder=%r identity=%r)",
+                "acquired" if now_leader else "lost", holder, self.identity,
+            )
+        self._is_leader = now_leader
+        return now_leader
+
+    def is_leader(self) -> bool:
+        return self._is_leader
+
+    # ----------------------------------------------------------------- loop
+
+    def start(self) -> None:
+        try:
+            self.refresh()
+        except Exception:
+            # start as non-leader and let the poll loop retry -- a transient
+            # API error at boot must not take the scheduler down
+            log.exception("initial lease refresh failed; starting as non-leader")
+        self._thread = threading.Thread(target=self._loop, daemon=True, name="leader-observer")
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.poll_interval):
+            try:
+                self.refresh()
+            except Exception:
+                log.exception("lease refresh failed; keeping last state")
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+class DummyLeaderManager:
+    """Always leads (election disabled -- reference NewDummyLeaderManager)."""
+
+    def is_leader(self) -> bool:
+        return True
+
+    def refresh(self) -> bool:
+        return True
+
+    def start(self) -> None:
+        pass
+
+    def stop(self) -> None:
+        pass
+
+
+def new_leader_manager(
+    client: KubeClient, enabled: bool, identity: str, **kw
+) -> LeaderManager | DummyLeaderManager:
+    if not enabled:
+        return DummyLeaderManager()
+    return LeaderManager(client, identity, **kw)

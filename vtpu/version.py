@@ -1,0 +1,18 @@
+"""Build/version info (reference pkg/version/version.go ldflags pattern;
+here overridable via environment at image build time)."""
+
+from __future__ import annotations
+
+import os
+
+VERSION = os.environ.get("VTPU_VERSION", "0.1.0")
+GIT_COMMIT = os.environ.get("VTPU_GIT_COMMIT", "unknown")
+BUILD_DATE = os.environ.get("VTPU_BUILD_DATE", "unknown")
+
+
+def build_info() -> dict[str, str]:
+    return {"version": VERSION, "gitCommit": GIT_COMMIT, "buildDate": BUILD_DATE}
+
+
+def version_string() -> str:
+    return f"vtpu {VERSION} (commit {GIT_COMMIT}, built {BUILD_DATE})"
