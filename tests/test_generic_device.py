@@ -142,6 +142,41 @@ def test_core_units_above_one_device_take_multiple_chips():
     assert (req.nums, req.coresreq) == (2, 100)
 
 
+def test_admission_count_matches_multi_chip_core_unit_ask():
+    b = GenericDevices(_cls(
+        cores_per_device=2,
+        resource_core_unit_name="google.com/tpu-v5p-tensorcore",
+    ))
+    pod = _pod(**{"google.com/tpu-v5p-tensorcore": "4"})
+    ctr = pod["spec"]["containers"][0]
+    assert b.mutate_admission(ctr, pod)
+    # injected count must equal what generate_resource_requests computes (2)
+    assert ctr["resources"]["limits"]["google.com/tpu-v5p"] == "2"
+
+
+def test_core_unit_quota_enforced():
+    from vtpu.device.quota import QuotaManager
+    from vtpu.device.registry import register_backend
+
+    quota = QuotaManager()
+    b = GenericDevices(_cls(
+        cores_per_device=2,
+        resource_core_unit_name="google.com/tpu-v5p-tensorcore",
+    ), quota=quota)
+    register_backend(b)
+    quota.refresh_managed_resources()
+    quota.add_quota({
+        "metadata": {"namespace": "default", "name": "q"},
+        "spec": {"hard": {"limits.google.com/tpu-v5p-tensorcore": "2"}},
+    })
+    # 2 chips x 100% x 2 cores/chip = 4 core-units > quota of 2
+    ok, _, reason = _fit(b, _usages(4), _pod(**{"google.com/tpu-v5p-tensorcore": "4"}))
+    assert not ok and common.ALLOCATED_POD_OVERQUOTA in reason
+    # 1 core (50% of one chip) fits
+    ok, _, reason = _fit(b, _usages(4), _pod(**{"google.com/tpu-v5p-tensorcore": "1"}))
+    assert ok, reason
+
+
 def test_quota_checked_against_template_rounded_values():
     from vtpu.device.quota import QuotaManager
     from vtpu.device.registry import register_backend

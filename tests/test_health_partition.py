@@ -1,0 +1,124 @@
+"""Health watcher + dynamic partition lock (reference rm/health.go +
+plugin/lock.go analogs)."""
+
+import os
+import time
+
+import pytest
+
+from vtpu.plugin import partition
+from vtpu.plugin.health import HealthWatcher
+from vtpu.plugin.rm import TpuChip, TpuResourceManager
+from vtpu.device.types import IciCoord
+
+
+def _rm(n=2):
+    chips = [
+        TpuChip(index=i, uuid=f"c{i}", devmem=16384, devcore=100,
+                type="TPU-v5e", numa=0, ici=IciCoord(i, 0, 0))
+        for i in range(n)
+    ]
+    return TpuResourceManager(chips, split_count=2)
+
+
+def test_shim_error_file_marks_unhealthy(tmp_path):
+    rm = _rm()
+    pushes = []
+    rm.on_health_change(lambda: pushes.append(1))
+    w = HealthWatcher(rm, hook_path=str(tmp_path), dev_dir=str(tmp_path / "dev"))
+    assert w.check_once() == {"c0": True, "c1": True}
+    (tmp_path / "health").mkdir()
+    (tmp_path / "health" / "c1.err").write_text("PJRT fatal")
+    assert w.check_once()["c1"] is False
+    assert rm.chip_by_uuid("c1").healthy is False
+    assert pushes  # ListAndWatch push fired
+    # recovery: watcher clears the sticky error, chip returns
+    w.clear_shim_error("c1")
+    assert w.check_once()["c1"] is True
+    assert rm.chip_by_uuid("c1").healthy is True
+
+
+def test_accel_file_vanishing_marks_unhealthy(tmp_path):
+    rm = _rm()
+    dev = tmp_path / "dev"
+    dev.mkdir()
+    (dev / "accel0").write_text("")
+    # accel1 missing while accel0 exists -> chip 1 unhealthy
+    w = HealthWatcher(rm, hook_path=str(tmp_path), dev_dir=str(dev))
+    result = w.check_once()
+    assert result["c0"] is True and result["c1"] is False
+
+
+def test_no_accel_files_at_all_is_healthy(tmp_path):
+    rm = _rm()
+    w = HealthWatcher(rm, hook_path=str(tmp_path), dev_dir=str(tmp_path / "nodev"))
+    assert all(w.check_once().values())
+
+
+def test_disable_env(tmp_path, monkeypatch):
+    monkeypatch.setenv("VTPU_DISABLE_HEALTHCHECKS", "all")
+    rm = _rm()
+    w = HealthWatcher(rm, hook_path=str(tmp_path))
+    assert w.check_once() == {}
+
+
+def test_partition_lock_roundtrip(tmp_path):
+    base = str(tmp_path)
+    assert not partition.lock_held(base)
+    partition.create_apply_lock(base)
+    assert partition.lock_held(base)
+    with pytest.raises(FileExistsError):
+        partition.create_apply_lock(base)
+    partition.release_apply_lock(base)
+    assert not partition.lock_held(base)
+
+
+def test_stale_lock_is_stolen(tmp_path):
+    base = str(tmp_path)
+    path = partition.create_apply_lock(base)
+    old = time.time() - 2 * partition.LOCK_STALE_SECONDS
+    os.utime(path, (old, old))
+    assert not partition.lock_held(base)  # monitor resumes past stale locks
+    partition.create_apply_lock(base)  # plugin steals it
+    assert partition.lock_held(base)
+
+
+def test_shim_error_auto_recovers_after_window(tmp_path):
+    rm = _rm()
+    w = HealthWatcher(rm, hook_path=str(tmp_path), dev_dir=str(tmp_path / "nodev"),
+                      recovery_seconds=30)
+    (tmp_path / "health").mkdir()
+    err = tmp_path / "health" / "c0.err"
+    err.write_text("PJRT fatal")
+    assert w.check_once()["c0"] is False
+    old = time.time() - 60
+    os.utime(err, (old, old))
+    assert w.check_once()["c0"] is True  # watcher GC'd the stale error
+    assert not err.exists()
+
+
+def test_explicit_shared_mode_overrides_exclusive_default(tmp_path):
+    rm = _rm()
+    # node default exclusive; repartition chip 0 back to shared
+    partition.apply_partitions(
+        rm, [partition.PartitionPlan(uuid="c0", mode="")], base=str(tmp_path)
+    )
+    infos = {d.id: d for d in rm.device_infos(mode="exclusive")}
+    assert infos["c0"].mode == ""  # explicitly shared wins over the default
+    assert infos["c1"].mode == "exclusive"  # unset inherits the default
+
+
+def test_apply_partitions_updates_mode_and_republishes(tmp_path):
+    rm = _rm()
+    pushes = []
+    rm.on_health_change(lambda: pushes.append(1))
+    partition.apply_partitions(
+        rm,
+        [partition.PartitionPlan(uuid="c0", mode="exclusive")],
+        base=str(tmp_path),
+    )
+    assert rm.chip_by_uuid("c0").mode == "exclusive"
+    infos = {d.id: d for d in rm.device_infos()}
+    assert infos["c0"].mode == "exclusive" and infos["c1"].mode == ""
+    assert pushes
+    assert not partition.lock_held(str(tmp_path))  # released on exit

@@ -38,6 +38,28 @@ def test_expired_lease_counts_as_vacant():
     assert mgr.refresh() is False
 
 
+def test_rfc3339_renew_time_expiry():
+    """Real API servers send RFC3339 renewTime; expiry must still enforce."""
+    import datetime
+
+    client = FakeKubeClient()
+    stale = (
+        datetime.datetime.now(datetime.timezone.utc) - datetime.timedelta(seconds=120)
+    ).isoformat().replace("+00:00", "Z")
+    client.put_lease(_lease("sched-a", renew=stale, duration=15))
+    mgr = LeaderManager(client, identity="sched-a")
+    assert mgr.refresh() is False
+    fresh = datetime.datetime.now(datetime.timezone.utc).isoformat().replace("+00:00", "Z")
+    client.put_lease(_lease("sched-a", renew=fresh, duration=15))
+    assert mgr.refresh() is True
+
+
+def test_unparseable_renew_time_fails_closed():
+    client = FakeKubeClient()
+    client.put_lease(_lease("sched-a", renew="garbage", duration=15))
+    assert LeaderManager(client, identity="sched-a").refresh() is False
+
+
 def test_dummy_manager_always_leads():
     assert isinstance(new_leader_manager(FakeKubeClient(), False, "x"), DummyLeaderManager)
     assert new_leader_manager(FakeKubeClient(), False, "x").is_leader()

@@ -131,8 +131,19 @@ class GenericDevices(Devices):
         if not has_count and not has_frac:
             return False
         if not has_count:
+            # default count must match what generate_resource_requests will
+            # compute, incl. multi-chip core-unit asks (ceil(units / cpd))
+            nums = 1
+            if cfg.resource_core_unit_name:
+                try:
+                    units = int(str(limits.get(cfg.resource_core_unit_name, 0)))
+                except (TypeError, ValueError):
+                    units = 0
+                cpd = max(1, cfg.cores_per_device)
+                if units > cpd:
+                    nums = -(-units // cpd)
             res = container.setdefault("resources", {})
-            res.setdefault("limits", {})[cfg.resource_count_name] = "1"
+            res.setdefault("limits", {})[cfg.resource_count_name] = str(nums)
         if cfg.qos:
             policy = pod_annotations(pod).get(QOS_POLICY_ANNO, "")
             if policy:
@@ -309,8 +320,13 @@ class GenericDevices(Devices):
             resolved = [self._resolve(d, request) for d in chosen]
             memsum = sum(m for m, _ in resolved)
             coresum = sum(c for _, c in resolved)
+            cpd = max(1, cfg.cores_per_device)
+            unit_sum = sum(max(1, c * cpd // 100) for _, c in resolved) if (
+                cfg.resource_core_unit_name
+            ) else 0
             if not self.quota.fit_quota(
-                ns, cfg.common_word, memsum, coresum, count=request.nums
+                ns, cfg.common_word, memsum, coresum, count=request.nums,
+                core_units=unit_sum,
             ):
                 reasons[common.ALLOCATED_POD_OVERQUOTA] += 1
                 return False, {}, common.gen_reason(reasons, len(devices))

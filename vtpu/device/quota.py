@@ -91,6 +91,8 @@ class QuotaManager:
         # resource name -> (vendor, role) so usage can be attributed; populated
         # from the registry by refresh_managed_resources().
         self._managed: dict[str, tuple[str, str]] = {}
+        # vendor -> physical cores per device (for coreUnit-role accounting)
+        self._cores_per_device: dict[str, int] = {}
 
     # ---------------------------------------------------------------- registry
 
@@ -99,9 +101,13 @@ class QuotaManager:
 
         with self._lock:
             self._managed.clear()
+            self._cores_per_device.clear()
             for word, dev in DEVICES_MAP.items():
                 for role, res in dev.resource_names().items():
                     self._managed[res] = (word, role)
+                cfg = getattr(dev, "config", None)
+                cpd = getattr(cfg, "cores_per_device", 1) if cfg else 1
+                self._cores_per_device[word] = max(1, int(cpd))
             # Quotas observed before the registry existed parse to nothing;
             # re-parse every raw spec now that roles are known.
             for entry in self._ns.values():
@@ -154,7 +160,13 @@ class QuotaManager:
     # ---------------------------------------------------------------- checks
 
     def fit_quota(
-        self, namespace: str, vendor: str, memreq: int, coresreq: int, count: int = 0
+        self,
+        namespace: str,
+        vendor: str,
+        memreq: int,
+        coresreq: int,
+        count: int = 0,
+        core_units: int = 0,
     ) -> bool:
         """Would this additional usage stay within the namespace quota?
         (reference FitQuota; called from vendor Fit paths)."""
@@ -174,6 +186,8 @@ class QuotaManager:
                     add = coresreq
                 elif role == "count":
                     add = count
+                elif role == "coreUnit":
+                    add = core_units
                 else:
                     add = 0
                 if add and entry.used.get(res, 0) + add > limits[res]:
@@ -196,6 +210,11 @@ class QuotaManager:
                             usage[res] = usage.get(res, 0) + dev.usedcores
                         elif role == "count":
                             usage[res] = usage.get(res, 0) + 1
+                        elif role == "coreUnit":
+                            cpd = self._cores_per_device.get(word, 1)
+                            usage[res] = usage.get(res, 0) + max(
+                                1, dev.usedcores * cpd // 100
+                            )
         return usage
 
     def add_usage(self, pod: dict, devices: PodDevices) -> None:

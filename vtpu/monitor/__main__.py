@@ -77,7 +77,13 @@ def main() -> None:
     start_http_server(args.metrics_port)
     logging.info("vtpu-monitor metrics on :%d, watching %s", args.metrics_port,
                  args.hook_path)
-    FeedbackLoop(lister, interval=args.feedback_interval).run_forever()
+    from vtpu.plugin.partition import lock_held
+
+    # pause while the plugin repartitions chips (reference MIG-apply lock,
+    # cmd/vGPUmonitor/main.go:101-116)
+    FeedbackLoop(lister, interval=args.feedback_interval).run_forever(
+        pause_check=lock_held
+    )
 
 
 if __name__ == "__main__":

@@ -73,6 +73,11 @@ def main() -> None:
     registrar = Registrar(client, rm, args.node_name, mode=args.mode)
     registrar.start_background(args.register_interval)
 
+    from vtpu.plugin.health import HealthWatcher
+
+    health = HealthWatcher(rm, hook_path=args.hook_path)
+    health.start()
+
     config = PluginConfig(
         resource_name=args.resource_name,
         node_name=args.node_name,

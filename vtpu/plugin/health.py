@@ -1,0 +1,142 @@
+"""TPU chip health watcher: the XID-event-loop analog for the node agent.
+
+Parity: reference rm/health.go:60-203 -- an NVML XID event loop marks devices
+Unhealthy and pushes a ListAndWatch update, skipping application-caused XIDs
+and honoring DP_DISABLE_HEALTHCHECKS. TPUs expose no XID stream; the portable
+liveness signals on a TPU VM are:
+
+- the accelerator device files (``/dev/accel<N>`` / ``/dev/vfio``) vanishing
+  or losing rw access (driver wedge, host maintenance event), and
+- a sticky per-chip error file the libvtpu shim writes on fatal PJRT errors
+  (``<hook>/health/<uuid>.err``), the moral equivalent of a hardware XID --
+  libvtpu can't clear it, only the watcher GCs it once the chip checks out.
+
+``VTPU_DISABLE_HEALTHCHECKS=all`` (or a comma list containing ``accel`` /
+``shim``) disables classes of checks, mirroring the reference env knob.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+from vtpu.plugin.rm import TpuResourceManager
+
+log = logging.getLogger(__name__)
+
+DISABLE_ENV = "VTPU_DISABLE_HEALTHCHECKS"
+
+
+class HealthWatcher:
+    """Polls chip liveness signals and flips rm health (which triggers the
+    plugin's ListAndWatch push via rm.on_health_change)."""
+
+    def __init__(
+        self,
+        rm: TpuResourceManager,
+        hook_path: str = "/usr/local/vtpu",
+        dev_dir: str = "/dev",
+        interval: float = 5.0,
+        recovery_seconds: float = 60.0,
+        probe: Optional[Callable[[str, int], bool]] = None,
+    ) -> None:
+        self.rm = rm
+        self.hook_path = hook_path
+        self.dev_dir = dev_dir
+        self.interval = interval
+        self.recovery_seconds = recovery_seconds
+        self._probe = probe  # test hook: (uuid, index) -> healthy
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        disabled = os.environ.get(DISABLE_ENV, "")
+        self.disabled = {d.strip() for d in disabled.split(",") if d.strip()}
+
+    # --------------------------------------------------------------- checks
+
+    def _accel_ok(self, index: int) -> bool:
+        """Device-file presence check; vacuously healthy when the node does
+        not expose per-chip accel files (CI, mock clusters)."""
+        path = os.path.join(self.dev_dir, f"accel{index}")
+        if not os.path.exists(path):
+            # distinguish "no accel files at all" (mock env -> healthy) from
+            # "chip N's file vanished while others remain" (unhealthy)
+            any_accel = any(
+                e.startswith("accel") for e in _safe_listdir(self.dev_dir)
+            )
+            return not any_accel
+        return os.access(path, os.R_OK | os.W_OK)
+
+    def _shim_ok(self, uuid: str) -> bool:
+        """Sticky shim error; the watcher GCs it after RECOVERY_SECONDS so a
+        transient PJRT fatal doesn't bench the chip forever (a chip that keeps
+        faulting gets re-marked on the next error)."""
+        err = os.path.join(self.hook_path, "health", f"{uuid}.err")
+        try:
+            age = time.time() - os.stat(err).st_mtime
+        except FileNotFoundError:
+            return True
+        if age > self.recovery_seconds:
+            self.clear_shim_error(uuid)
+            return True
+        return False
+
+    def clear_shim_error(self, uuid: str) -> None:
+        try:
+            os.unlink(os.path.join(self.hook_path, "health", f"{uuid}.err"))
+        except FileNotFoundError:
+            pass
+
+    def check_once(self) -> dict[str, bool]:
+        """One sweep; returns uuid -> healthy and applies it to the rm."""
+        if "all" in self.disabled:
+            return {}
+        result: dict[str, bool] = {}
+        for chip in self.rm.chips:
+            healthy = True
+            if self._probe is not None:
+                healthy = self._probe(chip.uuid, chip.index)
+            else:
+                if "accel" not in self.disabled:
+                    healthy = healthy and self._accel_ok(chip.index)
+                if "shim" not in self.disabled:
+                    healthy = healthy and self._shim_ok(chip.uuid)
+            result[chip.uuid] = healthy
+            if healthy != chip.healthy:
+                log.warning(
+                    "chip %s health %s -> %s", chip.uuid, chip.healthy, healthy
+                )
+                self.rm.set_health(chip.uuid, healthy)
+        return result
+
+    # ----------------------------------------------------------------- loop
+
+    def start(self) -> None:
+        if "all" in self.disabled:
+            log.info("health checks disabled via %s", DISABLE_ENV)
+            return
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="tpu-health-watcher"
+        )
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.check_once()
+            except Exception:
+                log.exception("health sweep failed")
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+def _safe_listdir(path: str) -> list[str]:
+    try:
+        return os.listdir(path)
+    except OSError:
+        return []

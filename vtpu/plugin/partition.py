@@ -1,0 +1,107 @@
+"""Dynamic chip repartitioning with a monitor-coordination lock.
+
+Parity: reference dynamic MIG (plugin/server.go:960-1002, plugin/lock.go,
+docs/develop/dynamic-mig.md) -- the plugin rewrites device geometry to match
+the scheduled template and takes ``/tmp/hami/hami-mig-apply.lock`` so the
+monitor stops touching shared regions mid-apply.
+
+TPUs have no MIG; the analog is switching a chip between operating modes
+(shared time-slice <-> exclusive <-> a partition template that pins HBM/core
+fractions per tenant slot). The apply itself is just node-agent state (the
+enforcement lives in libvtpu's per-container limits), but the lock protocol
+and the re-register after apply are identical in shape.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from dataclasses import dataclass
+
+from vtpu.plugin.rm import TpuResourceManager
+
+log = logging.getLogger(__name__)
+
+LOCK_DIR = "/tmp/vtpu"
+LOCK_FILE = "partition-apply.lock"
+LOCK_STALE_SECONDS = 300.0
+
+
+def lock_path(base: str = LOCK_DIR) -> str:
+    return os.path.join(base, LOCK_FILE)
+
+
+def create_apply_lock(base: str = LOCK_DIR) -> str:
+    """Take the apply lock (reference CreateMigApplyLock). Stale locks from a
+    crashed apply are stolen after LOCK_STALE_SECONDS."""
+    os.makedirs(base, exist_ok=True)
+    path = lock_path(base)
+    try:
+        fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        os.write(fd, str(os.getpid()).encode())
+        os.close(fd)
+        return path
+    except FileExistsError:
+        age = time.time() - os.stat(path).st_mtime
+        if age > LOCK_STALE_SECONDS:
+            # Atomic steal: rename the stale file aside first. Only one
+            # stealer's rename succeeds (the loser gets FileNotFoundError and
+            # retries against whatever fresh lock the winner created), so a
+            # racing stealer can never unlink the winner's new lock.
+            stale = f"{path}.stale-{os.getpid()}"
+            log.warning("stealing stale partition lock (age %.0fs)", age)
+            try:
+                os.rename(path, stale)
+            except FileNotFoundError:
+                return create_apply_lock(base)
+            os.unlink(stale)
+            return create_apply_lock(base)
+        raise
+
+
+def release_apply_lock(base: str = LOCK_DIR) -> None:
+    try:
+        os.unlink(lock_path(base))
+    except FileNotFoundError:
+        pass
+
+
+def lock_held(base: str = LOCK_DIR) -> bool:
+    """Monitor-side check (reference WatchLockFile): pause while held."""
+    path = lock_path(base)
+    if not os.path.exists(path):
+        return False
+    if time.time() - os.stat(path).st_mtime > LOCK_STALE_SECONDS:
+        return False  # stale lock: monitor resumes rather than hanging forever
+    return True
+
+
+@dataclass
+class PartitionPlan:
+    """Target mode for one chip."""
+
+    uuid: str
+    mode: str  # "" (shared) | "exclusive" | template name
+
+
+def apply_partitions(
+    rm: TpuResourceManager, plans: list[PartitionPlan], base: str = LOCK_DIR
+) -> None:
+    """Apply mode changes under the lock, then bump rm so the register loop
+    publishes the new geometry (reference processMigConfigs/ApplyMigTemplate)."""
+    if not plans:
+        return
+    create_apply_lock(base)
+    try:
+        for plan in plans:
+            chip = rm.chip_by_uuid(plan.uuid)
+            if chip is None:
+                log.warning("partition plan for unknown chip %s", plan.uuid)
+                continue
+            if chip.mode != plan.mode:
+                log.info("chip %s mode %r -> %r", plan.uuid, chip.mode, plan.mode)
+                chip.mode = plan.mode
+        rm.notify_health_change()  # reuse the ListAndWatch push channel
+    finally:
+        release_apply_lock(base)

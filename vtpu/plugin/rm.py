@@ -50,6 +50,11 @@ class TpuChip:
     ici: IciCoord
     device_paths: list[str] = field(default_factory=list)
     healthy: bool = True
+    # per-chip operating mode set by dynamic repartitioning (plugin/partition
+    # .py): None inherits the plugin's default; "" is EXPLICITLY shared (so a
+    # repartition can return a chip to shared on an exclusive-default node);
+    # else "exclusive" or a partition-template name
+    mode: Optional[str] = None
 
 
 def _accelerator_type() -> str:
@@ -169,7 +174,7 @@ class TpuResourceManager:
                     numa=c.numa,
                     health=c.healthy,
                     ici=c.ici,
-                    mode=mode,
+                    mode=c.mode if c.mode is not None else mode,
                     index=c.index,
                 )
                 for c in self.chips
@@ -188,5 +193,10 @@ class TpuResourceManager:
                     chip.healthy = healthy
                     changed = True
         if changed:
-            for fn in list(self._health_listeners):
-                fn()
+            self.notify_health_change()
+
+    def notify_health_change(self) -> None:
+        """Push a ListAndWatch refresh to every subscriber (also used by
+        dynamic repartitioning to publish new geometry)."""
+        for fn in list(self._health_listeners):
+            fn()

@@ -22,6 +22,22 @@ DEFAULT_LEASE_NS = "vtpu-system"
 DEFAULT_LEASE_NAME = "vtpu-scheduler"
 
 
+def _to_epoch(ts) -> Optional[float]:
+    """Epoch seconds from either a number or an RFC3339 string; None if
+    unparseable."""
+    try:
+        return float(ts)
+    except (TypeError, ValueError):
+        pass
+    try:
+        from datetime import datetime
+
+        s = str(ts).replace("Z", "+00:00")
+        return datetime.fromisoformat(s).timestamp()
+    except (TypeError, ValueError):
+        return None
+
+
 class LeaderManager:
     """Watches a coordination.k8s.io Lease and reports whether *identity*
     currently holds it. A vacant or expired lease counts as NOT leading
@@ -52,16 +68,19 @@ class LeaderManager:
             return ""
         spec = lease.get("spec", {}) or {}
         holder = spec.get("holderIdentity") or ""
-        # expired lease -> nobody leads (renewTime is epoch seconds in our
-        # fake; production adapters normalize RFC3339 to epoch on read)
+        # expired lease -> nobody leads. renewTime is epoch seconds from the
+        # fake client and RFC3339 (e.g. 2026-07-29T10:00:00.000000Z) from the
+        # real API; an unparseable renewTime counts as expired (fail closed).
         renew = spec.get("renewTime")
         duration = spec.get("leaseDurationSeconds")
         if renew is not None and duration is not None:
+            renew_epoch = _to_epoch(renew)
             try:
-                if float(renew) + float(duration) < time.time():
-                    return ""
+                dur = float(duration)
             except (TypeError, ValueError):
-                pass
+                return ""
+            if renew_epoch is None or renew_epoch + dur < time.time():
+                return ""
         return holder
 
     def refresh(self) -> bool:
