@@ -1,0 +1,87 @@
+"""Streaming TTFT benchmark client.
+
+Parity: reference benchmarks/ai-benchmark/benchmark.py — N warmup requests,
+then M timed requests against a streaming endpoint; per-request TTFT is the
+wall time from request start to the first streamed token, per-token latency
+the mean gap between subsequent tokens. One JSON object per timed request is
+appended to --out (JSONL), which report.py aggregates.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import sys
+import time
+import urllib.request
+
+
+def one_request(url: str, prompt_len: int, max_tokens: int) -> dict:
+    body = json.dumps({"prompt_len": prompt_len, "max_tokens": max_tokens}).encode()
+    req = urllib.request.Request(
+        f"{url}/generate", data=body, headers={"Content-Type": "application/json"}
+    )
+    start = time.monotonic()
+    ttft = None
+    stamps: list[float] = []
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        for raw in resp:
+            if not raw.startswith(b"data: "):
+                continue
+            now = time.monotonic()
+            if ttft is None:
+                ttft = now - start
+            stamps.append(now)
+    gaps = [b - a for a, b in zip(stamps, stamps[1:])]
+    return {
+        "ttft_ms": (ttft or 0.0) * 1e3,
+        "tokens": len(stamps),
+        "per_token_ms": statistics.mean(gaps) * 1e3 if gaps else 0.0,
+        "total_ms": (stamps[-1] - start) * 1e3 if stamps else 0.0,
+        "ts": time.time(),
+    }
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser("ttft-benchmark")
+    parser.add_argument("--url", default="http://127.0.0.1:8100")
+    parser.add_argument("--warmup", type=int, default=30)
+    parser.add_argument("--runs", type=int, default=200)
+    parser.add_argument("--prompt-len", type=int, default=1024)
+    parser.add_argument("--max-tokens", type=int, default=16)
+    parser.add_argument("--interval", type=float, default=0.0,
+                        help="seconds between request starts (0 = back to back)")
+    parser.add_argument("--out", default="ttft.jsonl")
+    parser.add_argument("--label", default="")
+    args = parser.parse_args()
+
+    for i in range(args.warmup):
+        one_request(args.url, args.prompt_len, args.max_tokens)
+        print(f"warmup {i + 1}/{args.warmup}", end="\r", file=sys.stderr)
+    print(file=sys.stderr)
+
+    samples = []
+    with open(args.out, "a") as out:
+        for i in range(args.runs):
+            t0 = time.monotonic()
+            sample = one_request(args.url, args.prompt_len, args.max_tokens)
+            sample["label"] = args.label
+            samples.append(sample)
+            out.write(json.dumps(sample) + "\n")
+            out.flush()
+            print(f"run {i + 1}/{args.runs}: ttft={sample['ttft_ms']:.1f}ms",
+                  end="\r", file=sys.stderr)
+            if args.interval:
+                time.sleep(max(0.0, args.interval - (time.monotonic() - t0)))
+    print(file=sys.stderr)
+
+    ttfts = sorted(s["ttft_ms"] for s in samples)
+    p50 = statistics.median(ttfts)
+    p99 = ttfts[min(len(ttfts) - 1, int(len(ttfts) * 0.99))]
+    print(json.dumps({"runs": len(samples), "p50_ttft_ms": round(p50, 2),
+                      "p99_ttft_ms": round(p99, 2), "out": args.out}))
+
+
+if __name__ == "__main__":
+    main()

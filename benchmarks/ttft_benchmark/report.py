@@ -1,0 +1,70 @@
+"""Compare two TTFT JSONL runs (shared arm vs exclusive baseline).
+
+Parity: reference benchmarks report generator — aggregates both arms'
+JSONL, prints a table of p50/p90/p99 TTFT and per-token latency, and the
+headline p50 degradation percent (north star: < 5% for 4-way sharing).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import sys
+
+
+def load(path: str) -> list[dict]:
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def pct(sorted_vals: list[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    return sorted_vals[min(len(sorted_vals) - 1, int(len(sorted_vals) * q))]
+
+
+def stats(samples: list[dict]) -> dict:
+    ttfts = sorted(s["ttft_ms"] for s in samples)
+    per_tok = sorted(s["per_token_ms"] for s in samples)
+    return {
+        "runs": len(samples),
+        "p50_ttft_ms": statistics.median(ttfts) if ttfts else 0.0,
+        "p90_ttft_ms": pct(ttfts, 0.90),
+        "p99_ttft_ms": pct(ttfts, 0.99),
+        "p50_per_token_ms": statistics.median(per_tok) if per_tok else 0.0,
+    }
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser("ttft-report")
+    parser.add_argument("--baseline", required=True, help="exclusive-arm JSONL")
+    parser.add_argument("--candidate", required=True, help="shared-arm JSONL")
+    parser.add_argument("--target-pct", type=float, default=5.0)
+    args = parser.parse_args()
+
+    base = stats(load(args.baseline))
+    cand = stats(load(args.candidate))
+    if not base["runs"] or not cand["runs"]:
+        sys.exit("empty sample file")
+
+    rows = [("", "exclusive", "shared")]
+    for key in ("runs", "p50_ttft_ms", "p90_ttft_ms", "p99_ttft_ms", "p50_per_token_ms"):
+        rows.append((key, f"{base[key]:.2f}" if isinstance(base[key], float) else str(base[key]),
+                     f"{cand[key]:.2f}" if isinstance(cand[key], float) else str(cand[key])))
+    width = max(len(r[0]) for r in rows) + 2
+    for r in rows:
+        print(f"{r[0]:<{width}}{r[1]:>12}{r[2]:>12}", file=sys.stderr)
+
+    degradation = (cand["p50_ttft_ms"] - base["p50_ttft_ms"]) / base["p50_ttft_ms"] * 100.0
+    print(json.dumps({
+        "metric": "p50_ttft_degradation",
+        "value": round(degradation, 2),
+        "unit": "percent",
+        "vs_baseline": round(degradation / args.target_pct, 3),
+        "pass": degradation < args.target_pct,
+    }))
+
+
+if __name__ == "__main__":
+    main()

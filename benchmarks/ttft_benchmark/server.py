@@ -1,0 +1,145 @@
+"""Minimal streaming JAX inference server for the TTFT benchmark.
+
+Serves the flagship vtpu.models transformer. POST /generate with
+``{"prompt_len": N, "max_tokens": M}`` streams one line per generated token
+(`data: {"token": t, "ts": server_time}`) so the client can timestamp the
+first token, mirroring the reference's vLLM streaming benchmark server shape
+(reference benchmarks/ai-benchmark/benchmark.py client contract).
+
+When launched inside a vtpu-scheduled pod, libvtpu caps this process's HBM
+and TensorCore duty per the pod's fractional ask — no server-side changes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+# runnable as a plain script (the deployment Jobs do `python .../server.py`):
+# put the repo root on sys.path so `vtpu` imports without an install
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+log = logging.getLogger("ttft-server")
+
+
+class Engine:
+    """Compiled prefill + decode over the benchmark model."""
+
+    def __init__(self, preset: str = "auto"):
+        import jax
+        import jax.numpy as jnp
+
+        from vtpu.models import ModelConfig, decode_step, init_params, prefill
+
+        if preset == "tpu" or (preset == "auto" and jax.default_backend() == "tpu"):
+            cfg = ModelConfig(
+                vocab=8192, d_model=1024, n_heads=8, n_layers=12, d_ff=4096,
+                max_seq=1280, head_dim=128, dtype=jnp.bfloat16, use_pallas=True,
+            )
+        else:
+            cfg = ModelConfig(
+                vocab=512, d_model=128, n_heads=4, n_layers=2, d_ff=256,
+                max_seq=160, head_dim=32, dtype=jnp.float32, use_pallas=False,
+            )
+        self.cfg = cfg
+        self.jax = jax
+        self.jnp = jnp
+        self.params = jax.jit(lambda k: init_params(k, cfg))(jax.random.key(0))
+        jax.block_until_ready(self.params)
+
+        @jax.jit
+        def _prefill(params, tokens):
+            logits, cache = prefill(params, cfg, tokens)
+            return jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32), cache
+
+        @jax.jit
+        def _decode(params, cache, token):
+            logits, cache = decode_step(params, cfg, cache, token)
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+
+        self._prefill = _prefill
+        self._decode = _decode
+        self._lock = threading.Lock()  # one model, serialized like a batch=1 engine
+        # warm the compile caches so the first request isn't a compile
+        self.generate(min(16, cfg.max_seq // 2), 2)
+
+    def generate(self, prompt_len: int, max_tokens: int):
+        """Yield (token_id, monotonic_ts) per generated token."""
+        prompt_len = max(1, min(prompt_len, self.cfg.max_seq - max_tokens - 1))
+        tokens = self.jax.random.randint(
+            self.jax.random.key(int(time.time() * 1e3) % (2**31)),
+            (1, prompt_len), 0, self.cfg.vocab, self.jnp.int32,
+        )
+        with self._lock:
+            first, cache = self._prefill(self.params, tokens)
+            yield int(first[0]), time.monotonic()
+            token = first
+            for _ in range(max_tokens - 1):
+                token, cache = self._decode(self.params, cache, token)
+                yield int(token[0]), time.monotonic()
+
+
+def make_handler(engine: Engine):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):
+            log.debug(fmt, *args)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self.send_response(200)
+                self.end_headers()
+                self.wfile.write(b"ok")
+            else:
+                self.send_response(404)
+                self.end_headers()
+
+        def do_POST(self):
+            if self.path != "/generate":
+                self.send_response(404)
+                self.end_headers()
+                return
+            length = int(self.headers.get("Content-Length", 0))
+            req = json.loads(self.rfile.read(length) or b"{}")
+            prompt_len = int(req.get("prompt_len", 128))
+            max_tokens = int(req.get("max_tokens", 16))
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.end_headers()
+            for token, ts in engine.generate(prompt_len, max_tokens):
+                line = json.dumps({"token": token, "ts": ts})
+                self.wfile.write(f"data: {line}\n".encode())
+                self.wfile.flush()
+
+    return Handler
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser("ttft-server")
+    parser.add_argument("--port", type=int, default=8100)
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--preset", default="auto", choices=["auto", "tpu", "cpu"])
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    if args.preset == "cpu":
+        # env vars are read too early when a sitecustomize imports jax at
+        # interpreter start; go through jax.config like tests/conftest.py
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    engine = Engine(args.preset)
+    httpd = ThreadingHTTPServer((args.host, args.port), make_handler(engine))
+    log.info("ttft server on :%d (model d=%d L=%d)", args.port,
+             engine.cfg.d_model, engine.cfg.n_layers)
+    httpd.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

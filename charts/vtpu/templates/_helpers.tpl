@@ -1,0 +1,37 @@
+{{- define "vtpu.name" -}}
+{{- default .Chart.Name .Values.nameOverride | trunc 63 | trimSuffix "-" -}}
+{{- end -}}
+
+{{- define "vtpu.fullname" -}}
+{{- if .Values.fullnameOverride -}}
+{{- .Values.fullnameOverride | trunc 63 | trimSuffix "-" -}}
+{{- else -}}
+{{- printf "%s-%s" .Release.Name (include "vtpu.name" .) | trunc 63 | trimSuffix "-" -}}
+{{- end -}}
+{{- end -}}
+
+{{- define "vtpu.labels" -}}
+helm.sh/chart: {{ printf "%s-%s" .Chart.Name .Chart.Version }}
+app.kubernetes.io/name: {{ include "vtpu.name" . }}
+app.kubernetes.io/instance: {{ .Release.Name }}
+app.kubernetes.io/version: {{ .Chart.AppVersion }}
+app.kubernetes.io/managed-by: {{ .Release.Service }}
+{{- end -}}
+
+{{- define "vtpu.image" -}}
+{{- $registry := .Values.global.imageRegistry -}}
+{{- $tag := default .Chart.AppVersion .Values.image.tag -}}
+{{- if $registry -}}
+{{- printf "%s/%s:%s" $registry .Values.image.repository $tag -}}
+{{- else -}}
+{{- printf "%s:%s" .Values.image.repository $tag -}}
+{{- end -}}
+{{- end -}}
+
+{{- define "vtpu.scheduler.fullname" -}}
+{{- printf "%s-scheduler" (include "vtpu.fullname" .) -}}
+{{- end -}}
+
+{{- define "vtpu.devicePlugin.fullname" -}}
+{{- printf "%s-device-plugin" (include "vtpu.fullname" .) -}}
+{{- end -}}

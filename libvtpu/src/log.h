@@ -24,6 +24,29 @@ inline int log_level() {
     }                                                                 \
   } while (0)
 
+namespace vtpu {
+
+// Fatal-health reporting: append the message to $VTPU_HEALTH_FILE (set by the
+// device plugin to a file inside the container's rw cache mount). The node
+// agent's HealthWatcher promotes these markers to chip Unhealthy in
+// ListAndWatch — the XID-event analog for a wedged PJRT stack.
+inline void report_fatal_health(const char* msg) {
+  const char* path = std::getenv("VTPU_HEALTH_FILE");
+  if (path == nullptr || path[0] == '\0') return;
+  std::FILE* f = std::fopen(path, "a");
+  if (f == nullptr) return;
+  std::fprintf(f, "%s\n", msg);
+  std::fclose(f);
+}
+
+}  // namespace vtpu
+
+#define VTPU_FATAL_HEALTH(msg_literal, fmt, ...)        \
+  do {                                                  \
+    vtpu::report_fatal_health(msg_literal);             \
+    VTPU_LOG(1, "ERROR: " fmt, ##__VA_ARGS__);          \
+  } while (0)
+
 #define VTPU_ERR(fmt, ...) VTPU_LOG(1, "ERROR: " fmt, ##__VA_ARGS__)
 #define VTPU_WARN(fmt, ...) VTPU_LOG(1, "WARN: " fmt, ##__VA_ARGS__)
 #define VTPU_INFO(fmt, ...) VTPU_LOG(2, fmt, ##__VA_ARGS__)

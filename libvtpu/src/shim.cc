@@ -310,6 +310,38 @@ PJRT_Error* wrapped_client_create(PJRT_Client_Create_Args* args) {
   PJRT_Error* err = s.real->PJRT_Client_Create(args);
   if (err == nullptr && args->client != nullptr) {
     refresh_device_map(args->client);
+  } else if (err != nullptr) {
+    // Only infrastructure-class failures are health events; app-caused ones
+    // (bad options, double init -> INVALID_ARGUMENT/FAILED_PRECONDITION/...)
+    // must not bench a shared chip for every tenant (reference rm/health.go
+    // skipping application-caused XIDs 13/31/43/45/68).
+    PJRT_Error_GetCode_Args code_args;
+    std::memset(&code_args, 0, sizeof(code_args));
+    code_args.struct_size = PJRT_Error_GetCode_Args_STRUCT_SIZE;
+    code_args.error = err;
+    PJRT_Error* code_err = s.real->PJRT_Error_GetCode(&code_args);
+    PJRT_Error_Code code =
+        code_err == nullptr ? code_args.code : PJRT_Error_Code_UNKNOWN;
+    if (code_err != nullptr) {
+      PJRT_Error_Destroy_Args destroy;
+      std::memset(&destroy, 0, sizeof(destroy));
+      destroy.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+      destroy.error = code_err;
+      s.real->PJRT_Error_Destroy(&destroy);
+    }
+    switch (code) {
+      case PJRT_Error_Code_UNKNOWN:
+      case PJRT_Error_Code_DEADLINE_EXCEEDED:
+      case PJRT_Error_Code_INTERNAL:
+      case PJRT_Error_Code_UNAVAILABLE:
+      case PJRT_Error_Code_DATA_LOSS:
+        // A wedged chip shows up here first (the XID analog).
+        report_fatal_health("PJRT_Client_Create failed (infrastructure)");
+        break;
+      default:
+        VTPU_WARN("PJRT_Client_Create failed with app-level code %d", (int)code);
+        break;
+    }
   }
   return err;
 }
@@ -552,12 +584,14 @@ const PJRT_Api* GetPjrtApi() {
     if (path == nullptr) path = "/lib/libtpu.so";
     void* handle = dlopen(path, RTLD_NOW | RTLD_LOCAL);
     if (handle == nullptr) {
-      VTPU_ERR("cannot dlopen real plugin %s: %s", path, dlerror());
+      VTPU_FATAL_HEALTH("dlopen real PJRT plugin failed",
+                        "cannot dlopen real plugin %s: %s", path, dlerror());
       return nullptr;
     }
     auto fn = (GetPjrtApiFn)dlsym(handle, "GetPjrtApi");
     if (fn == nullptr) {
-      VTPU_ERR("no GetPjrtApi in %s", path);
+      VTPU_FATAL_HEALTH("real PJRT plugin exports no GetPjrtApi",
+                        "no GetPjrtApi in %s", path);
       return nullptr;
     }
     return vtpu::wrap_api(fn());

@@ -25,7 +25,7 @@ def test_shim_error_file_marks_unhealthy(tmp_path):
     rm = _rm()
     pushes = []
     rm.on_health_change(lambda: pushes.append(1))
-    w = HealthWatcher(rm, hook_path=str(tmp_path), dev_dir=str(tmp_path / "dev"))
+    w = HealthWatcher(rm, hook_path=str(tmp_path))
     assert w.check_once() == {"c0": True, "c1": True}
     (tmp_path / "health").mkdir()
     (tmp_path / "health" / "c1.err").write_text("PJRT fatal")
@@ -38,20 +38,69 @@ def test_shim_error_file_marks_unhealthy(tmp_path):
     assert rm.chip_by_uuid("c1").healthy is True
 
 
-def test_accel_file_vanishing_marks_unhealthy(tmp_path):
+def test_container_fatal_marker_promotes_to_chip_unhealthy(tmp_path):
+    """libvtpu writes $VTPU_HEALTH_FILE in its cache mount; the watcher maps
+    it to the container's chips and benches them."""
+    rm = _rm()
+    region_dir = tmp_path / "containers" / "poduid_main"
+    region_dir.mkdir(parents=True)
+    (region_dir / "chips").write_text("c1")
+    (region_dir / "health.err").write_text("PJRT_Client_Create failed\n")
+    w = HealthWatcher(rm, hook_path=str(tmp_path))
+    result = w.check_once()
+    assert result["c0"] is True and result["c1"] is False
+    # the container report was consumed into a sticky marker
+    assert not (region_dir / "health.err").exists()
+    assert (tmp_path / "health" / "c1.err").read_text().startswith("PJRT_Client_Create")
+    # recovery: marker ages out
+    import os as _os
+    old = time.time() - 120
+    _os.utime(tmp_path / "health" / "c1.err", (old, old))
+    w.recovery_seconds = 60
+    assert w.check_once()["c1"] is True
+
+
+def test_libvtpu_writes_health_file_on_fatal(libvtpu_build, tmp_path):
+    """C-level producer: a broken real plugin makes the shim append to
+    $VTPU_HEALTH_FILE."""
+    import subprocess
+
+    health = tmp_path / "health.err"
+    env = dict(os.environ)
+    env.update({
+        "VTPU_REAL_LIBTPU": "/nonexistent/libtpu.so",
+        "VTPU_HEALTH_FILE": str(health),
+    })
+    r = subprocess.run(
+        [str(libvtpu_build / "pjrt_smoke"), str(libvtpu_build / "libvtpu.so"),
+         "16", "1", "0"],
+        env=env, capture_output=True, text=True,
+    )
+    assert r.returncode != 0  # no usable PJRT api
+    assert health.exists()
+    assert "dlopen real PJRT plugin failed" in health.read_text()
+
+
+def test_device_file_vanishing_marks_unhealthy(tmp_path):
+    """Covers both /dev/accel* and /dev/vfio/* layouts: the watcher checks the
+    chip's own recorded device nodes."""
     rm = _rm()
     dev = tmp_path / "dev"
     dev.mkdir()
     (dev / "accel0").write_text("")
-    # accel1 missing while accel0 exists -> chip 1 unhealthy
-    w = HealthWatcher(rm, hook_path=str(tmp_path), dev_dir=str(dev))
+    rm.chips[0].device_paths = [str(dev / "accel0")]
+    rm.chips[1].device_paths = [str(dev / "vfio1")]  # vanished
+    w = HealthWatcher(rm, hook_path=str(tmp_path))
     result = w.check_once()
     assert result["c0"] is True and result["c1"] is False
+    # vfio-style path coming back restores health
+    (dev / "vfio1").write_text("")
+    assert w.check_once()["c1"] is True
 
 
-def test_no_accel_files_at_all_is_healthy(tmp_path):
+def test_no_device_files_recorded_is_healthy(tmp_path):
     rm = _rm()
-    w = HealthWatcher(rm, hook_path=str(tmp_path), dev_dir=str(tmp_path / "nodev"))
+    w = HealthWatcher(rm, hook_path=str(tmp_path))
     assert all(w.check_once().values())
 
 
@@ -85,7 +134,7 @@ def test_stale_lock_is_stolen(tmp_path):
 
 def test_shim_error_auto_recovers_after_window(tmp_path):
     rm = _rm()
-    w = HealthWatcher(rm, hook_path=str(tmp_path), dev_dir=str(tmp_path / "nodev"),
+    w = HealthWatcher(rm, hook_path=str(tmp_path),
                       recovery_seconds=30)
     (tmp_path / "health").mkdir()
     err = tmp_path / "health" / "c0.err"

@@ -147,6 +147,65 @@ def test_allocate_full_slice(served_plugin):
     sched.stop()
 
 
+def test_allocate_qos_policy_maps_to_core_policy(served_plugin):
+    """QoS annotation drives libvtpu's core-utilization policy (reference
+    metax qos.go: best-effort never throttles, fixed-share always does)."""
+    client, rm, stub, config = served_plugin
+    sched = Scheduler(client)
+    register_tpu_backend(quota=sched.quota_manager)
+    sched.start(register_interval=3600)
+
+    config.qos_enabled = True
+    pod = client.put_pod(tpu_pod("be", tpumem=1024,
+                                 annotations={t.QOS_POLICY_ANNO: t.QOS_BEST_EFFORT}))
+    assert sched.filter({"Pod": pod, "NodeNames": ["host1"]})["NodeNames"] == ["host1"]
+    assert sched.bind({"PodName": "be", "PodNamespace": "default",
+                       "Node": "host1"})["Error"] == ""
+    resp = stub.Allocate(pb.AllocateRequest(
+        container_requests=[pb.ContainerAllocateRequest(devicesIDs=["host1-tpu-0::0"])]))
+    assert dict(resp.container_responses[0].envs)[envs.ENV_CORE_POLICY] == "disable"
+    sched.stop()
+
+
+def test_cdi_spec_and_qualified_devices(mock_chips, tmp_path):
+    """CDI mode: spec file on disk + qualified names in Allocate (reference
+    nvinternal/cdi/cdi.go)."""
+    import json
+
+    from vtpu.plugin import cdi
+    from vtpu.plugin.server import PluginConfig, TpuDevicePlugin
+
+    path = cdi.write_spec(cdi.generate_spec(mock_chips, "/usr/local/vtpu"),
+                          str(tmp_path / "cdi"))
+    spec = json.loads(open(path).read())
+    assert spec["kind"] == "vtpu.io/tpu"
+    assert len(spec["devices"]) == 8
+    assert any(m["containerPath"] == "/usr/local/vtpu/libvtpu.so"
+               for m in spec["containerEdits"]["mounts"])
+
+    client = fake_cluster({"host1": v5e_devices(8, prefix="host1-tpu")})
+    rm = TpuResourceManager(mock_chips, split_count=4)
+    plugin = TpuDevicePlugin(rm, client, PluginConfig(
+        node_name="host1", hook_path=str(tmp_path / "hook"), cdi_enabled=True))
+    sched = Scheduler(client)
+    register_tpu_backend(quota=sched.quota_manager)
+    sched.start(register_interval=3600)
+    pod = client.put_pod(tpu_pod("cdi-pod", tpumem=1024))
+    assert sched.filter({"Pod": pod, "NodeNames": ["host1"]})["NodeNames"] == ["host1"]
+    assert sched.bind({"PodName": "cdi-pod", "PodNamespace": "default",
+                       "Node": "host1"})["Error"] == ""
+
+    class _Req:
+        container_requests = [type("C", (), {"devicesIDs": ["host1-tpu-0::0"]})()]
+
+    resp = plugin._allocate_pending(client.get_pod("default", "cdi-pod"), _Req())
+    ctr = resp.container_responses[0]
+    assert [d.name for d in ctr.cdi_devices] == ["vtpu.io/tpu=host1-tpu-0"]
+    assert not ctr.devices  # no raw device paths in CDI mode
+    assert all(m.container_path != "/usr/local/vtpu/libvtpu.so" for m in ctr.mounts)
+    sched.stop()
+
+
 def test_allocate_without_pending_pod_fails(served_plugin):
     _, _, stub, _ = served_plugin
     with pytest.raises(grpc.RpcError) as exc:

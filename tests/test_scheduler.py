@@ -112,6 +112,48 @@ def test_bind_contention_releases_and_reports(cluster):
     assert not sched.pod_manager.has_pod(client.get_pod("default", "p2")["metadata"]["uid"])
 
 
+def test_bind_pod_group_member_retries_contended_lock(cluster):
+    """Gang members queue behind a contended node lock instead of failing
+    (reference acquireNodeLocks scheduler.go:794-819)."""
+    import threading
+    import time as _time
+
+    client, sched = cluster
+    sched.node_lock_retry_timeout = 5.0
+    _, r1 = _filter(sched, client, tpu_pod("g1", tpumem=1024))
+    winner = r1["NodeNames"][0]
+    assert sched.bind({"PodName": "g1", "PodNamespace": "default", "Node": winner})["Error"] == ""
+
+    gang_pod = tpu_pod("g2", tpumem=1024,
+                       annotations={"scheduling.k8s.io/group-name": "gang-x"})
+    _, r2 = _filter(sched, client, gang_pod)
+
+    def release_later():
+        _time.sleep(1.0)
+        from vtpu.util import nodelock
+        nodelock.release_node_lock(client, winner, client.get_pod("default", "g1"))
+
+    releaser = threading.Thread(target=release_later)
+    releaser.start()
+    res = sched.bind({"PodName": "g2", "PodNamespace": "default", "Node": winner})
+    releaser.join()
+    assert res["Error"] == ""
+    assert ("default", "g2", winner) in client.bindings
+
+
+def test_bind_pod_group_retry_times_out(cluster):
+    client, sched = cluster
+    sched.node_lock_retry_timeout = 0.8
+    _, r1 = _filter(sched, client, tpu_pod("g1", tpumem=1024))
+    winner = r1["NodeNames"][0]
+    assert sched.bind({"PodName": "g1", "PodNamespace": "default", "Node": winner})["Error"] == ""
+    gang_pod = tpu_pod("g2", tpumem=1024,
+                       annotations={"scheduling.k8s.io/group-name": "gang-x"})
+    _filter(sched, client, gang_pod)
+    res = sched.bind({"PodName": "g2", "PodNamespace": "default", "Node": winner})
+    assert "locked" in res["Error"]
+
+
 def test_pod_delete_frees_usage(cluster):
     client, sched = cluster
     _, result = _filter(sched, client, tpu_pod("p1", tpumem=4096))

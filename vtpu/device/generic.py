@@ -49,10 +49,10 @@ from vtpu.util import types as t
 from vtpu.util.helpers import pod_annotations, resource_limits
 
 # QoS policies (reference metax sdevice qos.go best-effort/fixed-share/burst-share)
-QOS_BEST_EFFORT = "best-effort"
-QOS_FIXED_SHARE = "fixed-share"
-QOS_BURST_SHARE = "burst-share"
-QOS_POLICY_ANNO = "vtpu.io/qos-policy"
+QOS_BEST_EFFORT = t.QOS_BEST_EFFORT
+QOS_FIXED_SHARE = t.QOS_FIXED_SHARE
+QOS_BURST_SHARE = t.QOS_BURST_SHARE
+QOS_POLICY_ANNO = t.QOS_POLICY_ANNO
 ENV_QOS_POLICY = "VTPU_QOS_POLICY"
 
 
@@ -131,19 +131,12 @@ class GenericDevices(Devices):
         if not has_count and not has_frac:
             return False
         if not has_count:
-            # default count must match what generate_resource_requests will
-            # compute, incl. multi-chip core-unit asks (ceil(units / cpd))
-            nums = 1
-            if cfg.resource_core_unit_name:
-                try:
-                    units = int(str(limits.get(cfg.resource_core_unit_name, 0)))
-                except (TypeError, ValueError):
-                    units = 0
-                cpd = max(1, cfg.cores_per_device)
-                if units > cpd:
-                    nums = -(-units // cpd)
+            # default count: exactly what the scheduler will compute for this
+            # container (count name is absent here, so .nums is the derived
+            # value incl. multi-chip core-unit asks)
+            nums = self.generate_resource_requests(container).nums
             res = container.setdefault("resources", {})
-            res.setdefault("limits", {})[cfg.resource_count_name] = str(nums)
+            res.setdefault("limits", {})[cfg.resource_count_name] = str(max(1, nums))
         if cfg.qos:
             policy = pod_annotations(pod).get(QOS_POLICY_ANNO, "")
             if policy:

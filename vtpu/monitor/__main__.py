@@ -77,12 +77,14 @@ def main() -> None:
     start_http_server(args.metrics_port)
     logging.info("vtpu-monitor metrics on :%d, watching %s", args.metrics_port,
                  args.hook_path)
-    from vtpu.plugin.partition import lock_held
+    from vtpu.plugin.partition import lock_dir_for, lock_held
 
     # pause while the plugin repartitions chips (reference MIG-apply lock,
-    # cmd/vGPUmonitor/main.go:101-116)
+    # cmd/vGPUmonitor/main.go:101-116). The lock lives under the hook path --
+    # the hostPath volume shared with the plugin container.
+    partition_dir = lock_dir_for(args.hook_path)
     FeedbackLoop(lister, interval=args.feedback_interval).run_forever(
-        pause_check=lock_held
+        pause_check=lambda: lock_held(partition_dir)
     )
 
 

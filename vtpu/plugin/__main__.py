@@ -36,6 +36,11 @@ def main() -> None:
                         "its tpu section provides split/scaling defaults, CLI flags win")
     parser.add_argument("--kube-api", default="")
     parser.add_argument("--mode", default="", choices=["", "exclusive"])
+    parser.add_argument("--qos", action="store_true",
+                        help="honor pod vtpu.io/qos-policy annotations in Allocate")
+    parser.add_argument("--cdi", action="store_true",
+                        help="write a CDI spec and name qualified devices in Allocate")
+    parser.add_argument("--cdi-dir", default="/var/run/cdi")
     parser.add_argument("-v", "--verbose", action="count", default=0)
     args = parser.parse_args()
 
@@ -82,7 +87,14 @@ def main() -> None:
         resource_name=args.resource_name,
         node_name=args.node_name,
         hook_path=args.hook_path,
+        cdi_enabled=args.cdi,
+        cdi_dir=args.cdi_dir,
+        qos_enabled=args.qos,
     )
+    if args.cdi:
+        from vtpu.plugin import cdi
+
+        cdi.write_spec(cdi.generate_spec(chips, args.hook_path), args.cdi_dir)
     socket_path = os.path.join(args.socket_dir, "vtpu.sock")
 
     while True:

@@ -1,0 +1,89 @@
+"""CDI (Container Device Interface) spec generation for TPU chips.
+
+Parity: reference pkg/device-plugin/nvidiadevice/nvinternal/cdi/cdi.go — the
+plugin can hand container engines a CDI spec instead of raw device paths, so
+runtimes that speak CDI (containerd >= 1.7, cri-o, podman) mount the chips,
+libvtpu, and the preload file themselves. The Allocate response then only
+names qualified devices (``vtpu.io/tpu=<uuid>``).
+
+The spec's containerEdits carry the libvtpu delivery (the .so + ld.so.preload
+mounts) once per device, matching the reference's driver-library edits.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import tempfile
+
+from vtpu.plugin import envs
+from vtpu.plugin.rm import TpuChip
+
+log = logging.getLogger(__name__)
+
+CDI_VERSION = "0.6.0"
+VENDOR = "vtpu.io"
+CLASS = "tpu"
+KIND = f"{VENDOR}/{CLASS}"
+DEFAULT_CDI_DIR = "/var/run/cdi"
+SPEC_FILENAME = "vtpu.json"
+
+
+def qualified_name(device: str) -> str:
+    """``vtpu.io/tpu=<device>`` (CDI fully-qualified device name)."""
+    return f"{KIND}={device}"
+
+
+def _device_edits(chip: TpuChip) -> dict:
+    return {
+        "deviceNodes": [
+            {"path": path, "hostPath": path, "permissions": "rw"}
+            for path in chip.device_paths
+        ]
+    }
+
+
+def generate_spec(chips: list[TpuChip], hook_path: str) -> dict:
+    """Build the CDI spec dict for this node's chips."""
+    return {
+        "cdiVersion": CDI_VERSION,
+        "kind": KIND,
+        "containerEdits": {
+            "mounts": [
+                {
+                    "containerPath": envs.CONTAINER_LIB_PATH,
+                    "hostPath": f"{hook_path}/{envs.LIBVTPU_SO}",
+                    "options": ["ro", "nosuid", "nodev", "bind"],
+                },
+                {
+                    "containerPath": envs.CONTAINER_PRELOAD_PATH,
+                    "hostPath": f"{hook_path}/{envs.LD_SO_PRELOAD}",
+                    "options": ["ro", "nosuid", "nodev", "bind"],
+                },
+            ]
+        },
+        "devices": [
+            {"name": chip.uuid, "containerEdits": _device_edits(chip)}
+            for chip in chips
+        ],
+    }
+
+
+def write_spec(spec: dict, cdi_dir: str = DEFAULT_CDI_DIR) -> str:
+    """Atomically write the spec file (reference cdi.CreateSpecFile)."""
+    os.makedirs(cdi_dir, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=cdi_dir, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(spec, f, indent=2)
+        path = os.path.join(cdi_dir, SPEC_FILENAME)
+        os.replace(tmp, path)
+        log.info("wrote CDI spec with %d devices to %s", len(spec["devices"]), path)
+        return path
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise

@@ -26,6 +26,11 @@ ENV_LOG_LEVEL = "LIBVTPU_LOG_LEVEL"
 ENV_VISIBLE_CHIPS = "TPU_VISIBLE_CHIPS"
 # Disable all enforcement (escape hatch; reference CUDA_DISABLE_CONTROL).
 ENV_DISABLE_CONTROL = "VTPU_DISABLE_CONTROL"
+# Fatal-health marker file: libvtpu appends a line on fatal PJRT errors; the
+# HealthWatcher promotes it to chip Unhealthy (the XID-event analog).
+ENV_HEALTH_FILE = "VTPU_HEALTH_FILE"
+HEALTH_ERR_FILE = "health.err"  # inside the container's rw cache mount
+CHIPS_FILE = "chips"  # host-side: uuids assigned to this container's region dir
 
 # Node-host filesystem layout (reference /usr/local/vgpu + HOOK_PATH).
 DEFAULT_HOOK_PATH = "/usr/local/vtpu"

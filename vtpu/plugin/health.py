@@ -5,11 +5,14 @@ Unhealthy and pushes a ListAndWatch update, skipping application-caused XIDs
 and honoring DP_DISABLE_HEALTHCHECKS. TPUs expose no XID stream; the portable
 liveness signals on a TPU VM are:
 
-- the accelerator device files (``/dev/accel<N>`` / ``/dev/vfio``) vanishing
-  or losing rw access (driver wedge, host maintenance event), and
-- a sticky per-chip error file the libvtpu shim writes on fatal PJRT errors
-  (``<hook>/health/<uuid>.err``), the moral equivalent of a hardware XID --
-  libvtpu can't clear it, only the watcher GCs it once the chip checks out.
+- the chip's device files (``/dev/accel<N>`` / ``/dev/vfio/*``) vanishing or
+  losing rw access (driver wedge, host maintenance event), and
+- fatal PJRT errors reported by libvtpu: the shim appends to
+  ``$VTPU_HEALTH_FILE`` (a file inside its rw cache mount, set by Allocate);
+  the watcher promotes that marker to a sticky per-chip error
+  ``<hook>/health/<uuid>.err`` via the region dir's ``chips`` map -- the
+  moral equivalent of a hardware XID. The sticky marker ages out after
+  ``recovery_seconds`` so a transient fault doesn't bench the chip forever.
 
 ``VTPU_DISABLE_HEALTHCHECKS=all`` (or a comma list containing ``accel`` /
 ``shim``) disables classes of checks, mirroring the reference env knob.
@@ -38,14 +41,12 @@ class HealthWatcher:
         self,
         rm: TpuResourceManager,
         hook_path: str = "/usr/local/vtpu",
-        dev_dir: str = "/dev",
         interval: float = 5.0,
         recovery_seconds: float = 60.0,
         probe: Optional[Callable[[str, int], bool]] = None,
     ) -> None:
         self.rm = rm
         self.hook_path = hook_path
-        self.dev_dir = dev_dir
         self.interval = interval
         self.recovery_seconds = recovery_seconds
         self._probe = probe  # test hook: (uuid, index) -> healthy
@@ -56,18 +57,18 @@ class HealthWatcher:
 
     # --------------------------------------------------------------- checks
 
-    def _accel_ok(self, index: int) -> bool:
-        """Device-file presence check; vacuously healthy when the node does
-        not expose per-chip accel files (CI, mock clusters)."""
-        path = os.path.join(self.dev_dir, f"accel{index}")
-        if not os.path.exists(path):
-            # distinguish "no accel files at all" (mock env -> healthy) from
-            # "chip N's file vanished while others remain" (unhealthy)
-            any_accel = any(
-                e.startswith("accel") for e in _safe_listdir(self.dev_dir)
-            )
-            return not any_accel
-        return os.access(path, os.R_OK | os.W_OK)
+    def _accel_ok(self, chip) -> bool:
+        """Device-file presence check over the chip's own recorded device
+        nodes (covers both /dev/accel* and /dev/vfio/* layouts); vacuously
+        healthy when the chip has none (CI, mock clusters)."""
+        if not chip.device_paths:
+            return True
+        for path in chip.device_paths:
+            if not os.path.exists(path):
+                return False
+            if not os.access(path, os.R_OK | os.W_OK):
+                return False
+        return True
 
     def _shim_ok(self, uuid: str) -> bool:
         """Sticky shim error; the watcher GCs it after RECOVERY_SECONDS so a
@@ -89,10 +90,49 @@ class HealthWatcher:
         except FileNotFoundError:
             pass
 
+    def _promote_container_errors(self) -> None:
+        """Translate per-container fatal-health markers (written by libvtpu
+        through its rw cache mount) into per-chip sticky errors. The sibling
+        ``chips`` file, written by Allocate, attributes the marker to the
+        chips that container holds."""
+        containers = os.path.join(self.hook_path, "containers")
+        try:
+            entries = os.listdir(containers)
+        except OSError:
+            return
+        for entry in entries:
+            region_dir = os.path.join(containers, entry)
+            err = os.path.join(region_dir, "health.err")
+            if not os.path.exists(err):
+                continue
+            try:
+                with open(os.path.join(region_dir, "chips")) as f:
+                    uuids = [u for u in f.read().strip().split(",") if u]
+            except OSError:
+                continue
+            health_dir = os.path.join(self.hook_path, "health")
+            os.makedirs(health_dir, exist_ok=True)
+            for uuid in uuids:
+                marker = os.path.join(health_dir, f"{uuid}.err")
+                if not os.path.exists(marker):
+                    log.warning("container %s reported fatal error on %s", entry, uuid)
+                # always (re)write: a fresh report must refresh the marker's
+                # mtime, or a chip that keeps faulting would age out to
+                # healthy between reports
+                with open(err) as src, open(marker, "w") as dst:
+                    dst.write(src.read())
+            # consume the container's report; the sticky marker carries it
+            try:
+                os.unlink(err)
+            except FileNotFoundError:
+                pass
+
     def check_once(self) -> dict[str, bool]:
         """One sweep; returns uuid -> healthy and applies it to the rm."""
         if "all" in self.disabled:
             return {}
+        if "shim" not in self.disabled:
+            self._promote_container_errors()
         result: dict[str, bool] = {}
         for chip in self.rm.chips:
             healthy = True
@@ -100,7 +140,7 @@ class HealthWatcher:
                 healthy = self._probe(chip.uuid, chip.index)
             else:
                 if "accel" not in self.disabled:
-                    healthy = healthy and self._accel_ok(chip.index)
+                    healthy = healthy and self._accel_ok(chip)
                 if "shim" not in self.disabled:
                     healthy = healthy and self._shim_ok(chip.uuid)
             result[chip.uuid] = healthy
@@ -133,10 +173,3 @@ class HealthWatcher:
         self._stop.set()
         if self._thread:
             self._thread.join(timeout=5)
-
-
-def _safe_listdir(path: str) -> list[str]:
-    try:
-        return os.listdir(path)
-    except OSError:
-        return []

@@ -23,18 +23,35 @@ from vtpu.plugin.rm import TpuResourceManager
 
 log = logging.getLogger(__name__)
 
-LOCK_DIR = "/tmp/vtpu"
 LOCK_FILE = "partition-apply.lock"
 LOCK_STALE_SECONDS = 300.0
 
 
-def lock_path(base: str = LOCK_DIR) -> str:
+def lock_dir_for(hook_path: str) -> str:
+    """The lock MUST live under the hook path: that's the hostPath volume both
+    the plugin and monitor containers mount, so it is visible across the
+    container boundary (a container-local /tmp silently defeats the monitor's
+    pause check). Both sides must derive it from their --hook-path flag via
+    this helper, never from the env, so they cannot disagree."""
+    return os.path.join(hook_path, "partition")
+
+
+def default_lock_dir() -> str:
+    """Fallback when a caller passes no base: HOOK_PATH env (set by the chart
+    in both containers), else /tmp/vtpu for bare processes/tests."""
+    hook = os.environ.get("HOOK_PATH", "")
+    return lock_dir_for(hook) if hook else "/tmp/vtpu"
+
+
+def lock_path(base: str | None = None) -> str:
+    base = base or default_lock_dir()
     return os.path.join(base, LOCK_FILE)
 
 
-def create_apply_lock(base: str = LOCK_DIR) -> str:
+def create_apply_lock(base: str | None = None) -> str:
     """Take the apply lock (reference CreateMigApplyLock). Stale locks from a
     crashed apply are stolen after LOCK_STALE_SECONDS."""
+    base = base or default_lock_dir()
     os.makedirs(base, exist_ok=True)
     path = lock_path(base)
     try:
@@ -43,7 +60,11 @@ def create_apply_lock(base: str = LOCK_DIR) -> str:
         os.close(fd)
         return path
     except FileExistsError:
-        age = time.time() - os.stat(path).st_mtime
+        try:
+            age = time.time() - os.stat(path).st_mtime
+        except FileNotFoundError:
+            # holder released between our failed O_EXCL open and the stat
+            return create_apply_lock(base)
         if age > LOCK_STALE_SECONDS:
             # Atomic steal: rename the stale file aside first. Only one
             # stealer's rename succeeds (the loser gets FileNotFoundError and
@@ -60,21 +81,21 @@ def create_apply_lock(base: str = LOCK_DIR) -> str:
         raise
 
 
-def release_apply_lock(base: str = LOCK_DIR) -> None:
+def release_apply_lock(base: str | None = None) -> None:
     try:
         os.unlink(lock_path(base))
     except FileNotFoundError:
         pass
 
 
-def lock_held(base: str = LOCK_DIR) -> bool:
+def lock_held(base: str | None = None) -> bool:
     """Monitor-side check (reference WatchLockFile): pause while held."""
     path = lock_path(base)
-    if not os.path.exists(path):
+    try:
+        age = time.time() - os.stat(path).st_mtime
+    except FileNotFoundError:
         return False
-    if time.time() - os.stat(path).st_mtime > LOCK_STALE_SECONDS:
-        return False  # stale lock: monitor resumes rather than hanging forever
-    return True
+    return age <= LOCK_STALE_SECONDS  # stale: monitor resumes, not hangs
 
 
 @dataclass
@@ -86,7 +107,7 @@ class PartitionPlan:
 
 
 def apply_partitions(
-    rm: TpuResourceManager, plans: list[PartitionPlan], base: str = LOCK_DIR
+    rm: TpuResourceManager, plans: list[PartitionPlan], base: str | None = None
 ) -> None:
     """Apply mode changes under the lock, then bump rm so the register loop
     publishes the new geometry (reference processMigConfigs/ApplyMigTemplate)."""

@@ -52,6 +52,14 @@ class PluginConfig:
     core_policy: str = "default"
     oversubscribe: bool = False
     log_level: str = "1"
+    # Operator opt-in for pod-driven QoS (reference metax qos honored only
+    # when the device class enables it): without this, a tenant annotation
+    # cannot weaken the configured core policy.
+    qos_enabled: bool = False
+    # CDI mode: name qualified devices instead of raw device paths (reference
+    # --cdi-enabled + nvinternal/cdi); the spec file is written at startup.
+    cdi_enabled: bool = False
+    cdi_dir: str = ""
     # extra passthrough envs (reference vgpucfg.go node overrides)
     extra_envs: dict[str, str] = field(default_factory=dict)
 
@@ -221,20 +229,37 @@ class TpuDevicePlugin:
         visible: list[str] = []
         core_limit = 0
         device_specs = []
+        cdi_devices = []
         for i, dev in enumerate(devices):
             env[envs.ENV_DEVICE_MEMORY_LIMIT.format(index=i)] = f"{dev.usedmem}m"
             core_limit = max(core_limit, dev.usedcores)
             chip = self.rm.chip_by_uuid(dev.uuid)
             if chip is not None:
                 visible.append(str(chip.index))
-                for path in chip.device_paths:
-                    device_specs.append(
-                        pb.DeviceSpec(container_path=path, host_path=path, permissions="rw")
-                    )
+                if cfg.cdi_enabled:
+                    from vtpu.plugin import cdi
+
+                    cdi_devices.append(pb.CDIDevice(name=cdi.qualified_name(chip.uuid)))
+                else:
+                    for path in chip.device_paths:
+                        device_specs.append(
+                            pb.DeviceSpec(container_path=path, host_path=path, permissions="rw")
+                        )
         env[envs.ENV_CORE_LIMIT] = str(core_limit)
         env[envs.ENV_VISIBLE_CHIPS] = ",".join(visible)
         env[envs.ENV_SHARED_REGION] = f"{envs.CONTAINER_CACHE_DIR}/{pod_uid[:12]}.cache"
-        env[envs.ENV_CORE_POLICY] = cfg.core_policy
+        env[envs.ENV_HEALTH_FILE] = f"{envs.CONTAINER_CACHE_DIR}/{envs.HEALTH_ERR_FILE}"
+        # host-side map region-dir -> assigned chips, so the HealthWatcher can
+        # attribute a container's fatal-health marker to the right chips
+        with open(os.path.join(region_dir, envs.CHIPS_FILE), "w") as f:
+            f.write(",".join(d.uuid for d in devices))
+        # QoS policy maps onto libvtpu's core-utilization policy (reference
+        # metax sdevice qos.go: best-effort / fixed-share / burst-share):
+        # best-effort runs unthrottled, fixed-share always enforces its core
+        # quota, burst-share throttles only under contention (default).
+        qos = pod_annotations(pod).get(t.QOS_POLICY_ANNO, "") if cfg.qos_enabled else ""
+        qos_core_policy = t.QOS_CORE_POLICY.get(qos, "")
+        env[envs.ENV_CORE_POLICY] = qos_core_policy or cfg.core_policy
         env[envs.ENV_LOG_LEVEL] = cfg.log_level
         if cfg.oversubscribe:
             env[envs.ENV_OVERSUBSCRIBE] = "true"
@@ -244,22 +269,29 @@ class TpuDevicePlugin:
 
         mounts = [
             pb.Mount(
-                container_path=envs.CONTAINER_LIB_PATH,
-                host_path=f"{cfg.hook_path}/{envs.LIBVTPU_SO}",
-                read_only=True,
-            ),
-            pb.Mount(
-                container_path=envs.CONTAINER_PRELOAD_PATH,
-                host_path=f"{cfg.hook_path}/{envs.LD_SO_PRELOAD}",
-                read_only=True,
-            ),
-            pb.Mount(
                 container_path=envs.CONTAINER_CACHE_DIR,
                 host_path=region_dir,
                 read_only=False,
             ),
         ]
-        return pb.ContainerAllocateResponse(envs=env, mounts=mounts, devices=device_specs)
+        if not cfg.cdi_enabled:
+            # CDI mode leaves the libvtpu delivery to the spec's
+            # containerEdits; otherwise mount the .so + preload file here.
+            mounts += [
+                pb.Mount(
+                    container_path=envs.CONTAINER_LIB_PATH,
+                    host_path=f"{cfg.hook_path}/{envs.LIBVTPU_SO}",
+                    read_only=True,
+                ),
+                pb.Mount(
+                    container_path=envs.CONTAINER_PRELOAD_PATH,
+                    host_path=f"{cfg.hook_path}/{envs.LD_SO_PRELOAD}",
+                    read_only=True,
+                ),
+            ]
+        return pb.ContainerAllocateResponse(
+            envs=env, mounts=mounts, devices=device_specs, cdi_devices=cdi_devices
+        )
 
     # -------------------------------------------------------------- lifecycle
 

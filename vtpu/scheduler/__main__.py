@@ -80,6 +80,9 @@ def main() -> None:
     parser.add_argument("--device-policy", default="binpack",
                         choices=["binpack", "spread", "mutex"])
     parser.add_argument("--register-interval", type=float, default=15.0)
+    parser.add_argument("--node-lock-retry-timeout", type=float, default=8.0,
+                        help="seconds a PodGroup member retries a contended node lock "
+                        "(keep below the extender httpTimeout)")
     parser.add_argument("--device-config", default="", help="device-config.yaml path")
     parser.add_argument("--kube-api", default="", help="API server URL (else in-cluster)")
     parser.add_argument("--fake-cluster", type=int, default=0,
@@ -117,6 +120,7 @@ def main() -> None:
         node_policy=args.node_policy,
         device_policy=args.device_policy,
         leader_check=leader.is_leader,
+        node_lock_retry_timeout=args.node_lock_retry_timeout,
     )
     init_devices_with_config(
         load_device_config(args.device_config), scheduler.quota_manager

@@ -18,6 +18,7 @@ over:
 from __future__ import annotations
 
 import logging
+import random
 import threading
 import time
 from typing import Optional
@@ -33,7 +34,7 @@ from vtpu.scheduler.nodes import NodeManager
 from vtpu.scheduler.policy import pick_winner
 from vtpu.util import nodelock
 from vtpu.util import types as t
-from vtpu.util.helpers import is_pod_deleted, pod_annotations, pod_key
+from vtpu.util.helpers import is_pod_deleted, pod_annotations, pod_group_name, pod_key
 from vtpu.util.k8sclient import ApiError, KubeClient, annotations
 
 log = logging.getLogger(__name__)
@@ -46,10 +47,12 @@ class Scheduler:
         node_policy: str = t.NODE_POLICY_BINPACK,
         device_policy: str = t.DEVICE_POLICY_BINPACK,
         leader_check=None,
+        node_lock_retry_timeout: float = t.NODE_LOCK_RETRY_TIMEOUT_SECONDS,
     ) -> None:
         self.client = client
         self.node_policy = node_policy
         self.device_policy = device_policy
+        self.node_lock_retry_timeout = node_lock_retry_timeout
         self.pod_manager = PodManager()
         self.quota_manager = QuotaManager()
         self.node_manager = NodeManager()
@@ -326,9 +329,7 @@ class Scheduler:
 
         locked_vendors: list[str] = []
         try:
-            for vendor, backend in DEVICES_MAP.items():
-                backend.lock_node(node, pod, self.client)
-                locked_vendors.append(vendor)
+            self._acquire_node_locks(node, pod, locked_vendors)
             self.client.patch_pod_annotations(
                 ns,
                 name,
@@ -347,6 +348,35 @@ class Scheduler:
             return {"Error": str(e)}
         self.events.binding_succeed(pod, node_name)
         return {"Error": ""}
+
+    def _acquire_node_locks(self, node: dict, pod: dict, locked_vendors: list[str]) -> None:
+        """Take every vendor's node lock. Gang members (PodGroup) retry on
+        contention up to node_lock_retry_timeout so sibling binds onto the same
+        node queue instead of failing the gang (reference acquireNodeLocks
+        scheduler.go:794-819)."""
+        in_group = bool(pod_group_name(pod))
+        deadline = time.monotonic() + self.node_lock_retry_timeout
+        # Jittered exponential backoff: a large gang's waiters must not poll
+        # the API server in lockstep nor stampede the CAS when the lock frees.
+        delay = t.NODE_LOCK_RETRY_INTERVAL_SECONDS
+        for vendor, backend in DEVICES_MAP.items():
+            while True:
+                try:
+                    backend.lock_node(node, pod, self.client)
+                    locked_vendors.append(vendor)
+                    break
+                except nodelock.NodeLockContention:
+                    if not in_group or time.monotonic() >= deadline:
+                        raise
+                    log.info(
+                        "bind %s: node lock busy, pod-group member retrying",
+                        pod_key(pod),
+                    )
+                    # never sleep past the deadline: a reply after the extender
+                    # httpTimeout would bind a pod the scheduler gave up on
+                    remaining = deadline - time.monotonic()
+                    time.sleep(min(delay * random.uniform(0.5, 1.5), max(0.0, remaining)))
+                    delay = min(delay * 2, 4.0)
 
     def _cleanup_stale_pod_allocation(self, pod: dict) -> None:
         """Failed bind: withdraw the Filter decision so the devices free up

@@ -25,6 +25,21 @@ def pod_annotations(pod: dict) -> dict:
     return pod.get("metadata", {}).get("annotations") or {}
 
 
+def pod_group_name(pod: dict) -> str:
+    """Gang-scheduling group this pod belongs to, or "" (reference Bind's
+    PodGroup-aware lock retry, scheduler.go:794-819)."""
+    meta = pod.get("metadata", {})
+    labels = meta.get("labels") or {}
+    annos = meta.get("annotations") or {}
+    for key in t.POD_GROUP_ANNOS:
+        if annos.get(key):
+            return annos[key]
+    for key in t.POD_GROUP_LABELS:
+        if labels.get(key):
+            return labels[key]
+    return ""
+
+
 def all_containers(pod: dict) -> list[dict]:
     spec = pod.get("spec", {})
     return list(spec.get("containers") or [])

@@ -35,8 +35,37 @@ NO_USE_DEVICE_TYPE_ANNO = "vtpu.io/nouse-tputype"
 NUMA_BIND_ANNO = "vtpu.io/numa-bind"  # "true" -> keep all devices on one NUMA node
 TASK_PRIORITY_ANNO = "vtpu.io/task-priority"  # 0 (low, default) | 1 (high)
 
+# Per-pod QoS (reference metax sdevice qos.go): how strictly libvtpu throttles
+# the TensorCore duty-cycle for this tenant.
+QOS_POLICY_ANNO = "vtpu.io/qos-policy"
+QOS_BEST_EFFORT = "best-effort"  # never throttled, no core guarantee
+QOS_FIXED_SHARE = "fixed-share"  # hard core quota, always enforced
+QOS_BURST_SHARE = "burst-share"  # quota enforced only under contention
+QOS_CORE_POLICY = {  # -> VTPU_CORE_UTILIZATION_POLICY for libvtpu
+    QOS_BEST_EFFORT: "disable",
+    QOS_FIXED_SHARE: "force",
+    QOS_BURST_SHARE: "default",
+}
+
 # --- Node annotations -------------------------------------------------------
 NODE_LOCK_ANNO = "vtpu.io/mutex.lock"  # RFC3339,<ns>,<pod> (reference nodelock.go:39)
+
+# Gang-scheduling pod-group markers recognized for node-lock retry in Bind
+# (reference scheduler.go:794-819: PodGroup members retry on contention up to
+# --node-lock-retry-timeout instead of failing the whole gang).
+POD_GROUP_LABELS = (
+    "pod-group.scheduling.sigs.k8s.io",  # coscheduling plugin
+    "volcano.sh/task-spec",
+)
+POD_GROUP_ANNOS = (
+    "scheduling.k8s.io/group-name",  # volcano
+    "pod-group.scheduling.sigs.k8s.io/name",
+)
+# Must stay below the kube-scheduler extender httpTimeout (the chart sets
+# 10 s): Bind blocks synchronously while a gang member retries, and a reply
+# after the extender timeout would bind a pod the scheduler already gave up on.
+NODE_LOCK_RETRY_TIMEOUT_SECONDS = 8.0  # --node-lock-retry-timeout default
+NODE_LOCK_RETRY_INTERVAL_SECONDS = 0.5
 NODE_HANDSHAKE_PREFIX = "vtpu.io/node-handshake-"  # + vendor common-word
 NODE_REGISTER_SUFFIX = "-register"  # vtpu.io/node-<vendor>-register
 
