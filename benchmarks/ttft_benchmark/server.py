@@ -67,7 +67,9 @@ class Engine:
         self._decode = _decode
         self._lock = threading.Lock()  # one model, serialized like a batch=1 engine
         # warm the compile caches so the first request isn't a compile
-        self.generate(min(16, cfg.max_seq // 2), 2)
+        # (generate is a generator — it must be consumed to run)
+        for _ in self.generate(min(16, cfg.max_seq // 2), 2):
+            pass
 
     def generate(self, prompt_len: int, max_tokens: int):
         """Yield (token_id, monotonic_ts) per generated token."""

@@ -226,6 +226,28 @@ def test_exclusive_ask_rejects_shared_device():
     assert not ok and common.EXCLUSIVE_DEVICE_ALLOCATE_CONFLICT in reason
 
 
+def test_merge_node_config_overrides():
+    """Per-node stanza wins over cluster defaults (reference
+    DevicePluginConfigs.Nodeconfig mergo merge)."""
+    from vtpu.scheduler.config import merge_node_config
+
+    tpu = {
+        "deviceSplitCount": 4,
+        "deviceMemoryScaling": 1.0,
+        "nodeconfig": [
+            {"name": "tpu-node-7", "deviceSplitCount": 8, "mode": "exclusive"},
+            {"name": "other", "deviceSplitCount": 2},
+        ],
+    }
+    merged = merge_node_config(tpu, "tpu-node-7")
+    assert merged["deviceSplitCount"] == 8
+    assert merged["mode"] == "exclusive"
+    assert merged["deviceMemoryScaling"] == 1.0
+    assert "nodeconfig" not in merged
+    # non-matching node keeps the defaults
+    assert merge_node_config(tpu, "tpu-node-1")["deviceSplitCount"] == 4
+
+
 def test_device_class_from_dict_roundtrip():
     d = {
         "commonWord": "TPU-V4", "resourceCountName": "google.com/tpu-v4",

@@ -107,6 +107,24 @@ def test_monitor_collector_exports(hook):
     assert any(s.value == 3 for s in kernel_samples)
 
 
+def test_monitor_collector_legacy_aliases(hook):
+    """--legacy-metrics publishes reference-compatible hami_* names so
+    dashboards built for the reference keep working."""
+    hook_path, _ = hook
+    lister = ContainerLister(str(hook_path))
+    metrics = {m.name: m for m in
+               MonitorCollector(lister, node_name="n1", legacy_metrics=True).collect()}
+    assert "hami_vgpu_memory_limit_bytes" in metrics
+    legacy = {tuple(s.labels.values()): s.value
+              for s in metrics["hami_vgpu_memory_limit_bytes"].samples}
+    native = {tuple(s.labels.values()): s.value
+              for s in metrics["vtpu_memory_limit_bytes"].samples}
+    assert legacy == native
+    # off by default
+    off = {m.name for m in MonitorCollector(lister, node_name="n1").collect()}
+    assert "hami_vgpu_memory_limit_bytes" not in off
+
+
 def test_scheduler_collector_exports():
     from prometheus_client.core import CollectorRegistry
     from vtpu.scheduler.metrics import SchedulerCollector

@@ -60,6 +60,14 @@ def test_registrar_publishes_annotations(mock_chips):
     devices = codec.decode_node_devices(annos["vtpu.io/node-tpu-register"])
     assert len(devices) == 8 and devices[0].count == 4
     assert annos["vtpu.io/node-handshake-tpu"].startswith("Reported_")
+    # TPU node labeled on register, label withdrawn when inventory empties
+    # (reference e2e node suite test_node.go:57-91)
+    assert client.get_node("n1")["metadata"]["labels"]["vtpu.io/tpu-node"] == "true"
+    for chip in list(rm.chips):
+        rm.set_health(chip.uuid, False)
+    rm.chips.clear()
+    Registrar(client, rm, "n1").register_once()
+    assert "vtpu.io/tpu-node" not in client.get_node("n1")["metadata"].get("labels", {})
 
 
 @pytest.fixture

@@ -1,0 +1,133 @@
+"""Concurrency regression tests.
+
+Parity: reference pkg/scheduler/register_race_test.go:38-60 — a
+health-flapping device racing register() against onDelNode must not corrupt
+the node cache; Go runs these under -race, here we hammer the same
+interleavings from threads and assert invariants (Python's allocator won't
+segfault, but dict/list corruption and lost updates would surface as
+assertion failures or exceptions)."""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from vtpu.device import codec
+from vtpu.scheduler.scheduler import Scheduler
+from vtpu.util import types as t
+
+from tests.helpers import REGISTER_ANNO, fake_cluster, register_tpu_backend, tpu_pod, v5e_devices
+
+ROUNDS = 60
+
+
+@pytest.fixture
+def cluster():
+    client = fake_cluster({
+        "node-a": v5e_devices(8, prefix="a"),
+        "node-b": v5e_devices(8, prefix="b"),
+    })
+    sched = Scheduler(client)
+    register_tpu_backend(quota=sched.quota_manager)
+    sched.start(register_interval=3600)
+    yield client, sched
+    sched.stop()
+
+
+def test_register_vs_node_delete_race(cluster):
+    """Flapping node registration racing node deletion (reference
+    Test_register_NodeCacheConcurrency)."""
+    client, sched = cluster
+    errors: list[BaseException] = []
+
+    def flap():
+        try:
+            for i in range(ROUNDS):
+                # health-flap: re-register with devices, then with none
+                client.patch_node_annotations(
+                    "node-a", {REGISTER_ANNO: codec.encode_node_devices(
+                        v5e_devices(8, prefix="a"))})
+                sched.register_from_node_annotations()
+                client.patch_node_annotations("node-a", {REGISTER_ANNO: None})
+                sched.register_from_node_annotations()
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+
+    def deleter():
+        try:
+            for i in range(ROUNDS):
+                sched.on_del_node({"metadata": {"name": "node-a"}})
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=flap), threading.Thread(target=deleter)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert not errors, errors
+    # cache still coherent: node-b unaffected, node-a either present or absent
+    usage = sched.inspect_all_nodes_usage()
+    assert "node-b" in usage and len(usage["node-b"]["TPU"]) == 8
+
+
+def test_concurrent_filters_never_overcommit(cluster):
+    """Parallel Filter calls on one scheduler must not place more than
+    count=4 sharers on any chip (the in-memory bookkeeping race)."""
+    client, sched = cluster
+    errors: list[BaseException] = []
+
+    def submit(i: int):
+        try:
+            pod = client.put_pod(tpu_pod(f"p{i}", tpumem=2048))
+            sched.filter({"Pod": pod, "NodeNames": ["node-a", "node-b"]})
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=submit, args=(i,)) for i in range(24)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert not errors, errors
+    for node, vendors in sched.inspect_all_nodes_usage().items():
+        for dev in vendors["TPU"]:
+            assert dev.used <= dev.count, f"{node}/{dev.id} overshared: {dev.used}"
+            assert dev.usedmem <= dev.totalmem, f"{node}/{dev.id} HBM overcommitted"
+
+
+def test_informer_replay_vs_filter_race(cluster):
+    """Pod add/delete informer events racing Filter decisions keep the
+    PodManager and QuotaManager consistent (reference onAddPod/onDelPod)."""
+    client, sched = cluster
+    stop = threading.Event()
+    errors: list[BaseException] = []
+
+    def churn():
+        try:
+            i = 0
+            while not stop.is_set():
+                pod = tpu_pod(f"churn{i}", tpumem=1024, ns="churn")
+                pod = client.put_pod(pod)
+                sched.filter({"Pod": pod, "NodeNames": ["node-a", "node-b"]})
+                client.delete_pod("churn", f"churn{i}")
+                i += 1
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+
+    workers = [threading.Thread(target=churn) for _ in range(4)]
+    for th in workers:
+        th.start()
+    import time
+
+    time.sleep(2.0)
+    stop.set()
+    for th in workers:
+        th.join()
+    assert not errors, errors
+    # every churn pod was deleted -> its usage must be fully released
+    usage = sched.inspect_all_nodes_usage()
+    for vendors in usage.values():
+        for dev in vendors["TPU"]:
+            assert dev.used == 0, f"leaked usage on {dev.id}: {dev.used}"

@@ -57,6 +57,8 @@ def main() -> None:
     parser.add_argument("--kube-api", default="")
     parser.add_argument("--no-gc", action="store_true",
                         help="disable dead-pod cache GC (no API access needed)")
+    parser.add_argument("--legacy-metrics", action="store_true",
+                        help="also publish reference-compatible hami_* metric aliases")
     parser.add_argument("-v", "--verbose", action="count", default=0)
     args = parser.parse_args()
 
@@ -73,7 +75,8 @@ def main() -> None:
         pod_checker = PodSetChecker(client, args.node_name)
 
     lister = ContainerLister(args.hook_path, pod_checker=pod_checker)
-    REGISTRY.register(MonitorCollector(lister, node_name=args.node_name))
+    REGISTRY.register(MonitorCollector(lister, node_name=args.node_name,
+                                       legacy_metrics=args.legacy_metrics))
     start_http_server(args.metrics_port)
     logging.info("vtpu-monitor metrics on :%d, watching %s", args.metrics_port,
                  args.hook_path)

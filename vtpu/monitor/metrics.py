@@ -15,10 +15,23 @@ from prometheus_client.registry import Collector
 from vtpu.monitor.lister import ContainerLister
 
 
+# --legacy-metrics: additionally publish reference-compatible names so
+# dashboards built for HAMi's vGPUmonitor keep working (reference
+# metrics.go --legacy-metrics dual naming). Maps our name -> legacy alias.
+LEGACY_ALIASES = {
+    "vtpu_memory_used_bytes": "hami_vgpu_memory_used_bytes",
+    "vtpu_memory_limit_bytes": "hami_vgpu_memory_limit_bytes",
+    "vtpu_container_device_utilization_ratio": "hami_container_device_utilization_ratio",
+    "vtpu_container_last_kernel_elapsed_seconds": "hami_container_last_kernel_elapsed_seconds",
+}
+
+
 class MonitorCollector(Collector):
-    def __init__(self, lister: ContainerLister, node_name: str = ""):
+    def __init__(self, lister: ContainerLister, node_name: str = "",
+                 legacy_metrics: bool = False):
         self.lister = lister
         self.node_name = node_name
+        self.legacy_metrics = legacy_metrics
 
     def collect(self):
         entries = self.lister.update()
@@ -77,5 +90,22 @@ class MonitorCollector(Collector):
                     last_kernel.add_metric(lv, max(0.0, (now_ns - dev.last_kernel_ns) / 1e9))
                 kernels.add_metric(lv, dev.kernel_count)
                 throttled.add_metric(lv, dev.throttle_wait_ns / 1e9)
-        yield from (mem_used, mem_limit, mem_peak, core_util, core_limit,
+        families = (mem_used, mem_limit, mem_peak, core_util, core_limit,
                     last_kernel, kernels, throttled, priority, blocked)
+        yield from families
+        if self.legacy_metrics:
+            for fam in families:
+                alias = LEGACY_ALIASES.get(fam.name)
+                if alias is None:
+                    continue
+                legacy = GaugeMetricFamily(
+                    alias, f"{fam.documentation} (legacy alias)",
+                    labels=["podUid", "container", "deviceuuid", "nodename"],
+                )
+                for sample in fam.samples:
+                    legacy.add_metric(
+                        [sample.labels.get(k, "") for k in
+                         ("podUid", "container", "deviceuuid", "nodename")],
+                        sample.value,
+                    )
+                yield legacy

@@ -52,9 +52,12 @@ def main() -> None:
         parser.error("--node-name (or NODE_NAME env) is required")
 
     if args.device_config:
-        from vtpu.scheduler.config import load_device_config
+        from vtpu.scheduler.config import load_device_config, merge_node_config
 
-        tpu_cfg = load_device_config(args.device_config).get("tpu", {}) or {}
+        tpu_cfg = merge_node_config(
+            load_device_config(args.device_config).get("tpu", {}) or {},
+            args.node_name,
+        )
         defaults = parser.parse_args([a for a in ["--node-name", args.node_name]])
         if args.device_split_count == defaults.device_split_count:
             args.device_split_count = int(tpu_cfg.get("deviceSplitCount", args.device_split_count))
@@ -64,6 +67,8 @@ def main() -> None:
             args.device_cores_scaling = float(tpu_cfg.get("deviceCoresScaling", args.device_cores_scaling))
         if args.resource_name == defaults.resource_name:
             args.resource_name = tpu_cfg.get("resourceCountName", args.resource_name)
+        if args.mode == defaults.mode:
+            args.mode = tpu_cfg.get("mode", args.mode)
 
     client = RealKubeClient(base_url=args.kube_api)
     init_global_client(client)

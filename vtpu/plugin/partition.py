@@ -37,8 +37,10 @@ def lock_dir_for(hook_path: str) -> str:
 
 
 def default_lock_dir() -> str:
-    """Fallback when a caller passes no base: HOOK_PATH env (set by the chart
-    in both containers), else /tmp/vtpu for bare processes/tests."""
+    """Fallback for the bare lock primitives only (tests, ad-hoc tooling):
+    HOOK_PATH env when set, else /tmp/vtpu. Runtime code paths — the plugin's
+    apply_partitions and the monitor's pause check — must not rely on this;
+    both plumb lock_dir_for(<--hook-path>) explicitly."""
     hook = os.environ.get("HOOK_PATH", "")
     return lock_dir_for(hook) if hook else "/tmp/vtpu"
 
@@ -107,10 +109,14 @@ class PartitionPlan:
 
 
 def apply_partitions(
-    rm: TpuResourceManager, plans: list[PartitionPlan], base: str | None = None
+    rm: TpuResourceManager, plans: list[PartitionPlan], base: str
 ) -> None:
     """Apply mode changes under the lock, then bump rm so the register loop
-    publishes the new geometry (reference processMigConfigs/ApplyMigTemplate)."""
+    publishes the new geometry (reference processMigConfigs/ApplyMigTemplate).
+
+    *base* is required and MUST be ``lock_dir_for(<--hook-path>)`` — the same
+    derivation the monitor's pause check uses — so the two sides can never
+    disagree about where the lock lives."""
     if not plans:
         return
     create_apply_lock(base)

@@ -22,6 +22,7 @@ log = logging.getLogger(__name__)
 
 REGISTER_ANNO = "vtpu.io/node-tpu-register"
 HANDSHAKE_ANNO = f"{t.NODE_HANDSHAKE_PREFIX}tpu"
+TPU_NODE_LABEL = "vtpu.io/tpu-node"  # reference gpu= node label (e2e node suite)
 
 
 class Registrar:
@@ -40,6 +41,12 @@ class Registrar:
                 REGISTER_ANNO: codec.encode_node_devices(infos),
                 HANDSHAKE_ANNO: f"Reported_{timeutil.format_ts()}",
             },
+        )
+        # Label TPU nodes so DaemonSets/operators can select them; withdrawn
+        # when the inventory empties (reference e2e node-label add/remove,
+        # test/e2e/node/test_node.go:57-91).
+        self.client.patch_node_labels(
+            self.node_name, {TPU_NODE_LABEL: "true" if infos else None}
         )
         log.debug("registered %d chips on %s", len(infos), self.node_name)
 
@@ -65,5 +72,6 @@ class Registrar:
                 self.node_name,
                 {HANDSHAKE_ANNO: codec.handshake_deleted_value()},
             )
+            self.client.patch_node_labels(self.node_name, {TPU_NODE_LABEL: None})
         except ApiError:
             log.exception("deregister handshake")

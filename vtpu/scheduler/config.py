@@ -86,6 +86,28 @@ def load_device_config(path: str = "") -> dict:
     return yaml.safe_load(DEFAULT_DEVICE_CONFIG_YAML) or {}
 
 
+def merge_node_config(tpu_section: dict, node_name: str) -> dict:
+    """Apply a per-node override stanza onto the cluster-wide tpu section
+    (reference DevicePluginConfigs.Nodeconfig, mergo-merged per node,
+    nvidia/device.go:145-155; plugin/server.go:122-163)::
+
+        tpu:
+          deviceSplitCount: 4
+          nodeconfig:
+            - name: tpu-node-7        # exact node name
+              deviceSplitCount: 8
+              deviceMemoryScaling: 1.5
+              mode: exclusive
+
+    Later matching entries win over earlier ones; the ``nodeconfig`` key
+    itself never leaks into the merged result."""
+    merged = {k: v for k, v in tpu_section.items() if k != "nodeconfig"}
+    for entry in tpu_section.get("nodeconfig") or []:
+        if entry.get("name") == node_name:
+            merged.update({k: v for k, v in entry.items() if k != "name"})
+    return merged
+
+
 def tpu_config_from_dict(d: dict) -> TpuConfig:
     return TpuConfig(
         resource_count_name=d.get("resourceCountName", "google.com/tpu"),

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import ssl
 import threading
 import time
@@ -135,15 +136,44 @@ class SchedulerServer:
         tls_cert: str = "",
         tls_key: str = "",
         profiling: bool = False,
+        cert_watch_interval: float = 30.0,
     ) -> None:
         self.httpd = ThreadingHTTPServer(
             (host, port), make_handler(scheduler, webhook, profiling=profiling)
         )
+        self._stop_watch = threading.Event()
         if tls_cert and tls_key:
             ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
             ctx.load_cert_chain(tls_cert, tls_key)
             self.httpd.socket = ctx.wrap_socket(self.httpd.socket, server_side=True)
+            # cert-manager rotates the secret in place; reload so new
+            # handshakes pick up the fresh pair without a restart (reference
+            # cert-watcher, cmd/scheduler/main.go:158-190)
+            threading.Thread(
+                target=self._watch_certs,
+                args=(ctx, tls_cert, tls_key, cert_watch_interval),
+                daemon=True, name="cert-watcher",
+            ).start()
         self._thread: threading.Thread | None = None
+
+    def _watch_certs(self, ctx: ssl.SSLContext, cert: str, key: str,
+                     interval: float = 30.0) -> None:
+        def stamp() -> tuple:
+            try:
+                return (os.stat(cert).st_mtime, os.stat(key).st_mtime)
+            except OSError:
+                return (0, 0)
+
+        last = stamp()
+        while not self._stop_watch.wait(interval):
+            cur = stamp()
+            if cur != last and cur != (0, 0):
+                try:
+                    ctx.load_cert_chain(cert, key)
+                    log.info("reloaded rotated TLS certificate")
+                    last = cur
+                except (OSError, ssl.SSLError):
+                    log.exception("TLS reload failed; keeping previous cert")
 
     @property
     def port(self) -> int:
@@ -157,5 +187,6 @@ class SchedulerServer:
         self.httpd.serve_forever()
 
     def shutdown(self) -> None:
+        self._stop_watch.set()
         self.httpd.shutdown()
         self.httpd.server_close()

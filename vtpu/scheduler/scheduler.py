@@ -59,6 +59,7 @@ class Scheduler:
         self.events = EventRecorder(client)
         self.quota_manager.refresh_managed_resources()
         self._lock = threading.RLock()
+        self._filter_lock = threading.Lock()
         self._synced = threading.Event()
         self._stop = threading.Event()
         self._leader_check = leader_check or (lambda: True)
@@ -256,6 +257,14 @@ class Scheduler:
                 "FailedNodes": {},
                 "Error": "pod requests no schedulable device",
             }
+        # The snapshot -> fit -> record section must be atomic: two concurrent
+        # Filters would otherwise both fit into the same free slot and
+        # overcommit a chip. kube-scheduler's scheduling cycle is sequential,
+        # but simulation calls and multi-scheduler setups are not.
+        with self._filter_lock:
+            return self._filter_locked(args, pod, requests)
+
+    def _filter_locked(self, args: dict, pod: dict, requests) -> dict:
 
         # Volcano-style simulation: full Node objects instead of names
         # (reference filterSimulation:990-1033): score only, no annotations.
