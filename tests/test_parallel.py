@@ -42,6 +42,33 @@ def test_ring_attention_matches_reference():
 
 
 @needs8
+def test_ulysses_attention_matches_reference():
+    from vtpu.parallel.ulysses import ulysses_attention
+
+    mesh = make_sp_mesh(8)
+    k1, k2, k3 = jax.random.split(jax.random.key(1), 3)
+    shape = (2, 64, 8, 16)  # H=8 divides the 8-way mesh; S=64 -> chunks of 8
+    q = jax.random.normal(k1, shape, jnp.float32)
+    k = jax.random.normal(k2, shape, jnp.float32)
+    v = jax.random.normal(k3, shape, jnp.float32)
+    want = causal_attention(q, k, v)
+    got = ulysses_attention(q, k, v, mesh)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+@needs8
+def test_ulysses_rejects_indivisible_heads():
+    import pytest
+
+    from vtpu.parallel.ulysses import ulysses_attention
+
+    mesh = make_sp_mesh(8)
+    q = jnp.zeros((1, 16, 6, 8))  # 6 heads over 8 devices
+    with pytest.raises(ValueError, match="ring_attention instead"):
+        ulysses_attention(q, q, q, mesh)
+
+
+@needs8
 def test_sharded_prefill_matches_single_device():
     mesh = make_mesh(8)  # dp=2, tp=4
     params = init_params(jax.random.key(0), CFG)

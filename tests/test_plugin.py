@@ -214,6 +214,29 @@ def test_cdi_spec_and_qualified_devices(mock_chips, tmp_path):
     sched.stop()
 
 
+def test_allocate_exclusive_repartitions_chip(served_plugin):
+    """An exclusive ask pins the chip's operating mode via the dynamic
+    repartition path (reference processMigConfigs during Allocate)."""
+    client, rm, stub, config = served_plugin
+    sched = Scheduler(client)
+    register_tpu_backend(quota=sched.quota_manager)
+    sched.start(register_interval=3600)
+    pod = client.put_pod(tpu_pod("excl", tpu=1, tpucores=100))
+    assert sched.filter({"Pod": pod, "NodeNames": ["host1"]})["NodeNames"] == ["host1"]
+    assert sched.bind({"PodName": "excl", "PodNamespace": "default",
+                       "Node": "host1"})["Error"] == ""
+    resp = stub.Allocate(pb.AllocateRequest(
+        container_requests=[pb.ContainerAllocateRequest(devicesIDs=["host1-tpu-0::0"])]))
+    assert len(resp.container_responses) == 1
+    allocated = [c for c in rm.chips if (c.mode or "") == "exclusive"]
+    assert len(allocated) == 1  # the assigned chip was pinned exclusive
+    # the apply lock was released (monitor resumes)
+    from vtpu.plugin.partition import lock_dir_for, lock_held
+
+    assert not lock_held(lock_dir_for(config.hook_path))
+    sched.stop()
+
+
 def test_allocate_without_pending_pod_fails(served_plugin):
     _, _, stub, _ = served_plugin
     with pytest.raises(grpc.RpcError) as exc:

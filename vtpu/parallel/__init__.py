@@ -11,6 +11,7 @@ XLA lowers onto ICI, and a ring-attention sequence-parallel kernel built on
 from vtpu.parallel.mesh import make_mesh, mesh_shape_for
 from vtpu.parallel.sharding import param_shardings, shard_params
 from vtpu.parallel.ring import ring_attention
+from vtpu.parallel.ulysses import ulysses_attention
 from vtpu.parallel.train import make_train_step, init_train_state
 
 __all__ = [
@@ -19,6 +20,7 @@ __all__ = [
     "param_shardings",
     "shard_params",
     "ring_attention",
+    "ulysses_attention",
     "make_train_step",
     "init_train_state",
 ]

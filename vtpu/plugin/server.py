@@ -25,7 +25,7 @@ import grpc
 
 from vtpu.device import codec
 from vtpu.device.types import ContainerDevices
-from vtpu.plugin import envs
+from vtpu.plugin import envs, partition
 from vtpu.plugin.api import deviceplugin_pb2 as pb
 from vtpu.plugin.api import grpc_api
 from vtpu.plugin.rm import TpuResourceManager
@@ -193,6 +193,25 @@ class TpuDevicePlugin:
                 f"kubelet asked for {len(request.container_requests)} containers "
                 f"but only {len(pending)} assignments remain"
             )
+        # Dynamic repartition (reference processMigConfigs before Allocate
+        # returns, plugin/server.go:960-1002): an exclusive ask pins the chip's
+        # operating mode so the next register publishes the new geometry. Runs
+        # under the apply lock; the monitor pauses meanwhile.
+        plans = []
+        for _slot_idx, devices in pending:
+            for dev in devices:
+                chip = self.rm.chip_by_uuid(dev.uuid)
+                if (
+                    chip is not None
+                    and dev.usedcores >= 100
+                    and (chip.mode or "") != "exclusive"
+                ):
+                    plans.append(partition.PartitionPlan(uuid=dev.uuid, mode="exclusive"))
+        if plans:
+            partition.apply_partitions(
+                self.rm, plans, partition.lock_dir_for(self.config.hook_path)
+            )
+
         responses = []
         consumed: list[int] = []
         for creq, (slot_idx, devices) in zip(request.container_requests, pending):
