@@ -15,6 +15,7 @@ from vtpu.models.transformer import (
     decode_step,
     greedy_generate,
 )
+from vtpu.models.moe import MoEConfig, init_moe_params, moe_forward, moe_loss
 
 __all__ = [
     "ModelConfig",
@@ -23,4 +24,8 @@ __all__ = [
     "prefill",
     "decode_step",
     "greedy_generate",
+    "MoEConfig",
+    "init_moe_params",
+    "moe_forward",
+    "moe_loss",
 ]

@@ -1,0 +1,179 @@
+"""Mixture-of-Experts transformer: the second model family of the data plane.
+
+GShard-style top-k routing with STATIC shapes end to end -- the TPU contract:
+- expert capacity is a compile-time constant (ceil(k*T/E * capacity_factor)),
+  so dispatch/combine are dense one-hot einsums the MXU eats whole; no
+  dynamic gather/scatter, no data-dependent shapes under jit;
+- per-layer expert weights are stacked [L, E, D, F] and the layer loop is one
+  `lax.scan`, same as the dense flagship (vtpu/models/transformer.py);
+- expert parallelism shards the E axis over an 'ep' mesh axis -- either via
+  NamedSharding annotations (XLA inserts the all-to-alls; used by the train
+  step) or the explicit `shard_map` path in vtpu/parallel/expert.py.
+
+The reference middleware has no model code (SURVEY.md §2.6); this family
+exists so the benchmark/dryrun exercise a real EP workload under vTPU limits.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from vtpu.ops import rms_norm, apply_rope, rope_angles, causal_attention
+
+Params = dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    vocab: int = 2048
+    d_model: int = 512
+    n_heads: int = 4
+    n_layers: int = 4
+    d_ff: int = 1024          # per-expert hidden width
+    n_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 2.0
+    max_seq: int = 1024
+    head_dim: int = 128
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def qkv_dim(self) -> int:
+        return self.n_heads * self.head_dim
+
+    def capacity(self, tokens: int) -> int:
+        """Static per-expert slot count for a `tokens`-token batch."""
+        return max(1, math.ceil(self.top_k * tokens / self.n_experts * self.capacity_factor))
+
+
+def init_moe_params(rng: jax.Array, cfg: MoEConfig) -> Params:
+    """Stacked [L, ...] tensors; experts stacked on their own axis [L, E, ...]."""
+    keys = jax.random.split(rng, 9)
+    d, f, l, e, qd = cfg.d_model, cfg.d_ff, cfg.n_layers, cfg.n_experts, cfg.qkv_dim
+
+    def w(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32) / math.sqrt(fan_in)).astype(cfg.dtype)
+
+    return {
+        "embed": w(keys[0], (cfg.vocab, d), d),
+        "layers": {
+            "wq": w(keys[1], (l, d, qd), d),
+            "wk": w(keys[2], (l, d, qd), d),
+            "wv": w(keys[3], (l, d, qd), d),
+            "wo": w(keys[4], (l, qd, d), qd),
+            # router stays f32: tiny matmul, and softmax over experts is
+            # numerically load-bearing for balanced routing
+            "router": (jax.random.normal(keys[5], (l, d, e), jnp.float32) / math.sqrt(d)),
+            "w_gate": w(keys[6], (l, e, d, f), d),
+            "w_up": w(keys[7], (l, e, d, f), d),
+            "w_down": w(keys[8], (l, e, f, d), f),
+            "attn_norm": jnp.ones((l, d), cfg.dtype),
+            "mlp_norm": jnp.ones((l, d), cfg.dtype),
+        },
+        "final_norm": jnp.ones((d,), cfg.dtype),
+    }
+
+
+def route(
+    router_w: jax.Array, x: jax.Array, cfg: MoEConfig, capacity: int
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Top-k routing over flat tokens x: [T, D].
+
+    Returns (dispatch [T, E, C] one-hot, combine [T, E, C] gate weights,
+    aux load-balancing loss scalar). Tokens beyond an expert's capacity are
+    dropped (their combine row is zero -> residual passes them through),
+    matching GShard semantics with k-th-choice priority ordering.
+    """
+    t, e = x.shape[0], cfg.n_experts
+    logits = x.astype(jnp.float32) @ router_w  # [T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate_vals, gate_idx = jax.lax.top_k(probs, cfg.top_k)  # [T, k]
+    gate_vals = gate_vals / jnp.maximum(jnp.sum(gate_vals, -1, keepdims=True), 1e-9)
+
+    dispatch = jnp.zeros((t, e, capacity), jnp.float32)
+    combine = jnp.zeros((t, e, capacity), jnp.float32)
+    prev_counts = jnp.zeros((e,), jnp.int32)
+    for j in range(cfg.top_k):  # static unroll (top_k is 2)
+        onehot = jax.nn.one_hot(gate_idx[:, j], e, dtype=jnp.int32)  # [T, E]
+        pos_all = jnp.cumsum(onehot, axis=0) - onehot + prev_counts[None, :]
+        pos = jnp.sum(pos_all * onehot, axis=-1)  # [T] slot within chosen expert
+        keep = pos < capacity
+        prev_counts = prev_counts + jnp.sum(onehot, axis=0)
+        slot = jax.nn.one_hot(pos, capacity, dtype=jnp.float32) * keep[:, None]  # [T, C]
+        hot = onehot.astype(jnp.float32)[:, :, None] * slot[:, None, :]  # [T, E, C]
+        dispatch = dispatch + hot
+        combine = combine + gate_vals[:, j][:, None, None] * hot
+
+    # load-balancing auxiliary (Switch/GShard): E * mean(frac_tokens * mean_prob)
+    frac = jnp.mean(jax.nn.one_hot(gate_idx[:, 0], e, dtype=jnp.float32), axis=0)
+    aux = e * jnp.sum(frac * jnp.mean(probs, axis=0))
+    return dispatch, combine, aux
+
+
+def expert_ffn(lp_e: dict[str, jax.Array], slots: jax.Array) -> jax.Array:
+    """SwiGLU over dispatched slots [E, C, D] with per-expert weights [E, D, F]."""
+    gate = jnp.einsum("ecd,edf->ecf", slots, lp_e["w_gate"])
+    up = jnp.einsum("ecd,edf->ecf", slots, lp_e["w_up"])
+    act = jax.nn.silu(gate.astype(jnp.float32)).astype(slots.dtype) * up
+    return jnp.einsum("ecf,efd->ecd", act, lp_e["w_down"])
+
+
+def moe_ffn(lp: dict[str, jax.Array], x: jax.Array, cfg: MoEConfig) -> tuple[jax.Array, jax.Array]:
+    """Single-device (or annotation-sharded) MoE block. x: [B, S, D].
+
+    With `w_gate`/`w_up`/`w_down` sharded P('ep') on the expert axis, XLA turns
+    the dispatch/combine einsums into all-to-alls over 'ep' by itself -- the
+    pjit path. Returns (out [B, S, D], aux_loss).
+    """
+    b, s, d = x.shape
+    flat = x.reshape(b * s, d)
+    cap = cfg.capacity(b * s)
+    dispatch, combine, aux = route(lp["router"], flat, cfg, cap)
+    slots = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), flat)  # [E, C, D]
+    out_slots = expert_ffn(lp, slots)
+    out = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), out_slots)
+    return out.reshape(b, s, d), aux
+
+
+def moe_forward(
+    params: Params, cfg: MoEConfig, tokens: jax.Array, ffn=moe_ffn
+) -> tuple[jax.Array, jax.Array]:
+    """Full-sequence forward. tokens: [B, S] -> (logits [B, S, V], aux loss).
+
+    `ffn` is injectable so vtpu/parallel/expert.py can swap in the shard_map
+    expert-parallel block without duplicating the trunk.
+    """
+    b, s = tokens.shape
+    cos, sin = rope_angles(cfg.max_seq, cfg.head_dim)
+    positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+    x = params["embed"][tokens].astype(cfg.dtype)
+
+    def layer(carry, lp):
+        x, aux = carry
+        h, dh = cfg.n_heads, cfg.head_dim
+        normed = rms_norm(x, lp["attn_norm"])
+        q = apply_rope((normed @ lp["wq"]).reshape(b, s, h, dh), cos, sin, positions)
+        k = apply_rope((normed @ lp["wk"]).reshape(b, s, h, dh), cos, sin, positions)
+        v = (normed @ lp["wv"]).reshape(b, s, h, dh)
+        x = x + causal_attention(q, k, v).reshape(b, s, cfg.qkv_dim) @ lp["wo"]
+        moe_out, layer_aux = ffn(lp, rms_norm(x, lp["mlp_norm"]), cfg)
+        return (x + moe_out, aux + layer_aux), None
+
+    (x, aux), _ = jax.lax.scan(layer, (x, jnp.float32(0.0)), params["layers"])
+    x = rms_norm(x, params["final_norm"])
+    logits = (x @ params["embed"].T).astype(jnp.float32)
+    return logits, aux / cfg.n_layers
+
+
+def moe_loss(params: Params, cfg: MoEConfig, tokens: jax.Array, ffn=moe_ffn) -> jax.Array:
+    """Next-token cross-entropy + 0.01 * load-balancing aux."""
+    logits, aux = moe_forward(params, cfg, tokens, ffn=ffn)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1).mean()
+    return nll + 0.01 * aux

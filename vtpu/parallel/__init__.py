@@ -8,19 +8,25 @@ XLA lowers onto ICI, and a ring-attention sequence-parallel kernel built on
 `shard_map` + `ppermute`.
 """
 
-from vtpu.parallel.mesh import make_mesh, mesh_shape_for
+from vtpu.parallel.mesh import make_mesh, mesh_shape_for, make_axis_mesh, make_dp_ep_mesh
 from vtpu.parallel.sharding import param_shardings, shard_params
 from vtpu.parallel.ring import ring_attention
 from vtpu.parallel.ulysses import ulysses_attention
+from vtpu.parallel.expert import ep_moe_forward, make_ep_ffn, moe_param_shardings
 from vtpu.parallel.train import make_train_step, init_train_state
 
 __all__ = [
     "make_mesh",
     "mesh_shape_for",
+    "make_axis_mesh",
+    "make_dp_ep_mesh",
     "param_shardings",
     "shard_params",
     "ring_attention",
     "ulysses_attention",
+    "ep_moe_forward",
+    "make_ep_ffn",
+    "moe_param_shardings",
     "make_train_step",
     "init_train_state",
 ]

@@ -42,9 +42,33 @@ def make_mesh(
     return Mesh(grid, ("dp", "tp"))
 
 
-def make_sp_mesh(n_devices: int | None = None, devices: list | None = None) -> Mesh:
-    """1D ('sp',) mesh for ring-attention sequence parallelism."""
+def make_axis_mesh(axis: str, n_devices: int | None = None, devices: list | None = None) -> Mesh:
+    """1D mesh over an arbitrary named axis ('sp' for sequence, 'ep' for
+    expert, 'pp' for pipeline parallelism)."""
     devs = devices if devices is not None else jax.devices()
     if n_devices is None:
         n_devices = len(devs)
-    return Mesh(np.asarray(devs[:n_devices]), ("sp",))
+    return Mesh(np.asarray(devs[:n_devices]), (axis,))
+
+
+def make_sp_mesh(n_devices: int | None = None, devices: list | None = None) -> Mesh:
+    """1D ('sp',) mesh for ring-attention sequence parallelism."""
+    return make_axis_mesh("sp", n_devices, devices)
+
+
+def make_dp_ep_mesh(
+    n_devices: int | None = None, ep: int | None = None, devices: list | None = None
+) -> Mesh:
+    """2D ('dp', 'ep') mesh for expert-parallel training: 'ep' is the inner
+    (fast-ICI) axis because the MoE all-to-all is the chatty collective."""
+    devs = devices if devices is not None else jax.devices()
+    if n_devices is None:
+        n_devices = len(devs)
+    if ep is None:
+        ep = 1
+        while ep * 2 <= min(4, n_devices) and n_devices % (ep * 2) == 0:
+            ep *= 2
+    if n_devices % ep:
+        raise ValueError(f"ep={ep} does not divide n_devices={n_devices}")
+    grid = np.asarray(devs[:n_devices]).reshape(n_devices // ep, ep)
+    return Mesh(grid, ("dp", "ep"))

@@ -198,7 +198,10 @@ class TpuDevicePlugin:
         # operating mode so the next register publishes the new geometry. Runs
         # under the apply lock; the monitor pauses meanwhile.
         plans = []
-        for _slot_idx, devices in pending:
+        # only the slots THIS call consumes: repartitioning ahead of a
+        # container that may never be allocated would pin its chip exclusive
+        # with nothing to revert it if the pod dies first
+        for _slot_idx, devices in pending[: len(request.container_requests)]:
             for dev in devices:
                 chip = self.rm.chip_by_uuid(dev.uuid)
                 if (
