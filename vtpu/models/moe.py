@@ -172,8 +172,7 @@ def moe_forward(
 
 def moe_loss(params: Params, cfg: MoEConfig, tokens: jax.Array, ffn=moe_ffn) -> jax.Array:
     """Next-token cross-entropy + 0.01 * load-balancing aux."""
+    from vtpu.ops.loss import next_token_ce
+
     logits, aux = moe_forward(params, cfg, tokens, ffn=ffn)
-    targets = tokens[:, 1:]
-    logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1).mean()
-    return nll + 0.01 * aux
+    return next_token_ce(logits, tokens) + 0.01 * aux

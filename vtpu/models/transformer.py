@@ -91,6 +91,25 @@ def _mlp_block(lp, x):
     return (gate * (normed @ lp["w_up"])) @ lp["w_down"]
 
 
+def transformer_layer(
+    cfg: ModelConfig, lp: dict[str, jax.Array], x: jax.Array, cos, sin, positions
+) -> tuple[jax.Array, tuple[jax.Array, jax.Array]]:
+    """One decoder block over a full sequence. x: [B, S, D] -> (x, (k, v)).
+
+    Shared by the dense prefill scan and the pipelined stage body
+    (vtpu/parallel/pipeline.py) so the block exists exactly once.
+    """
+    b, s, _ = x.shape
+    q, k, v = _qkv(cfg, lp, x, cos, sin, positions)
+    if cfg.use_pallas and s % 128 == 0:
+        attn = flash_attention(q, k, v)
+    else:
+        attn = causal_attention(q, k, v)
+    x = x + attn.reshape(b, s, cfg.qkv_dim) @ lp["wo"]
+    x = x + _mlp_block(lp, x)
+    return x, (k, v)
+
+
 def prefill(
     params: Params, cfg: ModelConfig, tokens: jax.Array
 ) -> tuple[jax.Array, dict[str, jax.Array]]:
@@ -101,14 +120,7 @@ def prefill(
     x = params["embed"][tokens].astype(cfg.dtype)
 
     def layer(x, lp):
-        q, k, v = _qkv(cfg, lp, x, cos, sin, positions)
-        if cfg.use_pallas and s % 128 == 0:
-            attn = flash_attention(q, k, v)
-        else:
-            attn = causal_attention(q, k, v)
-        x = x + attn.reshape(b, s, cfg.qkv_dim) @ lp["wo"]
-        x = x + _mlp_block(lp, x)
-        return x, (k, v)
+        return transformer_layer(cfg, lp, x, cos, sin, positions)
 
     x, (ks, vs) = jax.lax.scan(layer, x, params["layers"])
     x = rms_norm(x, params["final_norm"])

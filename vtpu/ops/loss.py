@@ -1,0 +1,13 @@
+"""Shared loss reductions for the training paths (dense, MoE, pipelined)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def next_token_ce(logits: jax.Array, tokens: jax.Array) -> jax.Array:
+    """Next-token cross-entropy. logits: [B, S, V] (f32), tokens: [B, S]."""
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+    return -jnp.take_along_axis(logp, targets[..., None], axis=-1).mean()

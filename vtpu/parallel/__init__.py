@@ -13,6 +13,7 @@ from vtpu.parallel.sharding import param_shardings, shard_params
 from vtpu.parallel.ring import ring_attention
 from vtpu.parallel.ulysses import ulysses_attention
 from vtpu.parallel.expert import ep_moe_forward, make_ep_ffn, moe_param_shardings
+from vtpu.parallel.pipeline import pipeline_apply, pp_transformer_forward, pp_loss, microbatch
 from vtpu.parallel.train import make_train_step, init_train_state
 
 __all__ = [
@@ -27,6 +28,10 @@ __all__ = [
     "ep_moe_forward",
     "make_ep_ffn",
     "moe_param_shardings",
+    "pipeline_apply",
+    "pp_transformer_forward",
+    "pp_loss",
+    "microbatch",
     "make_train_step",
     "init_train_state",
 ]

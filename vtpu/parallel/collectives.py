@@ -1,0 +1,16 @@
+"""Small shard_map helpers shared by the ring/pipeline/expert kernels."""
+
+from __future__ import annotations
+
+import jax
+
+
+def pvary(x, axis: str):
+    """Mark x as varying over `axis` (zero-init scan carries under shard_map).
+
+    jax >= 0.9 renames `lax.pvary` to `lax.pcast(..., to='varying')`; support
+    both so the kernels track the live API without a hard version pin.
+    """
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, axis, to="varying")
+    return jax.lax.pvary(x, axis)

@@ -17,6 +17,8 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
+from vtpu.parallel.collectives import pvary
+
 _NEG = -1e30
 
 
@@ -32,9 +34,9 @@ def _local_ring(q, k, v, *, axis: str):
 
     # mark the zero-init accumulators as varying over the ring axis, else the
     # fori_loop carry types disagree under shard_map's varying-axis tracking
-    o0 = jax.lax.pvary(jnp.zeros((b, h, s_loc, dh), jnp.float32), axis)
-    m0 = jax.lax.pvary(jnp.full((b, h, s_loc), _NEG, jnp.float32), axis)
-    l0 = jax.lax.pvary(jnp.zeros((b, h, s_loc), jnp.float32), axis)
+    o0 = pvary(jnp.zeros((b, h, s_loc, dh), jnp.float32), axis)
+    m0 = pvary(jnp.full((b, h, s_loc), _NEG, jnp.float32), axis)
+    l0 = pvary(jnp.zeros((b, h, s_loc), jnp.float32), axis)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     def body(t, carry):

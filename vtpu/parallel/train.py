@@ -20,11 +20,10 @@ from vtpu.parallel.sharding import shard_params, batch_sharding
 
 
 def next_token_loss(params: Any, cfg: ModelConfig, tokens: jax.Array) -> jax.Array:
+    from vtpu.ops.loss import next_token_ce
+
     logits, _ = prefill(params, cfg, tokens)  # [B, S, V] f32
-    logp = jax.nn.log_softmax(logits[:, :-1])
-    tgt = tokens[:, 1:]
-    nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
-    return jnp.mean(nll)
+    return next_token_ce(logits, tokens)
 
 
 def init_train_state(rng: jax.Array, cfg: ModelConfig, mesh, lr: float = 1e-3):
