@@ -1,5 +1,7 @@
 """Pipeline parallelism on the virtual 8-device CPU mesh."""
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -54,7 +56,7 @@ def test_pp_transformer_matches_dense_prefill():
 def test_pipeline_rejects_bad_geometry():
     mesh = make_axis_mesh("pp", 8)
     params = init_params(jax.random.key(0), CFG)
-    bad = ModelConfig(**{**CFG.__dict__, "n_layers": 6})
+    bad = dataclasses.replace(CFG, n_layers=6)
     with pytest.raises(ValueError, match="not divisible"):
         pp_transformer_forward(init_params(jax.random.key(0), bad), bad,
                                jnp.zeros((8, 16), jnp.int32), mesh)
