@@ -163,6 +163,72 @@ def test_full_pipeline_schedule_allocate_enforce(stack, libvtpu_build, tmp_path)
     assert snap.devices[0].hbm_limit_bytes == 4096 * 1024 * 1024
 
 
+def test_multihost_gang_over_real_transports(monkeypatch, tmp_path):
+    """Two slice-workers pods gang onto both hosts of one slice via the HTTP
+    extender, and each host's Allocate injects its own TPU_WORKER_* wiring."""
+    monkeypatch.setenv("VTPU_MOCK_DEVICES", "4")
+    nodes = ("mh-0", "mh-1")
+    client = FakeKubeClient()
+    servers = []
+    socks = {}
+    rms = {}
+    for wid, node in enumerate(nodes):
+        client.put_node({"metadata": {"name": node}})
+        monkeypatch.setenv("VTPU_MOCK_SLICE", f"fab:{wid}:2:v5e-16:4x4")
+        chips = discover_chips(split_count=4, hostname=node)
+        rm = TpuResourceManager(chips, split_count=4)
+        from vtpu.plugin.rm import discover_slice
+
+        sl = discover_slice()
+        Registrar(client, rm, node, slice_info=sl).register_once()
+        plugin = TpuDevicePlugin(
+            rm, client,
+            PluginConfig(node_name=node, hook_path=str(tmp_path / f"hook{wid}"),
+                         slice_info=sl),
+        )
+        sock = str(tmp_path / f"vtpu-{wid}.sock")
+        pserver = PluginServer(plugin, sock)
+        pserver.start()
+        servers.append(pserver)
+        socks[node] = sock
+        rms[node] = rm
+    sched = Scheduler(client)
+    register_tpu_backend(quota=sched.quota_manager)
+    sched.start(register_interval=3600)
+    server = SchedulerServer(sched, WebHook(sched.quota_manager), host="127.0.0.1", port=0)
+    server.start_background()
+    try:
+        gang = {"pod-group.scheduling.sigs.k8s.io/name": "train",
+                t.SLICE_WORKERS_ANNO: "2",
+                t.WORKER_HOSTNAMES_ANNO: "train-0.hs,train-1.hs"}
+        placed = []
+        for i in range(2):
+            pod = _admit(server.port, tpu_pod(f"train-{i}", tpu=4, annotations=gang))
+            pod = client.put_pod(pod)
+            result = _post(server.port, "/filter", {"Pod": pod, "NodeNames": list(nodes)})
+            assert result["Error"] == "" and len(result["NodeNames"]) == 1, result
+            node = result["NodeNames"][0]
+            placed.append(node)
+            r = _post(server.port, "/bind",
+                      {"PodName": f"train-{i}", "PodNamespace": "default", "Node": node})
+            assert r["Error"] == ""
+            with grpc.insecure_channel(f"unix://{socks[node]}") as channel:
+                stub = DevicePluginStub(channel)
+                resp = stub.Allocate(pb.AllocateRequest(container_requests=[
+                    pb.ContainerAllocateRequest(devicesIDs=[]),
+                ]), timeout=10)
+            env = dict(resp.container_responses[0].envs)
+            assert env["TPU_WORKER_HOSTNAMES"] == "train-0.hs,train-1.hs"
+            assert env["TPU_ACCELERATOR_TYPE"] == "v5e-16"
+            assert env["TPU_WORKER_ID"] == ("0" if node == "mh-0" else "1")
+        assert sorted(placed) == list(nodes)  # one worker per host
+    finally:
+        for s in servers:
+            s.stop()
+        server.shutdown()
+        sched.stop()
+
+
 def test_overcommit_pod_stays_pending(stack):
     client, sched, port, _sock = stack
     pod = _admit(port, tpu_pod("greedy", tpumem=999999))

@@ -1,0 +1,271 @@
+"""Multi-host slice placement + worker env injection.
+
+The TPU-native analog of the reference's IMEX cross-node channel layer
+(nvinternal/imex): nodes publish slice membership (vtpu.io/node-slice), the
+scheduler gangs slice-workers pods onto distinct hosts of ONE physical slice,
+and Allocate injects the TPU_WORKER_* / MEGASCALE_* wiring envs.
+"""
+
+import pytest
+
+from vtpu.device.types import SliceInfo
+from vtpu.plugin.rm import discover_slice
+from vtpu.scheduler.scheduler import Scheduler
+from vtpu.util import types as t
+
+from tests.helpers import fake_cluster, register_tpu_backend, tpu_pod, v5e_devices
+
+GANG = {"pod-group.scheduling.sigs.k8s.io/name": "trainjob"}
+
+
+def _slice_anno(slice_id, worker, num, accel="v5p-16", topo="2x2x4"):
+    return SliceInfo(slice_id, worker, num, accel, topo).encode()
+
+
+@pytest.fixture
+def cluster():
+    # two 2-host slices (s1: a0,a1; s2: b0,b1) + one single-host node
+    client = fake_cluster({
+        "a0": v5e_devices(4, prefix="a0"),
+        "a1": v5e_devices(4, prefix="a1"),
+        "b0": v5e_devices(4, prefix="b0"),
+        "b1": v5e_devices(4, prefix="b1"),
+        "solo": v5e_devices(4, prefix="solo"),
+    })
+    for node, (sid, wid) in {
+        "a0": ("s1", 0), "a1": ("s1", 1), "b0": ("s2", 0), "b1": ("s2", 1),
+    }.items():
+        client.patch_node_annotations(node, {t.NODE_SLICE_ANNO: _slice_anno(sid, wid, 2)})
+    sched = Scheduler(client)
+    register_tpu_backend(quota=sched.quota_manager)
+    sched.start(register_interval=3600)
+    yield client, sched
+    sched.stop()
+
+
+def _worker(name, workers=2, annos=None):
+    a = {t.SLICE_WORKERS_ANNO: str(workers), **GANG, **(annos or {})}
+    return tpu_pod(name, tpu=4, annotations=a)
+
+
+ALL_NODES = ("a0", "a1", "b0", "b1", "solo")
+
+
+def _filter(sched, client, pod, nodes=ALL_NODES):
+    pod = client.put_pod(pod)
+    return pod, sched.filter({"Pod": pod, "NodeNames": list(nodes)})
+
+
+def test_slice_info_codec_roundtrip():
+    si = SliceInfo("slice-a", 1, 4, "v5p-32", "2x4x4")
+    assert SliceInfo.decode(si.encode()) == si
+    with pytest.raises(ValueError):
+        SliceInfo.decode(",0,2,x,y")  # empty slice id
+    with pytest.raises(ValueError):
+        SliceInfo.decode("only,three,fields")
+
+
+def test_gang_lands_on_one_slice_distinct_hosts(cluster):
+    client, sched = cluster
+    _, r1 = _filter(sched, client, _worker("w0"))
+    assert r1["Error"] == "" and len(r1["NodeNames"]) == 1
+    first = r1["NodeNames"][0]
+    assert first != "solo"  # singleton host can't run a 2-host gang
+    _, r2 = _filter(sched, client, _worker("w1"))
+    second = r2["NodeNames"][0]
+    assert second != first
+    # both workers on the same physical slice
+    slice_of = {"a0": "s1", "a1": "s1", "b0": "s2", "b1": "s2"}
+    assert slice_of[first] == slice_of[second]
+
+
+def test_gang_overflow_fails_when_slice_full(cluster):
+    client, sched = cluster
+    _filter(sched, client, _worker("w0"))
+    _filter(sched, client, _worker("w1"))
+    _, r3 = _filter(sched, client, _worker("w2"))
+    assert r3["NodeNames"] == []
+    # hosts of the pinned slice are "already runs a worker", others are
+    # "pinned to" the gang's slice
+    assert any("already runs a worker" in v for v in r3["FailedNodes"].values())
+
+
+def test_slice_workers_requires_pod_group(cluster):
+    client, sched = cluster
+    pod = tpu_pod("lonely", tpu=4, annotations={t.SLICE_WORKERS_ANNO: "2"})
+    _, r = _filter(sched, client, pod)
+    assert r["NodeNames"] == []
+    assert all("pod-group" in v for v in r["FailedNodes"].values())
+
+
+def test_too_small_slices_rejected(cluster):
+    client, sched = cluster
+    _, r = _filter(sched, client, _worker("w0", workers=3))
+    assert r["NodeNames"] == []
+    reasons = set(r["FailedNodes"].values())
+    assert any("gang needs 3" in v for v in reasons)
+
+
+def test_right_sized_slice_preferred():
+    # one 4-host slice and one 2-host slice; a 2-worker gang must spare the
+    # big fabric
+    client = fake_cluster({
+        f"n{i}": v5e_devices(4, prefix=f"n{i}") for i in range(6)
+    })
+    for i in range(4):
+        client.patch_node_annotations(f"n{i}", {t.NODE_SLICE_ANNO: _slice_anno("big", i, 4)})
+    for i in (4, 5):
+        client.patch_node_annotations(f"n{i}", {t.NODE_SLICE_ANNO: _slice_anno("small", i - 4, 2)})
+    sched = Scheduler(client)
+    register_tpu_backend(quota=sched.quota_manager)
+    sched.start(register_interval=3600)
+    try:
+        pod = client.put_pod(_worker("w0"))
+        r = sched.filter({"Pod": pod, "NodeNames": [f"n{i}" for i in range(6)]})
+        assert r["NodeNames"][0] in ("n4", "n5")
+    finally:
+        sched.stop()
+
+
+def test_gangs_are_namespace_scoped(cluster):
+    """Same pod-group name in two namespaces = two independent gangs."""
+    client, sched = cluster
+    _, r1 = _filter(sched, client, _worker("w0"))
+    p2 = tpu_pod("w0", tpu=4, ns="other",
+                 annotations={t.SLICE_WORKERS_ANNO: "2", **GANG})
+    p2["metadata"]["uid"] = "uid-other-w0"
+    _, r2 = _filter(sched, client, p2)
+    # other-namespace gang is NOT pinned to ns default's slice and may even
+    # reuse the same host
+    assert r2["Error"] == "" and len(r2["NodeNames"]) == 1
+
+
+def test_coordinator_pod_does_not_pin_gang(cluster):
+    """A same-gang pod WITHOUT slice-workers (e.g. a coordinator) neither
+    pins the slice nor blacklists its host."""
+    client, sched = cluster
+    coord = tpu_pod("coord", tpumem=1024, annotations=dict(GANG))
+    _, rc = _filter(sched, client, coord)
+    assert rc["Error"] == ""
+    # both slice workers still schedulable onto ANY adequate slice (partial
+    # HBM asks, so the coordinator's chip can still host a worker)
+    w0 = tpu_pod("w0", tpu=4, tpumem=4096,
+                 annotations={t.SLICE_WORKERS_ANNO: "2", **GANG})
+    w1 = tpu_pod("w1", tpu=4, tpumem=4096,
+                 annotations={t.SLICE_WORKERS_ANNO: "2", **GANG})
+    _, r1 = _filter(sched, client, w0)
+    _, r2 = _filter(sched, client, w1)
+    assert r1["NodeNames"] and r2["NodeNames"]
+    assert r1["NodeNames"] != r2["NodeNames"]
+
+
+def test_larger_slice_fallback_when_exact_is_full():
+    """If the right-sized slice has no capacity, the gang falls through to a
+    larger slice instead of staying Pending."""
+    client = fake_cluster({
+        f"n{i}": v5e_devices(4, prefix=f"n{i}") for i in range(6)
+    })
+    for i in range(4):
+        client.patch_node_annotations(f"n{i}", {t.NODE_SLICE_ANNO: _slice_anno("big", i, 4)})
+    for i in (4, 5):
+        client.patch_node_annotations(f"n{i}", {t.NODE_SLICE_ANNO: _slice_anno("small", i - 4, 2)})
+    sched = Scheduler(client)
+    register_tpu_backend(quota=sched.quota_manager)
+    sched.start(register_interval=3600)
+    try:
+        # fill both hosts of the small slice with exclusive whole-host pods
+        for i, host in enumerate(("n4", "n5")):
+            filler = tpu_pod(f"filler-{i}", tpu=4, tpucores=100)
+            filler = client.put_pod(filler)
+            r = sched.filter({"Pod": filler, "NodeNames": [host]})
+            assert r["NodeNames"] == [host], r
+        pod = client.put_pod(_worker("w0"))
+        r = sched.filter({"Pod": pod, "NodeNames": [f"n{i}" for i in range(6)]})
+        assert r["NodeNames"] and r["NodeNames"][0] in ("n0", "n1", "n2", "n3")
+    finally:
+        sched.stop()
+
+
+def test_split_gang_refuses_further_placement(cluster):
+    """Corrupted state (gang already on two slices) fails placement instead
+    of widening the split."""
+    client, sched = cluster
+    for name, node in (("w0", "a0"), ("w1", "b0")):
+        pod = client.put_pod(_worker(name))
+        sched.pod_manager.add_pod(pod, node, {})
+    _, r = _filter(sched, client, _worker("w2"))
+    assert r["NodeNames"] == []
+    assert any("already spans slices" in v for v in r["FailedNodes"].values())
+
+
+def test_single_host_pods_ignore_slices(cluster):
+    client, sched = cluster
+    _, r = _filter(sched, client, tpu_pod("plain", tpumem=4096))
+    assert r["Error"] == "" and len(r["NodeNames"]) == 1
+
+
+def test_scheduler_restart_rederives_gang_state(cluster):
+    """Annotations are the database: a fresh Scheduler must reconstruct the
+    gang's slice pin from scheduled pods (reference onAddPod:138-168)."""
+    client, sched = cluster
+    _, r1 = _filter(sched, client, _worker("w0"))
+    first = r1["NodeNames"][0]
+    sched.stop()
+    sched2 = Scheduler(client)
+    sched2.start(register_interval=3600)
+    try:
+        sched2.sync_existing_pods()
+        pod = client.put_pod(_worker("w1"))
+        r2 = sched2.filter({"Pod": pod, "NodeNames": list(ALL_NODES)})
+        second = r2["NodeNames"][0]
+        slice_of = {"a0": "s1", "a1": "s1", "b0": "s2", "b1": "s2"}
+        assert second != first and slice_of[second] == slice_of[first]
+    finally:
+        sched2.stop()
+
+
+def test_discover_slice_from_env(monkeypatch):
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "h0,h1,h2,h3")
+    monkeypatch.setenv("TPU_WORKER_ID", "2")
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5p-32")
+    monkeypatch.setenv("TPU_TOPOLOGY", "2x4x4")
+    sl = discover_slice()
+    assert sl == SliceInfo("h0", 2, 4, "v5p-32", "2x4x4")
+    # single hostname -> single-host slice -> no gang wiring needed
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "h0")
+    assert discover_slice() is None
+    # mock form
+    monkeypatch.setenv("VTPU_MOCK_SLICE", "ms:1:2:v5e-16:4x4")
+    assert discover_slice() == SliceInfo("ms", 1, 2, "v5e-16", "4x4")
+
+
+def test_allocate_injects_worker_envs(monkeypatch):
+    from vtpu.plugin.server import PluginConfig, TpuDevicePlugin
+    from vtpu.plugin.rm import TpuResourceManager, discover_chips
+
+    monkeypatch.setenv("VTPU_MOCK_DEVICES", "4")
+    monkeypatch.delenv("TPU_WORKER_HOSTNAMES", raising=False)
+    chips = discover_chips()
+    rm = TpuResourceManager(chips, split_count=4)
+    client = fake_cluster({})
+    sl = SliceInfo("s1", 1, 2, "v5p-16", "2x2x4")
+    plugin = TpuDevicePlugin(
+        rm, client, PluginConfig(node_name="a1", hook_path="/tmp/vtpu-test", slice_info=sl)
+    )
+    pod = _worker("w1", annos={
+        t.WORKER_HOSTNAMES_ANNO: "trainjob-0.svc,trainjob-1.svc",
+        t.MEGASCALE_COORDINATOR_ANNO: "coord:8080",
+        t.MEGASCALE_NUM_SLICES_ANNO: "2",
+    })
+    env = plugin._worker_envs(pod)
+    assert env["TPU_WORKER_ID"] == "1"
+    assert env["TPU_WORKER_HOSTNAMES"] == "trainjob-0.svc,trainjob-1.svc"
+    assert env["TPU_ACCELERATOR_TYPE"] == "v5p-16"
+    assert env["TPU_TOPOLOGY"] == "2x2x4"
+    assert env["MEGASCALE_COORDINATOR_ADDRESS"] == "coord:8080"
+    assert env["MEGASCALE_NUM_SLICES"] == "2"
+    # completion-index label pins the rank over the node's worker id
+    pod["metadata"]["labels"] = {"batch.kubernetes.io/job-completion-index": "0"}
+    assert plugin._worker_envs(pod)["TPU_WORKER_ID"] == "0"
+    # non-gang pod: no wiring
+    assert plugin._worker_envs(tpu_pod("plain", tpu=1)) == {}

@@ -22,6 +22,8 @@ class PodInfo:
     node_id: str = ""
     devices: PodDevices = field(default_factory=dict)
     ctr_ids: list[str] = field(default_factory=list)
+    group: str = ""  # gang-scheduling pod group (multi-host slice placement)
+    slice_workers: int = 0  # >1: this pod is a multi-host slice worker
 
     @property
     def key(self) -> str:
@@ -34,6 +36,8 @@ class PodManager:
         self._pods: dict[str, PodInfo] = {}
 
     def add_pod(self, pod: dict, node_id: str, devices: PodDevices) -> None:
+        from vtpu.util.helpers import pod_group_name, slice_workers
+
         meta = pod["metadata"]
         with self._lock:
             self._pods[meta["uid"]] = PodInfo(
@@ -46,6 +50,8 @@ class PodManager:
                     c.get("name", f"ctr{i}")
                     for i, c in enumerate(pod.get("spec", {}).get("containers") or [])
                 ],
+                group=pod_group_name(pod),
+                slice_workers=slice_workers(pod),
             )
 
     def del_pod(self, pod: dict) -> None:

@@ -166,12 +166,60 @@ class DeviceUsage:
 
 
 @dataclass
+class SliceInfo:
+    """Multi-host TPU slice membership of one node.
+
+    A v4/v5p/v5e pod slice spans several hosts wired by ICI; jobs that span
+    hosts must land on hosts of the SAME physical slice. This is the
+    TPU-native analog of the reference's cross-node channel layer
+    (nvinternal/imex: IMEX channels injected so containers on different nodes
+    can talk over NVLink): here the fabric identity travels in a node
+    annotation and the scheduler gangs workers onto one fabric.
+
+    Wire form (``vtpu.io/node-slice``):
+    ``{slice_id},{worker_id},{num_workers},{accel_type},{topology}``.
+    """
+
+    slice_id: str = ""
+    worker_id: int = 0  # this host's index within the slice
+    num_workers: int = 1  # hosts in the slice
+    accel_type: str = ""  # e.g. "v5p-16"
+    topology: str = ""  # chip topology, e.g. "2x2x4"
+
+    def encode(self) -> str:
+        return ",".join(
+            [
+                self.slice_id,
+                str(self.worker_id),
+                str(self.num_workers),
+                self.accel_type,
+                self.topology,
+            ]
+        )
+
+    @classmethod
+    def decode(cls, s: str) -> "SliceInfo":
+        parts = s.split(",")
+        if len(parts) != 5 or not parts[0]:
+            raise ValueError(f"bad slice annotation {s!r}")
+        return cls(
+            slice_id=parts[0],
+            worker_id=int(parts[1]),
+            num_workers=int(parts[2]),
+            accel_type=parts[3],
+            topology=parts[4],
+        )
+
+
+@dataclass
 class NodeInfo:
     """Per-node registered devices, one entry per vendor.
 
     Parity: reference pkg/util NodeInfo + scheduler/nodes.go nodeManager payload.
+    TPU twist: the node may belong to a multi-host slice (see SliceInfo).
     """
 
     node_name: str = ""
     # vendor common-word -> list[DeviceInfo]
     devices: dict[str, list[DeviceInfo]] = field(default_factory=dict)
+    slice: Optional[SliceInfo] = None

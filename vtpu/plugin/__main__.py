@@ -15,7 +15,7 @@ import time
 
 from vtpu.plugin.api import grpc_api
 from vtpu.plugin.register import Registrar
-from vtpu.plugin.rm import TpuResourceManager, discover_chips
+from vtpu.plugin.rm import TpuResourceManager, discover_chips, discover_slice
 from vtpu.plugin.server import PluginConfig, PluginServer, TpuDevicePlugin
 from vtpu.util.k8sclient import RealKubeClient, init_global_client
 
@@ -80,7 +80,13 @@ def main() -> None:
     )
     logging.info("discovered %d TPU chips", len(chips))
     rm = TpuResourceManager(chips, split_count=args.device_split_count)
-    registrar = Registrar(client, rm, args.node_name, mode=args.mode)
+    slice_info = discover_slice()
+    if slice_info:
+        logging.info(
+            "host is worker %d/%d of slice %s",
+            slice_info.worker_id, slice_info.num_workers, slice_info.slice_id,
+        )
+    registrar = Registrar(client, rm, args.node_name, mode=args.mode, slice_info=slice_info)
     registrar.start_background(args.register_interval)
 
     from vtpu.plugin.health import HealthWatcher
@@ -95,6 +101,7 @@ def main() -> None:
         cdi_enabled=args.cdi,
         cdi_dir=args.cdi_dir,
         qos_enabled=args.qos,
+        slice_info=slice_info,
     )
     if args.cdi:
         from vtpu.plugin import cdi

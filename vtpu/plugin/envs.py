@@ -32,6 +32,17 @@ ENV_HEALTH_FILE = "VTPU_HEALTH_FILE"
 HEALTH_ERR_FILE = "health.err"  # inside the container's rw cache mount
 CHIPS_FILE = "chips"  # host-side: uuids assigned to this container's region dir
 
+# --- Multi-host slice worker wiring (reference nvinternal/imex channel
+# injection; TPU-native: the JAX/libtpu runtime reads these to form the
+# cross-host ICI ring, and MEGASCALE_* wires multislice jobs over DCN).
+ENV_WORKER_ID = "TPU_WORKER_ID"
+ENV_WORKER_HOSTNAMES = "TPU_WORKER_HOSTNAMES"
+ENV_ACCELERATOR_TYPE = "TPU_ACCELERATOR_TYPE"
+ENV_TOPOLOGY = "TPU_TOPOLOGY"
+ENV_MEGASCALE_COORDINATOR = "MEGASCALE_COORDINATOR_ADDRESS"
+ENV_MEGASCALE_NUM_SLICES = "MEGASCALE_NUM_SLICES"
+ENV_MEGASCALE_SLICE_ID = "MEGASCALE_SLICE_ID"
+
 # Node-host filesystem layout (reference /usr/local/vgpu + HOOK_PATH).
 DEFAULT_HOOK_PATH = "/usr/local/vtpu"
 LIBVTPU_SO = "libvtpu.so"

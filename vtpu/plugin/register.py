@@ -26,22 +26,31 @@ TPU_NODE_LABEL = "vtpu.io/tpu-node"  # reference gpu= node label (e2e node suite
 
 
 class Registrar:
-    def __init__(self, client: KubeClient, rm: TpuResourceManager, node_name: str, mode: str = ""):
+    def __init__(
+        self,
+        client: KubeClient,
+        rm: TpuResourceManager,
+        node_name: str,
+        mode: str = "",
+        slice_info=None,
+    ):
         self.client = client
         self.rm = rm
         self.node_name = node_name
         self.mode = mode
+        # Multi-host slice membership (rm.discover_slice()); published so the
+        # scheduler can gang multi-host workers onto one fabric.
+        self.slice_info = slice_info
         self._stop = threading.Event()
 
     def register_once(self) -> None:
         infos = self.rm.device_infos(mode=self.mode)
-        self.client.patch_node_annotations(
-            self.node_name,
-            {
-                REGISTER_ANNO: codec.encode_node_devices(infos),
-                HANDSHAKE_ANNO: f"Reported_{timeutil.format_ts()}",
-            },
-        )
+        annos = {
+            REGISTER_ANNO: codec.encode_node_devices(infos),
+            HANDSHAKE_ANNO: f"Reported_{timeutil.format_ts()}",
+            t.NODE_SLICE_ANNO: self.slice_info.encode() if self.slice_info else None,
+        }
+        self.client.patch_node_annotations(self.node_name, annos)
         # Label TPU nodes so DaemonSets/operators can select them; withdrawn
         # when the inventory empties (reference e2e node-label add/remove,
         # test/e2e/node/test_node.go:57-91).

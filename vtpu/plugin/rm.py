@@ -19,7 +19,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 from vtpu.device.tpu.topology import default_ici_mesh
-from vtpu.device.types import DeviceInfo, IciCoord
+from vtpu.device.types import DeviceInfo, IciCoord, SliceInfo
 
 log = logging.getLogger(__name__)
 
@@ -61,6 +61,45 @@ def _accelerator_type() -> str:
     """TPU VM accelerator type, e.g. 'v5litepod-8' (env set by the TPU VM
     image; metadata-server fallback omitted: zero-egress environments)."""
     return os.environ.get("TPU_ACCELERATOR_TYPE", "")
+
+
+def discover_slice() -> Optional[SliceInfo]:
+    """This host's multi-host slice membership, or None for single-host.
+
+    TPU VM images export the slice wiring as env (TPU_WORKER_ID,
+    TPU_WORKER_HOSTNAMES, TPU_ACCELERATOR_TYPE, TPU_TOPOLOGY); the slice
+    identity is the stable first worker hostname unless VTPU_SLICE_ID
+    overrides it. Mock form for CPU CI: VTPU_MOCK_SLICE=<slice_id>:<worker_id>
+    :<num_workers>[:<accel_type>[:<topology>]].
+    """
+    mock = os.environ.get("VTPU_MOCK_SLICE", "")
+    if mock:
+        parts = mock.split(":")
+        try:
+            return SliceInfo(
+                slice_id=parts[0],
+                worker_id=int(parts[1]),
+                num_workers=int(parts[2]),
+                accel_type=parts[3] if len(parts) > 3 else "mock",
+                topology=parts[4] if len(parts) > 4 else "",
+            )
+        except (IndexError, ValueError):
+            log.warning("bad VTPU_MOCK_SLICE %r", mock)
+            return None
+    hostnames = [h for h in os.environ.get("TPU_WORKER_HOSTNAMES", "").split(",") if h]
+    if len(hostnames) < 2:
+        return None  # single-host slice: no cross-host gang needed
+    try:
+        worker_id = int(os.environ.get("TPU_WORKER_ID", "0"))
+    except ValueError:
+        worker_id = 0
+    return SliceInfo(
+        slice_id=os.environ.get("VTPU_SLICE_ID", hostnames[0]),
+        worker_id=worker_id,
+        num_workers=len(hostnames),
+        accel_type=_accelerator_type(),
+        topology=os.environ.get("TPU_TOPOLOGY", ""),
+    )
 
 
 def _chip_numa(dev_index: int, n_chips: int) -> int:

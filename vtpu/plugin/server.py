@@ -36,6 +36,7 @@ from vtpu.util.helpers import (
     pod_allocation_failed,
     pod_allocation_try_success,
     pod_annotations,
+    slice_workers,
 )
 from vtpu.util.k8sclient import ApiError, KubeClient
 
@@ -62,6 +63,10 @@ class PluginConfig:
     cdi_dir: str = ""
     # extra passthrough envs (reference vgpucfg.go node overrides)
     extra_envs: dict[str, str] = field(default_factory=dict)
+    # multi-host slice membership of this node (rm.discover_slice()); when a
+    # multi-host pod lands here, Allocate injects the worker wiring envs
+    # (reference nvinternal/imex channel injection).
+    slice_info: object = None
 
 
 class TpuDevicePlugin:
@@ -288,6 +293,7 @@ class TpuDevicePlugin:
         prio = pod_annotations(pod).get(t.TASK_PRIORITY_ANNO, "")
         if prio:
             env[envs.ENV_TASK_PRIORITY] = prio
+        env.update(self._worker_envs(pod))
 
         mounts = [
             pb.Mount(
@@ -314,6 +320,37 @@ class TpuDevicePlugin:
         return pb.ContainerAllocateResponse(
             envs=env, mounts=mounts, devices=device_specs, cdi_devices=cdi_devices
         )
+
+    def _worker_envs(self, pod: dict) -> dict[str, str]:
+        """Multi-host worker wiring for a slice-workers pod (the reference's
+        IMEX-channel analog, nvinternal/imex): TPU_WORKER_* so libtpu forms
+        the cross-host ICI ring, MEGASCALE_* for multislice DCN jobs."""
+        annos = pod_annotations(pod)
+        sl = self.config.slice_info
+        if not slice_workers(pod) or sl is None:
+            return {}
+        labels = pod.get("metadata", {}).get("labels") or {}
+        worker_id = str(sl.worker_id)
+        for key in t.COMPLETION_INDEX_LABELS:
+            if labels.get(key, "") != "":
+                worker_id = labels[key]
+                break
+        env = {envs.ENV_WORKER_ID: worker_id}
+        if sl.accel_type:
+            env[envs.ENV_ACCELERATOR_TYPE] = sl.accel_type
+        hostnames = annos.get(t.WORKER_HOSTNAMES_ANNO, "") or os.environ.get(
+            envs.ENV_WORKER_HOSTNAMES, ""
+        )
+        if hostnames:
+            env[envs.ENV_WORKER_HOSTNAMES] = hostnames
+        if sl.topology:
+            env[envs.ENV_TOPOLOGY] = sl.topology
+        coordinator = annos.get(t.MEGASCALE_COORDINATOR_ANNO, "")
+        if coordinator:
+            env[envs.ENV_MEGASCALE_COORDINATOR] = coordinator
+            env[envs.ENV_MEGASCALE_NUM_SLICES] = annos.get(t.MEGASCALE_NUM_SLICES_ANNO, "1")
+            env[envs.ENV_MEGASCALE_SLICE_ID] = annos.get(t.MEGASCALE_SLICE_ID_ANNO, "0")
+        return env
 
     # -------------------------------------------------------------- lifecycle
 

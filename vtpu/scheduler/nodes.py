@@ -3,8 +3,9 @@
 from __future__ import annotations
 
 import threading
+from dataclasses import replace
 
-from vtpu.device.types import DeviceInfo, NodeInfo
+from vtpu.device.types import DeviceInfo, NodeInfo, SliceInfo
 
 
 class NodeManager:
@@ -16,6 +17,14 @@ class NodeManager:
         with self._lock:
             info = self._nodes.setdefault(node_name, NodeInfo(node_name=node_name))
             info.devices[vendor] = [d.clone() for d in devices]
+
+    def set_node_slice(self, node_name: str, slice_info: SliceInfo | None) -> None:
+        """Record the node's multi-host slice membership (from the
+        vtpu.io/node-slice annotation); only meaningful for registered nodes."""
+        with self._lock:
+            info = self._nodes.get(node_name)
+            if info is not None:
+                info.slice = slice_info
 
     def rm_node_devices(self, node_name: str, vendor: str | None = None) -> None:
         """Withdraw one vendor (or the whole node) from the cache (reference
@@ -38,6 +47,7 @@ class NodeManager:
             return NodeInfo(
                 node_name=info.node_name,
                 devices={v: [d.clone() for d in ds] for v, ds in info.devices.items()},
+                slice=replace(info.slice) if info.slice else None,
             )
 
     def list_nodes(self) -> dict[str, NodeInfo]:
@@ -47,6 +57,7 @@ class NodeManager:
                 name: NodeInfo(
                     node_name=info.node_name,
                     devices={v: [d.clone() for d in ds] for v, ds in info.devices.items()},
+                    slice=replace(info.slice) if info.slice else None,
                 )
                 for name, info in self._nodes.items()
             }

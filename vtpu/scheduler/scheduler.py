@@ -27,14 +27,20 @@ from vtpu.device.pods import PodManager
 from vtpu.device.quota import QuotaManager
 from vtpu.device.registry import DEVICES_MAP, SUPPORT_DEVICES
 from vtpu.device import codec
-from vtpu.device.types import DeviceUsage, NodeInfo, PodDevices
+from vtpu.device.types import DeviceUsage, NodeInfo, PodDevices, SliceInfo
 from vtpu.scheduler import score as score_mod
 from vtpu.scheduler.events import EventRecorder
 from vtpu.scheduler.nodes import NodeManager
 from vtpu.scheduler.policy import pick_winner
 from vtpu.util import nodelock
 from vtpu.util import types as t
-from vtpu.util.helpers import is_pod_deleted, pod_annotations, pod_group_name, pod_key
+from vtpu.util.helpers import (
+    is_pod_deleted,
+    pod_annotations,
+    pod_group_name,
+    pod_key,
+    slice_workers,
+)
 from vtpu.util.k8sclient import ApiError, KubeClient, annotations
 
 log = logging.getLogger(__name__)
@@ -186,6 +192,13 @@ class Scheduler:
                         log.exception("bad register annotation on %s/%s", name, vendor)
                     except ApiError:
                         log.exception("api error registering %s/%s", name, vendor)
+                slice_anno = annos.get(t.NODE_SLICE_ANNO, "")
+                try:
+                    self.node_manager.set_node_slice(
+                        name, SliceInfo.decode(slice_anno) if slice_anno else None
+                    )
+                except ValueError:
+                    log.exception("bad slice annotation on %s", name)
 
     # ----------------------------------------------------------------- usage
 
@@ -264,6 +277,93 @@ class Scheduler:
         with self._filter_lock:
             return self._filter_locked(args, pod, requests)
 
+    def _constrain_to_gang_slice(
+        self,
+        pod: dict,
+        node_infos: dict[str, NodeInfo],
+        candidates: dict[str, dict[str, list[DeviceUsage]]],
+    ) -> tuple[list[dict[str, dict[str, list[DeviceUsage]]]], dict[str, str]]:
+        """Multi-host slice gang placement (TPU-native analog of reference
+        nvinternal/imex cross-node channels).
+
+        A pod annotated ``vtpu.io/slice-workers: N`` (N > 1) is one worker of
+        an N-host job; its gang (POD_GROUP_* marker, namespace-scoped) must
+        land on N DISTINCT hosts of ONE physical slice. The gang's slice is
+        derived from already-scheduled slice-worker members in PodManager —
+        annotations are the database, so a scheduler restart reconstructs
+        this state for free.
+
+        Returns candidate tiers in preference order (right-sized slices
+        first, larger slices as fallback) plus per-node exclusion reasons.
+        """
+        workers = slice_workers(pod)
+        if not workers:
+            return [candidates], {}
+        group = pod_group_name(pod)
+        if not group:
+            return [], {
+                n: f"{t.SLICE_WORKERS_ANNO} requires a pod-group marker" for n in candidates
+            }
+        ns = pod["metadata"].get("namespace", "default")
+        # only slice-worker members count: a same-gang coordinator pod neither
+        # pins the slice nor blacklists its host
+        members = [
+            p
+            for p in self.pod_manager.list_pods_info()
+            if p.group == group
+            and p.namespace == ns
+            and p.slice_workers > 1
+            and p.uid != pod["metadata"].get("uid")
+        ]
+        used_hosts = {p.node_id for p in members}
+        gang_slices = {
+            node_infos[n].slice.slice_id
+            for n in used_hosts
+            if n in node_infos and node_infos[n].slice
+        }
+        if len(gang_slices) > 1:
+            # corrupted placement: refusing to widen the split is the only
+            # safe move — surface it instead of picking a third slice
+            log.warning("gang %s/%s spans slices %s; refusing placement", ns, group, gang_slices)
+            return [], {
+                n: f"gang {group} already spans slices {sorted(gang_slices)}"
+                for n in candidates
+            }
+        pinned = next(iter(gang_slices)) if gang_slices else ""
+
+        kept: dict[str, dict[str, list[DeviceUsage]]] = {}
+        failed: dict[str, str] = {}
+        for name, usage in candidates.items():
+            sl = node_infos[name].slice if name in node_infos else None
+            if sl is None:
+                failed[name] = "node is not part of a multi-host slice"
+            elif sl.num_workers < workers:
+                failed[name] = (
+                    f"slice {sl.slice_id} has {sl.num_workers} hosts, gang needs {workers}"
+                )
+            elif name in used_hosts:
+                failed[name] = f"host already runs a worker of gang {group}"
+            elif pinned and sl.slice_id != pinned:
+                failed[name] = f"gang {group} is pinned to slice {pinned}"
+            else:
+                kept[name] = usage
+        # Fragmentation preference: while the gang is unpinned, try slices
+        # sized exactly N hosts before larger ones (same idea as the kunlun
+        # "bubble" scoring, reference kunlun/topo.go:32-120 — don't carve a
+        # small job out of a big fabric when a right-sized one would do).
+        # Larger slices stay as a fallback tier: a full right-sized slice
+        # must not leave the gang Pending while capacity exists elsewhere.
+        if not pinned:
+            exact = {
+                n: u
+                for n, u in kept.items()
+                if node_infos[n].slice and node_infos[n].slice.num_workers == workers
+            }
+            rest = {n: u for n, u in kept.items() if n not in exact}
+            if exact and rest:
+                return [exact, rest], failed
+        return [kept], failed
+
     def _filter_locked(self, args: dict, pod: dict, requests) -> dict:
 
         # Volcano-style simulation: full Node objects instead of names
@@ -280,13 +380,21 @@ class Scheduler:
         failed: dict[str, str] = {
             n: "no registered devices" for n in node_names if n not in candidates
         }
-        scores, failures = score_mod.calc_score(
-            candidates, node_infos, pod, requests, self.node_policy, self.device_policy
-        )
-        failed.update(failures)
-        winner = pick_winner(scores, pod_annotations(pod).get(
-            t.NODE_SCHEDULER_POLICY_ANNO, self.node_policy
-        ))
+        tiers, slice_failed = self._constrain_to_gang_slice(pod, node_infos, candidates)
+        failed.update(slice_failed)
+        # Tiers are tried in preference order (e.g. right-sized slices before
+        # larger ones); a tier whose nodes all fail falls through to the next.
+        winner = None
+        for tier in tiers:
+            scores, failures = score_mod.calc_score(
+                tier, node_infos, pod, requests, self.node_policy, self.device_policy
+            )
+            failed.update(failures)
+            winner = pick_winner(scores, pod_annotations(pod).get(
+                t.NODE_SCHEDULER_POLICY_ANNO, self.node_policy
+            ))
+            if winner is not None:
+                break
         if winner is None:
             self.events.filtering_failed(pod, failed)
             return {"NodeNames": [], "FailedNodes": failed, "Error": ""}

@@ -40,6 +40,17 @@ def pod_group_name(pod: dict) -> str:
     return ""
 
 
+def slice_workers(pod: dict) -> int:
+    """Worker count of a multi-host slice job (vtpu.io/slice-workers), or 0.
+    Shared by scheduler gang placement and plugin env injection so the two
+    sides can never disagree on which pods are multi-host."""
+    try:
+        n = int(pod_annotations(pod).get(t.SLICE_WORKERS_ANNO, "0"))
+    except ValueError:
+        return 0
+    return n if n > 1 else 0
+
+
 def all_containers(pod: dict) -> list[dict]:
     spec = pod.get("spec", {})
     return list(spec.get("containers") or [])

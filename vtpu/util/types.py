@@ -47,6 +47,25 @@ QOS_CORE_POLICY = {  # -> VTPU_CORE_UTILIZATION_POLICY for libvtpu
     QOS_BURST_SHARE: "default",
 }
 
+# --- Multi-host slices (TPU-native analog of reference nvinternal/imex) -----
+# Node side: which physical slice this host belongs to (published by the
+# device plugin; see SliceInfo in device/types.py for the wire form).
+NODE_SLICE_ANNO = "vtpu.io/node-slice"
+# Pod side: "this pod is one of N workers of a multi-host job". All members of
+# the pod's gang (POD_GROUP_*) are placed on distinct hosts of ONE slice.
+SLICE_WORKERS_ANNO = "vtpu.io/slice-workers"
+# Optional pod-side overrides consumed at Allocate time:
+WORKER_HOSTNAMES_ANNO = "vtpu.io/worker-hostnames"  # -> TPU_WORKER_HOSTNAMES
+MEGASCALE_COORDINATOR_ANNO = "vtpu.io/megascale-coordinator"  # -> MEGASCALE_COORDINATOR_ADDRESS
+MEGASCALE_NUM_SLICES_ANNO = "vtpu.io/megascale-num-slices"  # -> MEGASCALE_NUM_SLICES
+MEGASCALE_SLICE_ID_ANNO = "vtpu.io/megascale-slice-id"  # -> MEGASCALE_SLICE_ID
+# Job-style completion index labels that pin a worker's rank (else the node's
+# own slice worker_id is used).
+COMPLETION_INDEX_LABELS = (
+    "batch.kubernetes.io/job-completion-index",
+    "jobset.sigs.k8s.io/job-index",
+)
+
 # --- Node annotations -------------------------------------------------------
 NODE_LOCK_ANNO = "vtpu.io/mutex.lock"  # RFC3339,<ns>,<pod> (reference nodelock.go:39)
 
