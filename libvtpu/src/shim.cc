@@ -346,61 +346,190 @@ PJRT_Error* wrapped_client_create(PJRT_Client_Create_Args* args) {
   return err;
 }
 
-PJRT_Error* wrapped_buffer_from_host(PJRT_Client_BufferFromHostBuffer_Args* args) {
+// Reserve est bytes on dev_idx ahead of a real allocation (under the lock,
+// BEFORE the real call, so two racing threads can't both pass the check and
+// jointly blow the cap). Returns a tagged RESOURCE_EXHAUSTED error when the
+// cap would be exceeded and oversubscription is off; else sets *reserved.
+PJRT_Error* precheck_alloc(size_t dev_idx, uint64_t est, bool* reserved) {
   auto& s = S();
-  size_t dev_idx = args->device ? device_index_of(args->device) : 0;
-  uint64_t est = estimate_bytes(args->type, args->dims, args->num_dims);
-  bool reserved = false;
-  if (s.limits.mem_enforced()) {
-    // Reserve under the lock BEFORE the real allocation so two racing
-    // threads can't both pass the check and jointly blow the cap.
-    std::unique_lock<std::mutex> lock(s.mu);
-    auto& dev = s.dev(dev_idx);
-    if (dev.limit_bytes > 0 && dev.used_bytes + est > dev.limit_bytes) {
-      uint64_t used = dev.used_bytes, limit = dev.limit_bytes;
-      lock.unlock();
-      if (!s.limits.oversubscribe) {
-        char msg[256];
-        std::snprintf(msg, sizeof(msg),
-                      "vtpu: HBM limit exceeded on device %zu: "
-                      "used %lu + request %lu > limit %lu bytes "
-                      "(TPU_DEVICE_MEMORY_LIMIT_%zu)",
-                      dev_idx, (unsigned long)used, (unsigned long)est,
-                      (unsigned long)limit, dev_idx);
-        VTPU_WARN("%s", msg);
-        return make_error(PJRT_Error_Code_RESOURCE_EXHAUSTED, msg);
-      }
-      VTPU_WARN("oversubscribe: dev%zu exceeding cap (used=%lu est=%lu limit=%lu)",
-                dev_idx, (unsigned long)used, (unsigned long)est,
-                (unsigned long)limit);
-    } else {
-      dev.used_bytes += est;
-      reserved = true;
+  *reserved = false;
+  if (!s.limits.mem_enforced()) return nullptr;
+  std::unique_lock<std::mutex> lock(s.mu);
+  auto& dev = s.dev(dev_idx);
+  if (dev.limit_bytes > 0 && dev.used_bytes + est > dev.limit_bytes) {
+    uint64_t used = dev.used_bytes, limit = dev.limit_bytes;
+    lock.unlock();
+    if (!s.limits.oversubscribe) {
+      char msg[256];
+      std::snprintf(msg, sizeof(msg),
+                    "vtpu: HBM limit exceeded on device %zu: "
+                    "used %lu + request %lu > limit %lu bytes "
+                    "(TPU_DEVICE_MEMORY_LIMIT_%zu)",
+                    dev_idx, (unsigned long)used, (unsigned long)est,
+                    (unsigned long)limit, dev_idx);
+      VTPU_WARN("%s", msg);
+      return make_error(PJRT_Error_Code_RESOURCE_EXHAUSTED, msg);
     }
+    VTPU_WARN("oversubscribe: dev%zu exceeding cap (used=%lu est=%lu limit=%lu)",
+              dev_idx, (unsigned long)used, (unsigned long)est,
+              (unsigned long)limit);
+  } else {
+    dev.used_bytes += est;
+    *reserved = true;
   }
-  PJRT_Error* err = s.real->PJRT_Client_BufferFromHostBuffer(args);
-  if (err != nullptr || args->buffer == nullptr) {
-    if (reserved) {
-      std::lock_guard<std::mutex> lock(s.mu);
-      auto& dev = s.dev(dev_idx);
-      dev.used_bytes = dev.used_bytes >= est ? dev.used_bytes - est : 0;
-    }
-    return err;
-  }
-  uint64_t real_size = buffer_device_size(args->buffer);
+  return nullptr;
+}
+
+void unreserve(size_t dev_idx, uint64_t est) {
+  auto& s = S();
+  std::lock_guard<std::mutex> lock(s.mu);
+  auto& dev = s.dev(dev_idx);
+  dev.used_bytes = dev.used_bytes >= est ? dev.used_bytes - est : 0;
+}
+
+// Settle a successful allocation: replace the pre-charged estimate by the
+// buffer's real on-device size and record the buffer for Destroy accounting.
+void settle_alloc(PJRT_Buffer* buffer, size_t dev_idx, uint64_t est, bool reserved) {
+  auto& s = S();
+  uint64_t real_size = buffer_device_size(buffer);
   uint64_t bytes = real_size ? real_size : est;
   {
     std::lock_guard<std::mutex> lock(s.mu);
     auto& dev = s.dev(dev_idx);
     if (reserved) {
-      // settle the reservation against the real on-device size
       dev.used_bytes = dev.used_bytes >= est ? dev.used_bytes - est : 0;
     }
     dev.used_bytes += bytes;
-    s.buffers[args->buffer] = {dev_idx, bytes};
+    s.buffers[buffer] = {dev_idx, bytes};
   }
   if (s.region) s.region->add_used(dev_idx, (int64_t)bytes);
+}
+
+PJRT_Error* wrapped_buffer_from_host(PJRT_Client_BufferFromHostBuffer_Args* args) {
+  auto& s = S();
+  size_t dev_idx = args->device ? device_index_of(args->device) : 0;
+  uint64_t est = estimate_bytes(args->type, args->dims, args->num_dims);
+  bool reserved = false;
+  if (PJRT_Error* verr = precheck_alloc(dev_idx, est, &reserved)) return verr;
+  PJRT_Error* err = s.real->PJRT_Client_BufferFromHostBuffer(args);
+  if (err != nullptr || args->buffer == nullptr) {
+    if (reserved) unreserve(dev_idx, est);
+    return err;
+  }
+  settle_alloc(args->buffer, dev_idx, est, reserved);
   return nullptr;
+}
+
+// Host memory spaces (pinned_host / unpinned_host) live in RAM, not HBM:
+// copies there must never be charged against — or blocked by — a chip's cap.
+// (JAX host offloading is exactly how a tenant gets back UNDER its cap.)
+bool memory_is_host(PJRT_Memory* mem) {
+  auto& s = S();
+  if (mem == nullptr || s.wrapped.PJRT_Memory_Kind == nullptr) return false;
+  PJRT_Memory_Kind_Args args;
+  std::memset(&args, 0, sizeof(args));
+  args.struct_size = PJRT_Memory_Kind_Args_STRUCT_SIZE;
+  args.memory = mem;
+  if (PJRT_Error* err = s.real->PJRT_Memory_Kind(&args)) {
+    PJRT_Error_Destroy_Args d{PJRT_Error_Destroy_Args_STRUCT_SIZE, nullptr, err};
+    s.real->PJRT_Error_Destroy(&d);
+    return false;
+  }
+  std::string kind(args.kind ? args.kind : "", args.kind_size);
+  return kind.find("host") != std::string::npos;
+}
+
+// Post-hoc cap settlement for allocations whose destination device is only
+// known from the resulting buffer: over-cap -> destroy the fresh buffer and
+// return the tagged error, so the tenant never holds memory past its cap.
+PJRT_Error* settle_or_reject(PJRT_Buffer** buffer, uint64_t est) {
+  auto& s = S();
+  size_t dev_idx = 0;
+  if (s.wrapped.PJRT_Buffer_Device != nullptr) {
+    PJRT_Buffer_Device_Args dargs;
+    std::memset(&dargs, 0, sizeof(dargs));
+    dargs.struct_size = PJRT_Buffer_Device_Args_STRUCT_SIZE;
+    dargs.buffer = *buffer;
+    if (PJRT_Error* derr = s.real->PJRT_Buffer_Device(&dargs)) {
+      PJRT_Error_Destroy_Args d{PJRT_Error_Destroy_Args_STRUCT_SIZE, nullptr, derr};
+      s.real->PJRT_Error_Destroy(&d);
+    } else if (dargs.device != nullptr) {
+      dev_idx = device_index_of(dargs.device);
+    }
+  }
+  bool reserved = false;
+  if (PJRT_Error* verr = precheck_alloc(dev_idx, est, &reserved)) {
+    PJRT_Buffer_Destroy_Args del;
+    std::memset(&del, 0, sizeof(del));
+    del.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+    del.buffer = *buffer;
+    if (PJRT_Error* kerr = s.real->PJRT_Buffer_Destroy(&del)) {
+      PJRT_Error_Destroy_Args d{PJRT_Error_Destroy_Args_STRUCT_SIZE, nullptr, kerr};
+      s.real->PJRT_Error_Destroy(&d);
+    }
+    *buffer = nullptr;
+    return verr;
+  }
+  settle_alloc(*buffer, dev_idx, est, reserved);
+  return nullptr;
+}
+
+PJRT_Error* wrapped_create_uninitialized(
+    PJRT_Client_CreateUninitializedBuffer_Args* args) {
+  auto& s = S();
+  uint64_t est =
+      estimate_bytes(args->shape_element_type, args->shape_dims, args->shape_num_dims);
+  if (args->device == nullptr) {
+    // Memory-space-based caller: host spaces bypass HBM accounting entirely;
+    // device spaces settle post-hoc from the resulting buffer's device.
+    if (memory_is_host(args->memory)) {
+      return s.real->PJRT_Client_CreateUninitializedBuffer(args);
+    }
+    PJRT_Error* err = s.real->PJRT_Client_CreateUninitializedBuffer(args);
+    if (err != nullptr || args->buffer == nullptr) return err;
+    return settle_or_reject(&args->buffer, est);
+  }
+  size_t dev_idx = device_index_of(args->device);
+  bool reserved = false;
+  if (PJRT_Error* verr = precheck_alloc(dev_idx, est, &reserved)) return verr;
+  PJRT_Error* err = s.real->PJRT_Client_CreateUninitializedBuffer(args);
+  if (err != nullptr || args->buffer == nullptr) {
+    if (reserved) unreserve(dev_idx, est);
+    return err;
+  }
+  settle_alloc(args->buffer, dev_idx, est, reserved);
+  return nullptr;
+}
+
+PJRT_Error* wrapped_copy_to_device(PJRT_Buffer_CopyToDevice_Args* args) {
+  // Device-to-device copies allocate on the destination chip; without this
+  // hook a tenant could sidestep its cap by staging through another device
+  // (the reference's cuMemcpyPeer-class paths are hooked the same way).
+  auto& s = S();
+  size_t dev_idx = args->dst_device ? device_index_of(args->dst_device) : 0;
+  uint64_t est = buffer_device_size(args->buffer);  // dst ≈ src size
+  bool reserved = false;
+  if (PJRT_Error* verr = precheck_alloc(dev_idx, est, &reserved)) return verr;
+  PJRT_Error* err = s.real->PJRT_Buffer_CopyToDevice(args);
+  if (err != nullptr || args->dst_buffer == nullptr) {
+    if (reserved) unreserve(dev_idx, est);
+    return err;
+  }
+  settle_alloc(args->dst_buffer, dev_idx, est, reserved);
+  return nullptr;
+}
+
+PJRT_Error* wrapped_copy_to_memory(PJRT_Buffer_CopyToMemory_Args* args) {
+  auto& s = S();
+  // Host-space destination (JAX offloading): RAM, not HBM — never charged,
+  // never blocked.
+  if (memory_is_host(args->dst_memory)) {
+    return s.real->PJRT_Buffer_CopyToMemory(args);
+  }
+  uint64_t est = buffer_device_size(args->buffer);
+  PJRT_Error* err = s.real->PJRT_Buffer_CopyToMemory(args);
+  if (err != nullptr || args->dst_buffer == nullptr) return err;
+  return settle_or_reject(&args->dst_buffer, est);
 }
 
 PJRT_Error* wrapped_buffer_destroy(PJRT_Buffer_Destroy_Args* args) {
@@ -559,6 +688,18 @@ const PJRT_Api* wrap_api(const PJRT_Api* real) {
   replace_field(&s.wrapped.PJRT_Client_Create, real, wrapped_client_create);
   replace_field(&s.wrapped.PJRT_Client_BufferFromHostBuffer, real,
                 wrapped_buffer_from_host);
+  // Read presence from s.wrapped (memcpy'd to struct_size, zeroed beyond),
+  // never from real fields that may lie past an older plugin's struct.
+  if (s.wrapped.PJRT_Client_CreateUninitializedBuffer != nullptr) {
+    replace_field(&s.wrapped.PJRT_Client_CreateUninitializedBuffer, real,
+                  wrapped_create_uninitialized);
+  }
+  if (s.wrapped.PJRT_Buffer_CopyToDevice != nullptr) {
+    replace_field(&s.wrapped.PJRT_Buffer_CopyToDevice, real, wrapped_copy_to_device);
+  }
+  if (s.wrapped.PJRT_Buffer_CopyToMemory != nullptr) {
+    replace_field(&s.wrapped.PJRT_Buffer_CopyToMemory, real, wrapped_copy_to_memory);
+  }
   replace_field(&s.wrapped.PJRT_Buffer_Destroy, real, wrapped_buffer_destroy);
   replace_field(&s.wrapped.PJRT_LoadedExecutable_Execute, real, wrapped_execute);
   VTPU_INFO("wrapped PJRT api (struct_size=%zu, version %d.%d)",

@@ -27,6 +27,7 @@ struct FakeError {
 
 struct FakeBuffer {
   uint64_t size;
+  int device = 0;
 };
 
 struct FakeEvent {
@@ -129,6 +130,18 @@ PJRT_Error* BufferOnDeviceSize(PJRT_Buffer_OnDeviceSizeInBytes_Args* args) {
       reinterpret_cast<FakeBuffer*>(args->buffer)->size;
   return nullptr;
 }
+PJRT_Error* BufferDevice(PJRT_Buffer_Device_Args* args) {
+  int d = reinterpret_cast<FakeBuffer*>(args->buffer)->device;
+  args->device = g_device_ptrs[d & 1];
+  return nullptr;
+}
+PJRT_Error* BufferCopyToDevice(PJRT_Buffer_CopyToDevice_Args* args) {
+  auto* src = reinterpret_cast<FakeBuffer*>(args->buffer);
+  int dst_dev = args->dst_device == g_device_ptrs[1] ? 1 : 0;
+  args->dst_buffer =
+      reinterpret_cast<PJRT_Buffer*>(new FakeBuffer{src->size, dst_dev});
+  return nullptr;
+}
 
 // ------------------------------------------------------------- event fns
 
@@ -212,6 +225,8 @@ extern "C" const PJRT_Api* GetPjrtApi() {
     g_api.PJRT_Client_BufferFromHostBuffer = BufferFromHostBuffer;
     g_api.PJRT_Buffer_Destroy = BufferDestroy;
     g_api.PJRT_Buffer_OnDeviceSizeInBytes = BufferOnDeviceSize;
+    g_api.PJRT_Buffer_Device = BufferDevice;
+    g_api.PJRT_Buffer_CopyToDevice = BufferCopyToDevice;
     g_api.PJRT_Event_Destroy = EventDestroy;
     g_api.PJRT_Event_OnReady = EventOnReady;
     g_api.PJRT_LoadedExecutable_GetExecutable = LoadedGetExecutable;

@@ -140,6 +140,26 @@ int main(int argc, char** argv) {
     }
   }
 
+  // Copy-to-device probe: replicate a surviving buffer onto device 1 until
+  // ITS cap (TPU_DEVICE_MEMORY_LIMIT_1) bites.
+  int copies_ok = 0;
+  std::string copy_error;
+  if (!buffers.empty() && dargs.num_addressable_devices > 1 &&
+      api->PJRT_Buffer_CopyToDevice != nullptr) {
+    for (int i = 0; i < n_allocs; i++) {
+      PJRT_Buffer_CopyToDevice_Args cp;
+      memset(&cp, 0, sizeof(cp));
+      cp.struct_size = PJRT_Buffer_CopyToDevice_Args_STRUCT_SIZE;
+      cp.buffer = buffers.back();
+      cp.dst_device = dargs.addressable_devices[1];
+      if (PJRT_Error* err = api->PJRT_Buffer_CopyToDevice(&cp)) {
+        copy_error = error_text(api, err);
+        break;
+      }
+      copies_ok++;
+    }
+  }
+
   // Execute loop (core-throttle probe): measure wall time of n_execs.
   size_t n_out = 1;
   std::vector<PJRT_Buffer*> out_row(n_out, nullptr);
@@ -184,8 +204,9 @@ int main(int argc, char** argv) {
 
   printf(
       "RESULT {\"allocated\": %zu, \"freed\": %zu, \"realloc_ok\": %d, "
-      "\"alloc_error\": \"%s\", \"execs\": %d, \"exec_seconds\": %.3f}\n",
+      "\"alloc_error\": \"%s\", \"execs\": %d, \"exec_seconds\": %.3f, "
+      "\"copies\": %d, \"copy_error\": \"%s\"}\n",
       allocated, freed, realloc_ok, first_error.c_str(), execs_ok,
-      exec_elapsed);
+      exec_elapsed, copies_ok, copy_error.c_str());
   return 0;
 }

@@ -41,6 +41,14 @@ env VTPU_REAL_LIBTPU=$PWD/$B/fake_pjrt.so TPU_DEVICE_MEMORY_LIMIT_0=256m \
     $B/pjrt_smoke $B/libvtpu.so 64 10 0 > "$TMP/over.out"
 [ "$(result_field "$TMP/over.out" allocated)" = 10 ] || fail "oversubscribe alloc"
 
+echo "== 4b. copy-to-device: dst chip's own cap bites (128m / 64m chunks) =="
+env VTPU_REAL_LIBTPU=$PWD/$B/fake_pjrt.so TPU_DEVICE_MEMORY_LIMIT_0=256m \
+    TPU_DEVICE_MEMORY_LIMIT_1=128m \
+    $B/pjrt_smoke $B/libvtpu.so 64 4 0 > "$TMP/copy.out"
+[ "$(result_field "$TMP/copy.out" copies)" = 2 ] || fail "copy count ($(result_field "$TMP/copy.out" copies))"
+result_field "$TMP/copy.out" copy_error | grep -q "code=8" || fail "copy code"
+result_field "$TMP/copy.out" copy_error | grep -q "HBM limit exceeded on device 1" || fail "copy msg"
+
 echo "== 5. core throttle: 20% duty over 2ms execs stretches wall time =="
 env VTPU_REAL_LIBTPU=$PWD/$B/fake_pjrt.so TPU_CORE_LIMIT=20 \
     FAKE_PJRT_EXEC_NS=2000000 \
