@@ -390,25 +390,34 @@ void unreserve(size_t dev_idx, uint64_t est) {
 // Settle a successful allocation: replace the pre-charged estimate by the
 // buffer's real on-device size and record the buffer for Destroy accounting.
 void settle_alloc(PJRT_Buffer* buffer, size_t dev_idx, uint64_t est, bool reserved) {
-  auto& s = S();
+  if (reserved) unreserve(dev_idx, est);
   uint64_t real_size = buffer_device_size(buffer);
-  uint64_t bytes = real_size ? real_size : est;
-  {
-    std::lock_guard<std::mutex> lock(s.mu);
-    auto& dev = s.dev(dev_idx);
-    if (reserved) {
-      dev.used_bytes = dev.used_bytes >= est ? dev.used_bytes - est : 0;
-    }
-    dev.used_bytes += bytes;
-    s.buffers[buffer] = {dev_idx, bytes};
-  }
-  if (s.region) s.region->add_used(dev_idx, (int64_t)bytes);
+  account_alloc(buffer, dev_idx, real_size ? real_size : est);
 }
+
+// Host memory spaces (pinned_host / unpinned_host) live in RAM, not HBM:
+// allocations there must never be charged against — or blocked by — a chip's
+// cap. (JAX host offloading is exactly how a tenant gets back UNDER its cap.)
+bool memory_is_host(PJRT_Memory* mem);
+// Post-hoc cap settlement for allocations whose destination device is only
+// known from the resulting buffer.
+PJRT_Error* settle_or_reject(PJRT_Buffer** buffer, uint64_t est);
 
 PJRT_Error* wrapped_buffer_from_host(PJRT_Client_BufferFromHostBuffer_Args* args) {
   auto& s = S();
-  size_t dev_idx = args->device ? device_index_of(args->device) : 0;
   uint64_t est = estimate_bytes(args->type, args->dims, args->num_dims);
+  if (args->memory != nullptr) {
+    // PJRT gives `memory` precedence over `device` when both are set: host
+    // spaces bypass HBM accounting; device spaces settle post-hoc from the
+    // resulting buffer's device.
+    if (memory_is_host(args->memory)) {
+      return s.real->PJRT_Client_BufferFromHostBuffer(args);
+    }
+    PJRT_Error* err = s.real->PJRT_Client_BufferFromHostBuffer(args);
+    if (err != nullptr || args->buffer == nullptr) return err;
+    return settle_or_reject(&args->buffer, est);
+  }
+  size_t dev_idx = args->device ? device_index_of(args->device) : 0;
   bool reserved = false;
   if (PJRT_Error* verr = precheck_alloc(dev_idx, est, &reserved)) return verr;
   PJRT_Error* err = s.real->PJRT_Client_BufferFromHostBuffer(args);
@@ -420,9 +429,6 @@ PJRT_Error* wrapped_buffer_from_host(PJRT_Client_BufferFromHostBuffer_Args* args
   return nullptr;
 }
 
-// Host memory spaces (pinned_host / unpinned_host) live in RAM, not HBM:
-// copies there must never be charged against — or blocked by — a chip's cap.
-// (JAX host offloading is exactly how a tenant gets back UNDER its cap.)
 bool memory_is_host(PJRT_Memory* mem) {
   auto& s = S();
   if (mem == nullptr || s.wrapped.PJRT_Memory_Kind == nullptr) return false;
@@ -439,9 +445,8 @@ bool memory_is_host(PJRT_Memory* mem) {
   return kind.find("host") != std::string::npos;
 }
 
-// Post-hoc cap settlement for allocations whose destination device is only
-// known from the resulting buffer: over-cap -> destroy the fresh buffer and
-// return the tagged error, so the tenant never holds memory past its cap.
+// Over-cap -> destroy the fresh buffer and return the tagged error, so the
+// tenant never holds memory past its cap.
 PJRT_Error* settle_or_reject(PJRT_Buffer** buffer, uint64_t est) {
   auto& s = S();
   size_t dev_idx = 0;
@@ -479,9 +484,10 @@ PJRT_Error* wrapped_create_uninitialized(
   auto& s = S();
   uint64_t est =
       estimate_bytes(args->shape_element_type, args->shape_dims, args->shape_num_dims);
-  if (args->device == nullptr) {
-    // Memory-space-based caller: host spaces bypass HBM accounting entirely;
-    // device spaces settle post-hoc from the resulting buffer's device.
+  if (args->memory != nullptr) {
+    // PJRT gives `memory` precedence over `device` when both are set: host
+    // spaces bypass HBM accounting entirely; device spaces settle post-hoc
+    // from the resulting buffer's device.
     if (memory_is_host(args->memory)) {
       return s.real->PJRT_Client_CreateUninitializedBuffer(args);
     }
@@ -489,7 +495,7 @@ PJRT_Error* wrapped_create_uninitialized(
     if (err != nullptr || args->buffer == nullptr) return err;
     return settle_or_reject(&args->buffer, est);
   }
-  size_t dev_idx = device_index_of(args->device);
+  size_t dev_idx = args->device ? device_index_of(args->device) : 0;
   bool reserved = false;
   if (PJRT_Error* verr = precheck_alloc(dev_idx, est, &reserved)) return verr;
   PJRT_Error* err = s.real->PJRT_Client_CreateUninitializedBuffer(args);

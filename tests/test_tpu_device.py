@@ -80,6 +80,49 @@ def test_fit_exclusive_conflicts():
     assert not ok and common.CARD_INSUFFICIENT_CORE in reason
 
 
+def test_vtpu_mode_exclusive_annotation():
+    """vtpu.io/vtpu-mode: exclusive takes the whole chip even without a
+    tpucores=100 ask (reference hami.io/vgpu-mode)."""
+    b = register_tpu_backend()
+    devices = _usages(1)
+    pod = tpu_pod("p", tpu=1, annotations={t.VTPU_MODE_ANNO: "exclusive"})
+    ok, result, reason = _fit(b, devices, pod)
+    assert ok, reason
+    cd = result["TPU"][0]
+    assert cd.usedcores == 100 and cd.usedmem == devices[0].totalmem
+    devices[0].add(cd, "default/p")
+    # a second tenant (shared or exclusive) can't join
+    ok, _, reason = _fit(b, devices, tpu_pod("q", tpumem=1024))
+    assert not ok
+    ok, _, reason = _fit(b, devices, pod)
+    assert not ok and common.EXCLUSIVE_DEVICE_ALLOCATE_CONFLICT in reason
+
+
+def test_vtpu_mode_mps_served_as_shared():
+    """mps is accepted (reference ships MPS as disabled stubs) and behaves as
+    time-slice sharing."""
+    b = register_tpu_backend()
+    devices = _usages(1)
+    pod = tpu_pod("p", tpumem=2048, annotations={t.VTPU_MODE_ANNO: "mps"})
+    ok, result, _ = _fit(b, devices, pod)
+    assert ok and result["TPU"][0].usedcores != 100
+    devices[0].add(result["TPU"][0], "default/p")
+    ok, _, _ = _fit(b, devices, tpu_pod("q", tpumem=2048))
+    assert ok  # chip still shared
+
+
+def test_exclusive_mode_chip_rejects_shared_ask():
+    """A chip repartitioned to exclusive mode only hosts exclusive asks."""
+    b = register_tpu_backend()
+    devices = _usages(1)
+    devices[0].mode = "exclusive"
+    ok, _, reason = _fit(b, devices, tpu_pod("p", tpumem=1024))
+    assert not ok and common.CARD_MODE_MISMATCH in reason
+    pod = tpu_pod("p", tpu=1, annotations={t.VTPU_MODE_ANNO: "exclusive"})
+    ok, _, reason = _fit(b, devices, pod)
+    assert ok, reason
+
+
 def test_fit_unhealthy_and_type_uuid_selectors():
     b = register_tpu_backend()
     devices = _usages(2)

@@ -18,6 +18,7 @@ CARD_INSUFFICIENT_CORE = "CardInsufficientCore"
 CARD_COMPUTE_UNITS_EXHAUSTED = "CardComputeUnitsExhausted"
 EXCLUSIVE_DEVICE_ALLOCATE_CONFLICT = "ExclusiveDeviceAllocateConflict"
 CARD_NOT_FOUND_ON_NODE = "CardNotFoundOnNode"
+CARD_MODE_MISMATCH = "CardModeMismatch"  # chip operating mode != pod's vtpu-mode ask
 CARD_UNHEALTHY = "CardUnhealthy"
 NUMA_NOT_FIT = "NumaNotFit"
 TOPOLOGY_NOT_FIT = "TopologyNotFit"  # no contiguous ICI sub-slice available

@@ -122,6 +122,18 @@ class TpuDevices(Devices):
             envs = container.setdefault("env", [])
             if not any(e.get("name") == ENV_TASK_PRIORITY for e in envs):
                 envs.append({"name": ENV_TASK_PRIORITY, "value": priority})
+        mode = pod_annotations(pod).get(t.VTPU_MODE_ANNO, "").lower()
+        if mode == t.VTPU_MODE_MPS:
+            # Accepted for spec compatibility; TPUs have no spatial-MPS
+            # daemon (the reference ships MPS disabled too, plugin/mps.go:
+            # 55-80) — the ask is served by the time-slice + core-quota path.
+            log.info(
+                "pod %s requests vtpu-mode=mps; serving via time-slice sharing",
+                pod.get("metadata", {}).get("name", ""),
+            )
+        elif mode and mode not in (t.VTPU_MODE_SHARED, t.VTPU_MODE_EXCLUSIVE):
+            log.warning("pod %s: unknown vtpu-mode %r ignored",
+                        pod.get("metadata", {}).get("name", ""), mode)
         return True
 
     # ------------------------------------------------------------- requests
@@ -191,10 +203,25 @@ class TpuDevices(Devices):
         reasons: Counter = Counter()
         candidates: list[DeviceUsage] = []
 
+        # Operating-mode ask (reference hami.io/vgpu-mode): "exclusive" takes
+        # whole chips; "mps" is accepted as an alias of shared (the reference
+        # ships MPS as disabled stubs, plugin/mps.go:55-80 — TPU has no
+        # spatial-sharing daemon either, so the time-slice path serves it).
+        pod_mode = annos.get(t.VTPU_MODE_ANNO, "").lower()
+        exclusive_ask = request.coresreq == 100 or pod_mode == t.VTPU_MODE_EXCLUSIVE
+        coresreq = 100 if exclusive_ask else request.coresreq
+
         for dev in devices:
-            memreq = request.memreq
-            if memreq == 0 and request.mem_percentage_req:
+            if exclusive_ask:
+                # Exclusive means the whole chip: an explicit (smaller) memreq
+                # must not leave HBM headroom a later tenant could co-locate in.
+                memreq = dev.totalmem
+            elif request.memreq:
+                memreq = request.memreq
+            elif request.mem_percentage_req:
                 memreq = dev.totalmem * request.mem_percentage_req // 100
+            else:
+                memreq = 0
             if not dev.health:
                 reasons[common.CARD_UNHEALTHY] += 1
             elif not self._check_type(annos, dev):
@@ -203,16 +230,18 @@ class TpuDevices(Devices):
                 reasons[common.CARD_UUID_MISMATCH] += 1
             elif dev.used >= dev.count:
                 reasons[common.CARD_TIME_SLICING_EXHAUSTED] += 1
-            elif dev.free_mem() < memreq:
-                reasons[common.CARD_INSUFFICIENT_MEMORY] += 1
-            elif request.coresreq == 100 and dev.used > 0:
+            elif exclusive_ask and dev.used > 0:
                 # Exclusive ask can't land on a shared chip (reference
                 # exclusive-card logic device.go:809-818).
                 reasons[common.EXCLUSIVE_DEVICE_ALLOCATE_CONFLICT] += 1
-            elif request.coresreq and dev.free_cores() < request.coresreq:
+            elif dev.free_mem() < memreq:
+                reasons[common.CARD_INSUFFICIENT_MEMORY] += 1
+            elif coresreq and dev.free_cores() < coresreq:
                 reasons[common.CARD_INSUFFICIENT_CORE] += 1
-            elif dev.mode == "exclusive" and dev.used > 0:
-                reasons[common.EXCLUSIVE_DEVICE_ALLOCATE_CONFLICT] += 1
+            elif dev.mode == "exclusive" and not exclusive_ask:
+                # A chip repartitioned to exclusive mode only hosts exclusive
+                # asks (reference vgpu-mode/MIG-geometry matching).
+                reasons[common.CARD_MODE_MISMATCH] += 1
             else:
                 candidates.append(dev)
 
@@ -258,17 +287,23 @@ class TpuDevices(Devices):
         # Namespace device quota over the devices actually chosen — percentage
         # asks resolve to different MiB on heterogeneous chips (reference
         # fitQuota device.go:725-744).
+        def resolved_mem(dev: DeviceUsage) -> int:
+            if exclusive_ask:
+                return dev.totalmem
+            if request.memreq:
+                return request.memreq
+            if request.mem_percentage_req:
+                return dev.totalmem * request.mem_percentage_req // 100
+            return 0
+
         if self.quota is not None:
             ns = pod.get("metadata", {}).get("namespace", "default")
-            memsum = sum(
-                request.memreq or d.totalmem * request.mem_percentage_req // 100
-                for d in chosen
-            )
+            memsum = sum(resolved_mem(d) for d in chosen)
             if not self.quota.fit_quota(
                 ns,
                 TPU_COMMON_WORD,
                 memsum,
-                request.coresreq * request.nums,
+                coresreq * request.nums,
                 count=request.nums,
             ):
                 reasons[common.ALLOCATED_POD_OVERQUOTA] += 1
@@ -276,16 +311,13 @@ class TpuDevices(Devices):
 
         out: ContainerDevices = []
         for dev in chosen:
-            memreq = request.memreq
-            if memreq == 0 and request.mem_percentage_req:
-                memreq = dev.totalmem * request.mem_percentage_req // 100
             out.append(
                 ContainerDevice(
                     idx=dev.index,
                     uuid=dev.id,
                     type=dev.type,
-                    usedmem=memreq,
-                    usedcores=request.coresreq,
+                    usedmem=resolved_mem(dev),
+                    usedcores=coresreq,
                 )
             )
         return True, {TPU_COMMON_WORD: out}, ""

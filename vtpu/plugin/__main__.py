@@ -109,6 +109,24 @@ def main() -> None:
         cdi.write_spec(cdi.generate_spec(chips, args.hook_path), args.cdi_dir)
     socket_path = os.path.join(args.socket_dir, "vtpu.sock")
 
+    # Crash counting (reference Serve restart loop, plugin/server.go:367-445):
+    # rapid re-serve cycles mean something systemic (bad socket dir, kubelet
+    # rejecting the plugin); give up and let the DaemonSet backoff take over.
+    CRASH_WINDOW_S, CRASH_THRESHOLD = 600.0, 5
+    crash_times: list[float] = []
+
+    def count_crash() -> None:
+        now = time.monotonic()
+        crash_times.append(now)
+        while crash_times and now - crash_times[0] > CRASH_WINDOW_S:
+            crash_times.pop(0)
+        if len(crash_times) > CRASH_THRESHOLD:
+            logging.error(
+                "%d serve failures within %.0fs; exiting for DaemonSet backoff",
+                len(crash_times), CRASH_WINDOW_S,
+            )
+            raise SystemExit(1)
+
     while True:
         plugin = TpuDevicePlugin(rm, client, config)
         server = PluginServer(plugin, socket_path)
@@ -118,6 +136,7 @@ def main() -> None:
         except Exception:
             logging.exception("kubelet registration failed; retrying in 5s")
             server.stop()
+            count_crash()
             time.sleep(5)
             continue
         # watch for kubelet restarts: socket inode change -> re-register

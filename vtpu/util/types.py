@@ -33,6 +33,14 @@ NO_USE_DEVICE_UUID_ANNO = "vtpu.io/nouse-tpuuuid"  # comma-separated denylist
 USE_DEVICE_TYPE_ANNO = "vtpu.io/use-tputype"
 NO_USE_DEVICE_TYPE_ANNO = "vtpu.io/nouse-tputype"
 NUMA_BIND_ANNO = "vtpu.io/numa-bind"  # "true" -> keep all devices on one NUMA node
+# Operating-mode request (reference hami.io/vgpu-mode: hami-core|mig|mps):
+# "shared" (default), "exclusive" (whole chip), or "mps" — accepted as an
+# alias of shared-with-core-quota; TPUs have no spatial-MPS analog and the
+# reference itself ships MPS as disabled stubs (plugin/mps.go:55-80).
+VTPU_MODE_ANNO = "vtpu.io/vtpu-mode"
+VTPU_MODE_SHARED = "shared"
+VTPU_MODE_EXCLUSIVE = "exclusive"
+VTPU_MODE_MPS = "mps"
 TASK_PRIORITY_ANNO = "vtpu.io/task-priority"  # 0 (low, default) | 1 (high)
 
 # Per-pod QoS (reference metax sdevice qos.go): how strictly libvtpu throttles
