@@ -17,7 +17,6 @@ import json
 import logging
 import os
 import sys
-import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -29,62 +28,63 @@ log = logging.getLogger("ttft-server")
 
 
 class Engine:
-    """Compiled prefill + decode over the benchmark model."""
+    """The vtpu.serving continuous-batching engine behind a streaming API.
+
+    Concurrent /generate requests occupy independent cache slots and decode
+    jointly — the real multi-request serving path, not a lock-serialized
+    batch-1 loop."""
 
     def __init__(self, preset: str = "auto"):
         import jax
         import jax.numpy as jnp
 
-        from vtpu.models import ModelConfig, decode_step, init_params, prefill
+        from vtpu.models import ModelConfig, init_params
+        from vtpu.serving import ServingConfig, ServingEngine
 
         if preset == "tpu" or (preset == "auto" and jax.default_backend() == "tpu"):
             cfg = ModelConfig(
                 vocab=8192, d_model=1024, n_heads=8, n_layers=12, d_ff=4096,
                 max_seq=1280, head_dim=128, dtype=jnp.bfloat16, use_pallas=True,
             )
+            serving = ServingConfig(slots=4, prefill_buckets=(128, 256, 512, 1024),
+                                    max_new_tokens=64)
         else:
             cfg = ModelConfig(
                 vocab=512, d_model=128, n_heads=4, n_layers=2, d_ff=256,
                 max_seq=160, head_dim=32, dtype=jnp.float32, use_pallas=False,
             )
+            serving = ServingConfig(slots=2, prefill_buckets=(32, 64, 128),
+                                    max_new_tokens=32)
         self.cfg = cfg
         self.jax = jax
         self.jnp = jnp
         self.params = jax.jit(lambda k: init_params(k, cfg))(jax.random.key(0))
         jax.block_until_ready(self.params)
-
-        @jax.jit
-        def _prefill(params, tokens):
-            logits, cache = prefill(params, cfg, tokens)
-            return jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32), cache
-
-        @jax.jit
-        def _decode(params, cache, token):
-            logits, cache = decode_step(params, cfg, cache, token)
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
-
-        self._prefill = _prefill
-        self._decode = _decode
-        self._lock = threading.Lock()  # one model, serialized like a batch=1 engine
-        # warm the compile caches so the first request isn't a compile
-        # (generate is a generator — it must be consumed to run)
-        for _ in self.generate(min(16, cfg.max_seq // 2), 2):
-            pass
+        self.engine = ServingEngine(self.params, cfg, serving)
+        self.engine.start()
+        # warm EVERY prefill bucket (plus the shared decode step) so no real
+        # request ever pays an XLA compile — this is a TTFT benchmark.
+        for bucket in serving.prefill_buckets:
+            for _ in self.generate(bucket, 2):
+                pass
 
     def generate(self, prompt_len: int, max_tokens: int):
         """Yield (token_id, monotonic_ts) per generated token."""
-        prompt_len = max(1, min(prompt_len, self.cfg.max_seq - max_tokens - 1))
+        limit = self.engine.serving.prefill_buckets[-1]
+        prompt_len = max(1, min(prompt_len, limit))
+        # keep prompt + generation inside the KV cache; a request asking for
+        # more tokens than fit is clamped, never allowed to wrap the cache
+        max_tokens = max(1, min(max_tokens, self.cfg.max_seq - prompt_len - 1))
         tokens = self.jax.random.randint(
             self.jax.random.key(int(time.time() * 1e3) % (2**31)),
-            (1, prompt_len), 0, self.cfg.vocab, self.jnp.int32,
+            (prompt_len,), 0, self.cfg.vocab, self.jnp.int32,
         )
-        with self._lock:
-            first, cache = self._prefill(self.params, tokens)
-            yield int(first[0]), time.monotonic()
-            token = first
-            for _ in range(max_tokens - 1):
-                token, cache = self._decode(self.params, cache, token)
-                yield int(token[0]), time.monotonic()
+        req = self.engine.submit(tokens, max_new_tokens=max_tokens)
+        try:
+            for token in req.stream():
+                yield token, time.monotonic()
+        finally:
+            req.cancel()  # client gone mid-stream: free the slot next tick
 
 
 def make_handler(engine: Engine):

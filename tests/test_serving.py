@@ -1,0 +1,127 @@
+"""Continuous-batching serving engine: staggered slots must reproduce the
+single-sequence reference exactly (greedy decoding, f32 CPU determinism)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from vtpu.models import ModelConfig, init_params
+from vtpu.models.transformer import greedy_generate
+from vtpu.serving import Request, ServingConfig, ServingEngine
+
+CFG = ModelConfig(
+    vocab=128, d_model=64, n_heads=2, n_layers=2, d_ff=128,
+    max_seq=64, head_dim=32, dtype=jnp.float32, use_pallas=False,
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(jax.random.key(0), CFG)
+
+
+def _reference(params, prompt, steps):
+    out = greedy_generate(params, CFG, jnp.asarray(prompt, jnp.int32)[None], steps)
+    return [int(t) for t in out[0]]
+
+
+def _prompt(seed, n):
+    return list(jax.random.randint(jax.random.key(seed), (n,), 0, CFG.vocab, jnp.int32))
+
+
+def test_single_request_matches_reference(params):
+    eng = ServingEngine(params, CFG, ServingConfig(
+        slots=2, prefill_buckets=(16, 32), max_new_tokens=8))
+    eng.start()
+    try:
+        prompt = _prompt(1, 10)
+        got = list(eng.submit(prompt, max_new_tokens=8).stream())
+        assert got == _reference(params, prompt, 8)
+    finally:
+        eng.stop()
+
+
+def _solo(params, cfg_serving, prompt, steps):
+    """The same prompt through a fresh engine with identical slot geometry —
+    the isolation oracle (same compiled shapes, no neighbors)."""
+    eng = ServingEngine(params, CFG, cfg_serving)
+    eng.start()
+    try:
+        return list(eng.submit(prompt, max_new_tokens=steps).stream())
+    finally:
+        eng.stop()
+
+
+def test_staggered_requests_are_isolated(params):
+    """Requests of different lengths admitted at different times must each
+    match their SOLO run through the same engine geometry — slot neighbors
+    must not perturb a sequence. (Comparing against the unbatched reference
+    would test numerics, not isolation: a near-tied argmax can flip with
+    batch shape.)"""
+    serving = ServingConfig(slots=3, prefill_buckets=(8, 16, 32), max_new_tokens=12)
+    prompts = [_prompt(2, 5), _prompt(3, 13), _prompt(4, 27)]
+    want = [_solo(params, serving, p, 12) for p in prompts]
+    eng = ServingEngine(params, CFG, serving)
+    eng.start()
+    try:
+        reqs = [eng.submit(p, max_new_tokens=12) for p in prompts]
+        results = [list(r.stream()) for r in reqs]
+        for p, got, solo in zip(prompts, results, want):
+            assert got == solo, f"prompt len {len(p)}"
+    finally:
+        eng.stop()
+
+
+def test_slot_reuse_more_requests_than_slots(params):
+    serving = ServingConfig(slots=2, prefill_buckets=(16,), max_new_tokens=4)
+    prompts = [_prompt(i + 10, 6 + i) for i in range(5)]
+    want = [_solo(params, serving, p, 4) for p in prompts]
+    eng = ServingEngine(params, CFG, serving)
+    eng.start()
+    try:
+        reqs = [eng.submit(p, max_new_tokens=4) for p in prompts]
+        for r, solo in zip(reqs, want):
+            assert list(r.stream()) == solo
+    finally:
+        eng.stop()
+
+
+def test_oversized_prompt_rejected(params):
+    eng = ServingEngine(params, CFG, ServingConfig(slots=1, prefill_buckets=(8,)))
+    with pytest.raises(ValueError, match="exceeds the largest bucket"):
+        eng._bucket(9)
+
+
+def test_cancellation_frees_slot(params):
+    """A cancelled request stops decoding and its slot admits the next
+    waiter (client-disconnect path)."""
+    serving = ServingConfig(slots=1, prefill_buckets=(16,), max_new_tokens=1000)
+    eng = ServingEngine(params, CFG, serving)
+    eng.start()
+    try:
+        hog = eng.submit(_prompt(1, 8), max_new_tokens=1000)
+        next(iter(hog.stream()))  # it is being served
+        hog.cancel()
+        follow = eng.submit(_prompt(2, 8), max_new_tokens=3)
+        assert len(list(follow.stream())) == 3  # would starve if slot leaked
+    finally:
+        eng.stop()
+
+
+def test_budget_clamped_to_cache(params):
+    """max_new_tokens beyond the KV cache is clamped, never wrapped."""
+    eng = ServingEngine(params, CFG, ServingConfig(
+        slots=1, prefill_buckets=(16,), max_new_tokens=8))
+    eng.start()
+    try:
+        got = list(eng.submit(_prompt(5, 10), max_new_tokens=10_000).stream())
+        assert len(got) == CFG.max_seq - 10  # 64 - prompt
+    finally:
+        eng.stop()
+
+
+def test_request_stream_api():
+    q = Request(tokens=jnp.zeros((1,), jnp.int32))
+    q.out.put(5)
+    q.out.put(None)
+    assert list(q.stream()) == [5]

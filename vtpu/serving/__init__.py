@@ -1,0 +1,11 @@
+"""TPU-native serving engine: continuous batching over a slot-based KV cache."""
+
+from vtpu.serving.engine import Request, ServingConfig, ServingEngine, batched_decode_step, prefill_into_slot
+
+__all__ = [
+    "Request",
+    "ServingConfig",
+    "ServingEngine",
+    "batched_decode_step",
+    "prefill_into_slot",
+]

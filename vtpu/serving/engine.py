@@ -1,0 +1,281 @@
+"""Continuous-batching serving engine over the flagship transformer.
+
+The TPU-shaped serving loop (JetStream-style): a fixed pool of B cache slots,
+one compiled prefill per bucketed prompt length, and ONE compiled decode step
+for the whole pool — requests join and leave slots without recompiling
+anything. All shapes are static; per-slot state is data (lengths, active
+mask), never shape:
+
+- prefill runs on a [1, bucket] prompt and scatters its KV into the slot;
+- decode advances every ACTIVE slot one token per tick; inactive slots
+  compute too (lockstep hardware loves uniformity) but their state is masked
+  out, so a slot's garbage never leaks into a live sequence;
+- admission is continuous: a request entering slot 3 never disturbs the
+  sequences mid-decode in slots 0-2.
+
+This is the data plane the vTPU middleware schedules: the TTFT benchmark's
+tenants each run one of these engines against their fractional chip share.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from vtpu.models.transformer import (
+    ModelConfig,
+    Params,
+    _mlp_block,
+    _qkv,
+    init_kv_cache,
+    prefill,
+)
+from vtpu.ops import causal_attention, rms_norm, rope_angles
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingConfig:
+    slots: int = 4  # concurrent sequences (the compiled decode batch)
+    prefill_buckets: tuple[int, ...] = (128, 256, 512, 1024)
+    max_new_tokens: int = 64
+    eos_token: int = -1  # -1: never stops early
+
+
+@dataclasses.dataclass
+class Request:
+    tokens: Any  # [S] int32 prompt
+    max_new_tokens: int = 0  # 0: serving config default
+    out: "queue.Queue[Optional[int]]" = dataclasses.field(default_factory=queue.Queue)
+    cancelled: bool = False
+
+    def cancel(self) -> None:
+        """Abandon the request: the engine retires its slot on the next tick
+        instead of decoding tokens nobody will read."""
+        self.cancelled = True
+
+    def stream(self):
+        """Yield generated token ids until the engine signals completion."""
+        while True:
+            tok = self.out.get()
+            if tok is None:
+                return
+            yield tok
+
+
+def batched_decode_step(
+    params: Params,
+    cfg: ModelConfig,
+    cache: dict[str, jax.Array],
+    tokens: jax.Array,
+    active: jax.Array,
+) -> tuple[jax.Array, dict[str, jax.Array]]:
+    """One decode tick for the whole slot pool.
+
+    Unlike models.transformer.decode_step (lockstep: every row at the same
+    position), each slot writes its new KV at ITS OWN length via a batched
+    scatter, so staggered sequences coexist. tokens: [B] int32; active: [B]
+    bool. Inactive slots still compute (uniform work is free on the MXU) but
+    neither their cache nor their length advances.
+    """
+    b = tokens.shape[0]
+    cos, sin = rope_angles(cfg.max_seq, cfg.head_dim)
+    lens = cache["len"]
+    positions = lens[:, None]  # [B, 1] per-slot write position
+    x = params["embed"][tokens[:, None]].astype(cfg.dtype)
+    rows = jnp.arange(b)
+
+    def layer(x, inp):
+        lp, layer_k, layer_v = inp
+        q, k, v = _qkv(cfg, lp, x, cos, sin, positions)
+        # per-slot scatter at (row, lens[row]); inactive rows keep old KV
+        new_k = layer_k.at[rows, lens].set(
+            jnp.where(active[:, None, None], k[:, 0], layer_k[rows, lens])
+        )
+        new_v = layer_v.at[rows, lens].set(
+            jnp.where(active[:, None, None], v[:, 0], layer_v[rows, lens])
+        )
+        attn = causal_attention(q, new_k, new_v, kv_len=lens + 1)
+        x = x + attn.reshape(b, 1, cfg.qkv_dim) @ lp["wo"]
+        x = x + _mlp_block(lp, x)
+        return x, (new_k, new_v)
+
+    x, (new_ks, new_vs) = jax.lax.scan(layer, x, (params["layers"], cache["k"], cache["v"]))
+    x = rms_norm(x, params["final_norm"])
+    logits = (x[:, 0] @ params["embed"].T).astype(jnp.float32)
+    new_cache = {
+        "k": new_ks,
+        "v": new_vs,
+        "len": jnp.where(active, lens + 1, lens),
+    }
+    return logits, new_cache
+
+
+def prefill_into_slot(
+    params: Params,
+    cfg: ModelConfig,
+    cache: dict[str, jax.Array],
+    tokens: jax.Array,
+    slot: jax.Array,
+    true_len: jax.Array,
+) -> tuple[jax.Array, dict[str, jax.Array]]:
+    """Prefill a [1, bucket] (right-padded) prompt and install it in *slot*.
+
+    Causality makes right padding harmless: real positions never attend to
+    the pad tail, and decode masks the cache past true_len. Returns the first
+    generated token's logits ([vocab]) and the updated pool cache.
+    """
+    logits, seq_cache = prefill(params, cfg, tokens)
+    # [L, 1, max_seq, H, Dh] -> the bucket's worth, written at (layer, slot, 0)
+    s = tokens.shape[1]
+    k = seq_cache["k"][:, 0, :s]
+    v = seq_cache["v"][:, 0, :s]
+    new_k = cache["k"].at[:, slot, :s].set(k)
+    new_v = cache["v"].at[:, slot, :s].set(v)
+    new_len = cache["len"].at[slot].set(true_len)
+    last = logits[0, true_len - 1]
+    return last, {"k": new_k, "v": new_v, "len": new_len}
+
+
+class ServingEngine:
+    """Continuous-batching loop: admit -> prefill -> joint decode -> stream.
+
+    Runs a background thread; `submit()` is thread-safe and returns a Request
+    whose `.stream()` yields tokens as they are produced. The loop prefers
+    admission (a waiting request fills an idle slot) and otherwise advances
+    every active slot one token — the standard prefill-prioritized continuous
+    batching schedule.
+    """
+
+    def __init__(
+        self,
+        params: Params,
+        cfg: ModelConfig,
+        serving: ServingConfig = ServingConfig(),
+        sample: Optional[Callable[[jax.Array], int]] = None,
+    ):
+        self.params = params
+        self.cfg = cfg
+        self.serving = serving
+        self.sample = sample or (lambda logits: int(jnp.argmax(logits)))
+        b = serving.slots
+        self.cache = init_kv_cache(cfg, b)
+        self._decode = jax.jit(
+            lambda params, cache, tokens, active: batched_decode_step(
+                cfg=cfg, params=params, cache=cache, tokens=tokens, active=active
+            )
+        )
+        self._prefill = jax.jit(
+            lambda params, cache, tokens, slot, true_len: prefill_into_slot(
+                params, cfg, cache, tokens, slot, true_len
+            )
+        )
+        self._pending: "queue.Queue[Request]" = queue.Queue()
+        self._slot_req: list[Optional[Request]] = [None] * b
+        self._slot_budget = [0] * b
+        self._tokens = [0] * b  # next token per slot (host-side)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------ API
+
+    def submit(self, tokens, max_new_tokens: int = 0) -> Request:
+        req = Request(tokens=jnp.asarray(tokens, jnp.int32),
+                      max_new_tokens=max_new_tokens or self.serving.max_new_tokens)
+        self._pending.put(req)
+        return req
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=10)
+
+    # ----------------------------------------------------------------- loop
+
+    def _bucket(self, n: int) -> int:
+        for b in self.serving.prefill_buckets:
+            if n <= b:
+                return b
+        raise ValueError(f"prompt length {n} exceeds the largest bucket "
+                         f"{self.serving.prefill_buckets[-1]}")
+
+    def _admit(self, slot: int, req: Request) -> None:
+        prompt = req.tokens
+        n = int(prompt.shape[0])
+        bucket = self._bucket(n)
+        padded = jnp.zeros((1, bucket), jnp.int32).at[0, :n].set(prompt)
+        logits, self.cache = self._prefill(
+            self.params, self.cache, padded, jnp.int32(slot), jnp.int32(n)
+        )
+        first = self.sample(logits)
+        self._slot_req[slot] = req
+        # the KV cache is a hard wall: never decode past max_seq
+        budget = min(req.max_new_tokens, self.cfg.max_seq - n)
+        self._slot_budget[slot] = budget - 1
+        self._tokens[slot] = first
+        req.out.put(first)
+        if self._slot_budget[slot] <= 0 or first == self.serving.eos_token:
+            self._retire(slot)
+
+    def _retire(self, slot: int) -> None:
+        req = self._slot_req[slot]
+        if req is not None:
+            req.out.put(None)
+        self._slot_req[slot] = None
+        self._slot_budget[slot] = 0
+
+    def _loop(self) -> None:
+        b = self.serving.slots
+        while not self._stop.is_set():
+            # 1. admission first: fill every idle slot that has a waiter
+            admitted = False
+            for slot in range(b):
+                if self._slot_req[slot] is None:
+                    try:
+                        req = self._pending.get_nowait()
+                    except queue.Empty:
+                        break
+                    if req.cancelled:
+                        req.out.put(None)
+                        continue
+                    self._admit(slot, req)
+                    admitted = True
+            # retire slots whose client walked away before decoding for them
+            for slot in range(b):
+                req = self._slot_req[slot]
+                if req is not None and req.cancelled:
+                    self._retire(slot)
+            active_slots = [i for i in range(b) if self._slot_req[i] is not None]
+            if not active_slots:
+                if not admitted:
+                    try:
+                        req = self._pending.get(timeout=0.05)
+                    except queue.Empty:
+                        continue
+                    if req.cancelled:
+                        req.out.put(None)
+                        continue
+                    self._admit(0, req)
+                continue
+            # 2. one decode tick for the whole pool
+            tokens = jnp.asarray(self._tokens, jnp.int32)
+            active = jnp.asarray(
+                [self._slot_req[i] is not None for i in range(b)], bool
+            )
+            logits, self.cache = self._decode(self.params, self.cache, tokens, active)
+            for slot in active_slots:
+                tok = self.sample(logits[slot])
+                self._tokens[slot] = tok
+                req = self._slot_req[slot]
+                req.out.put(tok)
+                self._slot_budget[slot] -= 1
+                if self._slot_budget[slot] <= 0 or tok == self.serving.eos_token:
+                    self._retire(slot)
