@@ -1,0 +1,15 @@
+"""The static-verification layer stays green (reference hack/verify-all.sh
+run in CI: staticcheck, license headers, chart version)."""
+
+import subprocess
+import sys
+from pathlib import Path
+
+
+def test_static_checks_pass():
+    root = Path(__file__).resolve().parent.parent
+    r = subprocess.run(
+        [sys.executable, str(root / "hack" / "verify.py")],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 0, f"static verification failed:\n{r.stdout}\n{r.stderr}"

@@ -1,1 +1,4 @@
+"""Mock device backend: fabricated inventory for CPU-only CI (reference
+mock-device-plugin trick)."""
+
 from vtpu.device.mock.device import MockDevices  # noqa: F401

@@ -12,9 +12,6 @@ from vtpu.device.types import (
     ContainerDevice,
     ContainerDeviceRequest,
     ContainerDevices,
-    DeviceUsage,
-    NodeInfo,
-    PodDevices,
 )
 from vtpu.util.helpers import resource_limits
 

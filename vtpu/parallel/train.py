@@ -12,7 +12,6 @@ import dataclasses
 from typing import Any, Callable
 
 import jax
-import jax.numpy as jnp
 import optax
 
 from vtpu.models.transformer import ModelConfig, init_params, prefill

@@ -10,7 +10,6 @@ from __future__ import annotations
 
 import logging
 import threading
-import time
 
 from vtpu.device import codec
 from vtpu.plugin.rm import TpuResourceManager

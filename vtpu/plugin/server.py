@@ -17,7 +17,6 @@ from __future__ import annotations
 import logging
 import os
 import threading
-import time
 from concurrent import futures
 from dataclasses import dataclass, field
 

@@ -12,10 +12,10 @@ import logging
 import socket
 
 from vtpu.device import codec
+from vtpu.plugin.register import REGISTER_ANNO
 from vtpu.device.types import DeviceInfo
 from vtpu.device.tpu.topology import default_ici_mesh
 from vtpu.scheduler.config import (
-    SchedulerOptions,
     init_devices_with_config,
     load_device_config,
 )
@@ -47,7 +47,7 @@ def make_fake_cluster(n_nodes: int, chips_per_node: int = 8) -> FakeKubeClient:
                 "metadata": {
                     "name": f"tpu-node-{i}",
                     "annotations": {
-                        "vtpu.io/node-tpu-register": codec.encode_node_devices(devices)
+                        REGISTER_ANNO: codec.encode_node_devices(devices)
                     },
                 }
             }

@@ -8,7 +8,7 @@ default, and the registry init that turns config into backend instances.
 from __future__ import annotations
 
 import logging
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 import yaml
 

@@ -27,7 +27,7 @@ from vtpu.device.pods import PodManager
 from vtpu.device.quota import QuotaManager
 from vtpu.device.registry import DEVICES_MAP, SUPPORT_DEVICES
 from vtpu.device import codec
-from vtpu.device.types import DeviceUsage, NodeInfo, PodDevices, SliceInfo
+from vtpu.device.types import DeviceUsage, NodeInfo, SliceInfo
 from vtpu.scheduler import score as score_mod
 from vtpu.scheduler.events import EventRecorder
 from vtpu.scheduler.nodes import NodeManager

@@ -17,7 +17,7 @@ import json
 import os
 import threading
 import time
-from typing import Callable, Iterable, Optional
+from typing import Callable, Optional
 
 
 class ApiError(Exception):
