@@ -15,8 +15,10 @@ from vtpu.parallel.ulysses import ulysses_attention
 from vtpu.parallel.expert import ep_moe_forward, make_ep_ffn, moe_param_shardings
 from vtpu.parallel.pipeline import pipeline_apply, pp_transformer_forward, pp_loss, microbatch
 from vtpu.parallel.train import make_train_step, init_train_state
+from vtpu.parallel.checkpoint import TrainCheckpointer
 
 __all__ = [
+    "TrainCheckpointer",
     "make_mesh",
     "mesh_shape_for",
     "make_axis_mesh",

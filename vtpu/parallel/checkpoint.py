@@ -1,0 +1,136 @@
+"""Sharded checkpoint/resume for the training path (orbax-backed).
+
+TPU-first elasticity: a checkpoint written from one mesh restores onto ANY
+other mesh geometry — restore targets are abstract shapes annotated with the
+NEW mesh's NamedShardings, so orbax reshards on read and each host only
+touches the bytes its devices own. That is the recovery story the reference
+lacks (its control plane is stateless; SURVEY §5.4): here the *data plane*
+can lose a slice, be rescheduled by the vTPU middleware onto a different
+topology, and resume.
+
+Layout per step: ``<dir>/<step>/`` — an orbax StandardSave tree of
+{params, opt_state}; the step number is the directory name.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Optional
+
+import jax
+import optax
+
+from vtpu.models.transformer import ModelConfig, init_params
+from vtpu.parallel.sharding import param_shardings
+
+log = logging.getLogger(__name__)
+
+
+class TrainCheckpointer:
+    """Save/restore the train state tree with keep-N retention.
+
+    Built on ocp.CheckpointManager so saves are atomic (tmp dir + rename):
+    a preempted save never corrupts the latest restorable step.
+    """
+
+    def __init__(self, directory: str, keep: int = 3):
+        # lazy: checkpointing is the only vtpu.parallel feature needing orbax
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self.manager = ocp.CheckpointManager(
+            directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=keep, create=True, enable_async_checkpointing=False
+            ),
+        )
+
+    def save(self, step: int, state: Any) -> None:
+        self.manager.save(step, args=self._ocp.args.StandardSave(state))
+        self.manager.wait_until_finished()
+
+    def latest_step(self) -> Optional[int]:
+        return self.manager.latest_step()
+
+    def restore(
+        self,
+        cfg: ModelConfig,
+        mesh,
+        opt: optax.GradientTransformation,
+        step: Optional[int] = None,
+    ) -> tuple[Any, int]:
+        """Restore (state, step) resharded onto *mesh*.
+
+        The abstract target is built by eval_shape over the same init the
+        trainer uses, so the tree structure always matches; shardings come
+        from the CURRENT mesh, which may have a different axis split (or
+        device count) than the mesh that wrote the checkpoint.
+        """
+        step = self.manager.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError("no checkpoint step found")
+        abstract = _abstract_state(cfg, mesh, opt)
+        state = self.manager.restore(step, args=self._ocp.args.StandardRestore(abstract))
+        return state, step
+
+    def close(self) -> None:
+        self.manager.close()
+
+
+def _abstract_state(cfg: ModelConfig, mesh, opt: optax.GradientTransformation):
+    """ShapeDtypeStructs of {params, opt_state} with NamedShardings on *mesh*."""
+
+    def build():
+        params = init_params(jax.random.key(0), cfg)
+        return {"params": params, "opt_state": opt.init(params)}
+
+    shapes = jax.eval_shape(build)
+    shardings = {
+        "params": param_shardings(mesh),
+        "opt_state": _opt_shardings(shapes["opt_state"], mesh),
+    }
+
+    def annotate(shape, sharding):
+        return jax.ShapeDtypeStruct(shape.shape, shape.dtype, sharding=sharding)
+
+    return {
+        "params": jax.tree.map(annotate, shapes["params"], shardings["params"]),
+        "opt_state": jax.tree.map(
+            annotate, shapes["opt_state"], shardings["opt_state"],
+            is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct),
+        ),
+    }
+
+
+def _opt_shardings(opt_shapes, mesh):
+    """Optimizer moments mirror the param shardings; scalar counters are
+    replicated. Matches init_train_state, where opt.init is jitted over
+    already-placed params."""
+    pshard = param_shardings(mesh)
+    replicated = jax.NamedSharding(mesh, jax.sharding.PartitionSpec())
+
+    def map_state(node):
+        if isinstance(node, dict) and set(node.keys()) == _tree_keys(pshard):
+            # a param-shaped subtree (e.g. adam mu/nu): reuse param shardings
+            return jax.tree.map(lambda _, s: s, node, pshard)
+        return None
+
+    def recurse(node):
+        mapped = map_state(node)
+        if mapped is not None:
+            return mapped
+        if isinstance(node, jax.ShapeDtypeStruct):
+            return replicated
+        if isinstance(node, dict):
+            return {k: recurse(v) for k, v in node.items()}
+        if hasattr(node, "_fields"):  # NamedTuple (optax states) — before tuple
+            return type(node)(*(recurse(v) for v in node))
+        if isinstance(node, (list, tuple)):
+            return type(node)(recurse(v) for v in node)
+        return node
+
+    return recurse(opt_shapes)
+
+
+def _tree_keys(tree) -> set:
+    return set(tree.keys()) if isinstance(tree, dict) else set()
