@@ -16,8 +16,22 @@ from vtpu.models.transformer import (
     greedy_generate,
 )
 from vtpu.models.moe import MoEConfig, init_moe_params, moe_forward, moe_loss
+from vtpu.models.ssm import (
+    SSMConfig,
+    init_ssm_params,
+    init_ssm_state,
+    ssm_decode_step,
+    ssm_forward,
+    ssm_loss,
+)
 
 __all__ = [
+    "SSMConfig",
+    "init_ssm_params",
+    "init_ssm_state",
+    "ssm_decode_step",
+    "ssm_forward",
+    "ssm_loss",
     "ModelConfig",
     "init_params",
     "init_kv_cache",

@@ -23,7 +23,7 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
-from vtpu.ops import rms_norm, apply_rope, rope_angles, causal_attention
+from vtpu.ops import scaled_normal, rms_norm, apply_rope, rope_angles, causal_attention
 
 Params = dict[str, Any]
 
@@ -57,7 +57,7 @@ def init_moe_params(rng: jax.Array, cfg: MoEConfig) -> Params:
     d, f, l, e, qd = cfg.d_model, cfg.d_ff, cfg.n_layers, cfg.n_experts, cfg.qkv_dim
 
     def w(key, shape, fan_in):
-        return (jax.random.normal(key, shape, jnp.float32) / math.sqrt(fan_in)).astype(cfg.dtype)
+        return scaled_normal(key, shape, fan_in, cfg.dtype)
 
     return {
         "embed": w(keys[0], (cfg.vocab, d), d),

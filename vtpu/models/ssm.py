@@ -1,0 +1,187 @@
+"""Selective state-space (Mamba-style) language model, TPU-first.
+
+Third model family beside the dense transformer and the MoE. The reference
+middleware has no model code; these are the workloads it schedules, and this
+one exercises a different hardware profile than attention: no KV cache, O(1)
+decode state, and a sequence mixer that is a parallel prefix instead of a
+matmul over positions.
+
+TPU-first choices:
+- the selective scan h_t = a_t * h_{t-1} + b_t runs as
+  ``jax.lax.associative_scan`` — log-depth parallel prefix that XLA maps onto
+  the vector units, instead of a translated sequential CUDA kernel;
+- the short causal depthwise conv is an explicit pad+window matmul (static
+  shapes, fuses into the surrounding elementwise ops);
+- diagonal A (per channel x state), bf16 activations with f32 scan
+  accumulator, layers stacked and scanned like the transformer.
+
+Recurrent decode: ``ssm_decode_step`` carries (conv window, h state) per
+layer — constant memory per token, no cache growth with context.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from vtpu.ops import scaled_normal, rms_norm
+
+Params = dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class SSMConfig:
+    vocab: int = 2048
+    d_model: int = 512
+    n_layers: int = 4
+    d_state: int = 16  # per-channel SSM state width N
+    d_conv: int = 4  # short causal conv window
+    expand: int = 2  # inner width = expand * d_model
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def d_inner(self) -> int:
+        return self.expand * self.d_model
+
+
+def init_ssm_params(rng: jax.Array, cfg: SSMConfig) -> Params:
+    keys = jax.random.split(rng, 5)
+    d, di, n, l = cfg.d_model, cfg.d_inner, cfg.d_state, cfg.n_layers
+
+    def w(key, shape, fan_in):
+        return scaled_normal(key, shape, fan_in, cfg.dtype)
+
+    # S4/Mamba-style A init: -[1..N] per channel, stored as log for stability
+    a_log = jnp.log(jnp.broadcast_to(jnp.arange(1, n + 1, dtype=jnp.float32), (di, n)))
+    return {
+        "embed": w(keys[0], (cfg.vocab, d), d),
+        "layers": {
+            "in_proj": w(keys[1], (l, d, 2 * di), d),  # -> (x, z)
+            "conv_w": w(keys[2], (l, cfg.d_conv, di), cfg.d_conv),
+            "x_proj": w(keys[3], (l, di, 2 * n + 1), di),  # -> (B, C, dt)
+            "dt_bias": jnp.zeros((l,), jnp.float32),  # per-layer step-size bias
+            "a_log": jnp.broadcast_to(a_log, (l, di, n)).astype(jnp.float32),
+            "d_skip": jnp.ones((l, di), cfg.dtype),
+            "out_proj": w(keys[4], (l, di, d), di),
+            "norm": jnp.ones((l, d), cfg.dtype),
+        },
+        "final_norm": jnp.ones((d,), cfg.dtype),
+    }
+
+
+def _causal_conv(x: jax.Array, w: jax.Array) -> jax.Array:
+    """Depthwise causal conv. x: [B, S, Di], w: [K, Di] -> [B, S, Di]."""
+    k = w.shape[0]
+    pad = jnp.pad(x, ((0, 0), (k - 1, 0), (0, 0)))
+    # window matmul: sum_k w[k] * x[t - (K-1) + k]
+    out = jnp.zeros_like(x)
+    for i in range(k):  # K is tiny (4) and static: unrolled, fused by XLA
+        out = out + pad[:, i : i + x.shape[1]] * w[i]
+    return out
+
+
+def _selective_mix(lp: dict[str, jax.Array], x: jax.Array):
+    """Input-dependent (selective) SSM coefficients from x: [B, S, Di].
+
+    Returns per-step decay a: [B,S,Di,N] and drive b: [B,S,Di,N] plus C
+    readout [B,S,N] — the discretized diagonal SSM."""
+    n = lp["a_log"].shape[-1]
+    proj = (x @ lp["x_proj"]).astype(jnp.float32)  # [B,S,2N+1]
+    b_in, c_out, dt = proj[..., :n], proj[..., n : 2 * n], proj[..., -1:]
+    dt = jax.nn.softplus(dt + lp["dt_bias"])  # [B,S,1] step size > 0
+    a = -jnp.exp(lp["a_log"])  # [Di,N], negative: stable decay
+    a_disc = jnp.exp(dt[..., None] * a)  # [B,S,Di,N]
+    xf = x.astype(jnp.float32)
+    b_disc = (dt[..., None] * b_in[:, :, None, :]) * xf[..., None]  # [B,S,Di,N]
+    return a_disc, b_disc, c_out
+
+
+def _scan_states(a: jax.Array, b: jax.Array) -> jax.Array:
+    """h_t = a_t h_{t-1} + b_t over axis 1 by parallel prefix."""
+
+    def combine(left, right):
+        a1, b1 = left
+        a2, b2 = right
+        return a1 * a2, a2 * b1 + b2
+
+    _, h = jax.lax.associative_scan(combine, (a, b), axis=1)
+    return h  # [B,S,Di,N]
+
+
+def ssm_layer(cfg: SSMConfig, lp: dict[str, jax.Array], x: jax.Array) -> jax.Array:
+    """One selective-SSM block over a full sequence. x: [B, S, D]."""
+    normed = rms_norm(x, lp["norm"])
+    xz = normed @ lp["in_proj"]
+    xi, z = jnp.split(xz, 2, axis=-1)  # [B,S,Di] each
+    xi = jax.nn.silu(_causal_conv(xi, lp["conv_w"]).astype(jnp.float32)).astype(x.dtype)
+    a, b, c = _selective_mix(lp, xi)
+    h = _scan_states(a, b)
+    y = jnp.einsum("bsdn,bsn->bsd", h, c)  # readout
+    y = y + xi.astype(jnp.float32) * lp["d_skip"].astype(jnp.float32)
+    y = (y * jax.nn.silu(z.astype(jnp.float32))).astype(x.dtype)
+    return x + y @ lp["out_proj"]
+
+
+def ssm_forward(params: Params, cfg: SSMConfig, tokens: jax.Array) -> jax.Array:
+    """tokens [B, S] -> logits [B, S, V] (f32)."""
+    x = params["embed"][tokens].astype(cfg.dtype)
+
+    def layer(x, lp):
+        return ssm_layer(cfg, lp, x), None
+
+    x, _ = jax.lax.scan(layer, x, params["layers"])
+    x = rms_norm(x, params["final_norm"])
+    return (x @ params["embed"].T).astype(jnp.float32)
+
+
+def ssm_loss(params: Params, cfg: SSMConfig, tokens: jax.Array) -> jax.Array:
+    from vtpu.ops.loss import next_token_ce
+
+    return next_token_ce(ssm_forward(params, cfg, tokens), tokens)
+
+
+# ---------------------------------------------------------------- O(1) decode
+
+
+def init_ssm_state(cfg: SSMConfig, batch: int) -> dict[str, jax.Array]:
+    """Constant-size per-token decode state: conv windows + SSM states."""
+    return {
+        "conv": jnp.zeros((cfg.n_layers, batch, cfg.d_conv - 1, cfg.d_inner), cfg.dtype),
+        "h": jnp.zeros((cfg.n_layers, batch, cfg.d_inner, cfg.d_state), jnp.float32),
+    }
+
+
+def ssm_decode_step(
+    params: Params, cfg: SSMConfig, state: dict[str, jax.Array], token: jax.Array
+) -> tuple[jax.Array, dict[str, jax.Array]]:
+    """One recurrent step. token: [B] -> (logits [B, V], new state).
+
+    Exactly the sequence path evaluated at one position: the conv window
+    replaces padding, the scan becomes h = a*h + b.
+    """
+    x = params["embed"][token[:, None]].astype(cfg.dtype)  # [B,1,D]
+
+    def layer(x, inp):
+        lp, conv_win, h = inp
+        normed = rms_norm(x, lp["norm"])
+        xz = normed @ lp["in_proj"]
+        xi, z = jnp.split(xz, 2, axis=-1)  # [B,1,Di]
+        window = jnp.concatenate([conv_win, xi], axis=1)  # [B,K,Di]
+        conv = jnp.einsum("bkd,kd->bd", window, lp["conv_w"])[:, None]
+        xi = jax.nn.silu(conv.astype(jnp.float32)).astype(x.dtype)
+        a, b, c = _selective_mix(lp, xi)  # [B,1,Di,N], [B,1,N]
+        new_h = a[:, 0] * h + b[:, 0]  # [B,Di,N]
+        y = jnp.einsum("bdn,bn->bd", new_h, c[:, 0])[:, None]
+        y = y + xi.astype(jnp.float32) * lp["d_skip"].astype(jnp.float32)
+        y = (y * jax.nn.silu(z.astype(jnp.float32))).astype(x.dtype)
+        return x + y @ lp["out_proj"], (window[:, 1:], new_h)
+
+    x, (new_conv, new_h) = jax.lax.scan(
+        layer, x, (params["layers"], state["conv"], state["h"])
+    )
+    x = rms_norm(x, params["final_norm"])
+    logits = (x[:, 0] @ params["embed"].T).astype(jnp.float32)
+    return logits, {"conv": new_conv, "h": new_h}

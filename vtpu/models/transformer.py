@@ -12,13 +12,12 @@ TPU-first choices:
 from __future__ import annotations
 
 import dataclasses
-import math
 from typing import Any
 
 import jax
 import jax.numpy as jnp
 
-from vtpu.ops import rms_norm, apply_rope, rope_angles, causal_attention, flash_attention
+from vtpu.ops import scaled_normal, rms_norm, apply_rope, rope_angles, causal_attention, flash_attention
 
 Params = dict[str, Any]
 
@@ -46,7 +45,7 @@ def init_params(rng: jax.Array, cfg: ModelConfig) -> Params:
     d, f, l, qd = cfg.d_model, cfg.d_ff, cfg.n_layers, cfg.qkv_dim
 
     def w(key, shape, fan_in):
-        return (jax.random.normal(key, shape, jnp.float32) / math.sqrt(fan_in)).astype(cfg.dtype)
+        return scaled_normal(key, shape, fan_in, cfg.dtype)
 
     return {
         "embed": w(keys[0], (cfg.vocab, d), d),

@@ -7,11 +7,13 @@ JAX/XLA model under vTPU isolation, mirroring the reference's vLLM harness,
 reference benchmarks/ai-benchmark/benchmark.py:1-50).
 """
 
+from vtpu.ops.init import scaled_normal
 from vtpu.ops.norms import rms_norm
 from vtpu.ops.rope import apply_rope, rope_angles
 from vtpu.ops.attention import causal_attention, flash_attention
 
 __all__ = [
+    "scaled_normal",
     "rms_norm",
     "apply_rope",
     "rope_angles",

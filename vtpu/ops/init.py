@@ -1,0 +1,14 @@
+"""Weight init shared by every model family."""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+def scaled_normal(key: jax.Array, shape: tuple[int, ...], fan_in: int, dtype: Any) -> jax.Array:
+    """N(0, 1/fan_in) init cast to the model dtype (f32 draw for stability)."""
+    return (jax.random.normal(key, shape, jnp.float32) / math.sqrt(fan_in)).astype(dtype)
