@@ -46,3 +46,15 @@ def shard_params(params: Any, mesh: Mesh) -> Any:
     """Place a host pytree of params onto the mesh per param_shardings."""
     specs = param_shardings(mesh)
     return jax.tree.map(jax.device_put, params, specs)
+
+
+def kv_cache_shardings(mesh: Mesh) -> dict[str, NamedSharding]:
+    """KV cache [L, B, S, H, Dh]: heads over 'tp' (matching the q/k/v column
+    shards), lengths replicated. Serving is tp-only — see shard_kv_cache."""
+    kv = NamedSharding(mesh, P(None, None, None, "tp", None))
+    return {"k": kv, "v": kv, "len": NamedSharding(mesh, P())}
+
+
+def shard_kv_cache(cache: dict[str, jax.Array], mesh: Mesh) -> dict[str, jax.Array]:
+    """Place (or re-place) a KV cache per kv_cache_shardings."""
+    return jax.tree.map(jax.device_put, cache, kv_cache_shardings(mesh))

@@ -157,13 +157,34 @@ class ServingEngine:
         cfg: ModelConfig,
         serving: ServingConfig = ServingConfig(),
         sample: Optional[Callable[[jax.Array], int]] = None,
+        mesh=None,
     ):
+        """With *mesh* (a ('dp','tp') Mesh), weights are tensor-parallel over
+        'tp' and the KV cache shards its head axis — multi-chip serving with
+        the same slot machinery; XLA places the per-layer all-reduces on ICI.
+        """
         self.params = params
         self.cfg = cfg
         self.serving = serving
         self.sample = sample or (lambda logits: int(jnp.argmax(logits)))
         b = serving.slots
-        self.cache = init_kv_cache(cfg, b)
+        if mesh is None:
+            self.cache = init_kv_cache(cfg, b)
+        else:
+            from vtpu.parallel.sharding import kv_cache_shardings, shard_params
+
+            if mesh.shape.get("dp", 1) != 1:
+                # decode ticks would replicate across dp groups with zero
+                # throughput gain; slots are the batch axis and stay local
+                raise ValueError(
+                    f"serving mesh must be tp-only (dp=1), got {dict(mesh.shape)}"
+                )
+            self.params = shard_params(params, mesh)
+            # allocate the cache directly sharded: a head-sharded cache that
+            # would not fit one chip must never be materialized unsharded
+            self.cache = jax.jit(
+                lambda: init_kv_cache(cfg, b), out_shardings=kv_cache_shardings(mesh)
+            )()
         self._decode = jax.jit(
             lambda params, cache, tokens, active: batched_decode_step(
                 cfg=cfg, params=params, cache=cache, tokens=tokens, active=active
