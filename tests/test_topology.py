@@ -63,3 +63,60 @@ def test_default_mesh_shapes():
     assert max(c.x for c in m8) == 3 and max(c.y for c in m8) == 1
     m3 = topology.default_ici_mesh(3)
     assert [c.x for c in m3] == [0, 1, 2]
+
+
+def test_topology_aware_node_policy_prefers_compact_node():
+    """Cross-node: with vtpu.io/node-scheduler-policy=topology-aware, the pod
+    lands on the node whose 2-chip assignment is ICI-adjacent rather than on
+    one whose only free chips are far apart."""
+    from vtpu.scheduler.scheduler import Scheduler
+    from vtpu.util import types as t
+    from tests.helpers import fake_cluster, register_tpu_backend, tpu_pod, v5e_devices
+
+    # scattered: only chips 0 and 7 free (opposite corners of the 4x2 mesh)
+    scattered = v5e_devices(8, prefix="sc")
+    compact = v5e_devices(8, prefix="co")
+    client = fake_cluster({"scattered": scattered, "compact": compact})
+    sched = Scheduler(client)
+    register_tpu_backend(quota=sched.quota_manager)
+    sched.start(register_interval=3600)
+    try:
+        # occupy sc-1..sc-6 with exclusive fillers so only corners remain
+        for i in range(1, 7):
+            filler = tpu_pod(f"filler-{i}", tpu=1, tpucores=100,
+                             annotations={t.USE_DEVICE_UUID_ANNO: f"sc-{i}"})
+            filler = client.put_pod(filler)
+            r = sched.filter({"Pod": filler, "NodeNames": ["scattered"]})
+            assert r["NodeNames"] == ["scattered"], r
+        pod = client.put_pod(tpu_pod(
+            "want2", tpu=2,
+            annotations={t.NODE_SCHEDULER_POLICY_ANNO: t.NODE_POLICY_TOPOLOGY}))
+        r = sched.filter({"Pod": pod, "NodeNames": ["scattered", "compact"]})
+        assert r["NodeNames"] == ["compact"], r
+    finally:
+        sched.stop()
+
+
+def test_topology_policy_single_chip_falls_back_to_binpack():
+    """A topology-neutral ask (1 chip) under topology-aware must still
+    binpack by usage instead of picking iteration order."""
+    from vtpu.scheduler.scheduler import Scheduler
+    from vtpu.util import types as t
+    from tests.helpers import fake_cluster, register_tpu_backend, tpu_pod, v5e_devices
+
+    client = fake_cluster({"emptier": v5e_devices(8, prefix="e"),
+                           "fuller": v5e_devices(8, prefix="f")})
+    sched = Scheduler(client)
+    register_tpu_backend(quota=sched.quota_manager)
+    sched.start(register_interval=3600)
+    try:
+        warm = client.put_pod(tpu_pod("warm", tpumem=1024))
+        r = sched.filter({"Pod": warm, "NodeNames": ["fuller"]})
+        assert r["NodeNames"] == ["fuller"]
+        pod = client.put_pod(tpu_pod(
+            "one", tpumem=1024,
+            annotations={t.NODE_SCHEDULER_POLICY_ANNO: t.NODE_POLICY_TOPOLOGY}))
+        r = sched.filter({"Pod": pod, "NodeNames": ["emptier", "fuller"]})
+        assert r["NodeNames"] == ["fuller"]  # binpack tie-break
+    finally:
+        sched.stop()

@@ -189,6 +189,30 @@ class TpuDevices(Devices):
         nouse = self._split_anno(annos, t.NO_USE_DEVICE_TYPE_ANNO)
         return not any(dev.type.lower().startswith(u.lower()) for u in nouse)
 
+    # ------------------------------------------------------------- scoring
+
+    def score_node(self, node, pod_devices, previous, policy) -> float:
+        """Under the 'topology-aware' node policy, nodes whose assignment for
+        THIS pod forms a more compact ICI sub-slice (and strands fewer free
+        chips) score higher — the cross-node half of the reference's
+        topology-aware placement (types.go policy name + nvidia combination
+        scoring; chip-level selection happens in topology.select_subslice).
+        """
+        if policy != t.NODE_POLICY_TOPOLOGY or not pod_devices:
+            return 0.0
+        per_dev = Counter(cd.uuid for ctr in pod_devices for cd in ctr)
+        chosen = [d for d in previous if d.id in per_dev and d.ici is not None]
+        if len(chosen) < 2:
+            return 0.0
+        # post-allocation snapshot: free = still-unused chips (fragmentation
+        # AFTER this placement); idle = was free BEFORE this pod landed
+        free_coords = {
+            d.id: d.ici for d in previous if d.ici is not None and d.used == 0
+        }
+        return -topology.combo_score(
+            chosen, free_coords, idle=lambda d: d.used == per_dev[d.id]
+        )
+
     # ------------------------------------------------------------- fit
 
     def fit(

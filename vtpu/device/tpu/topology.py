@@ -64,14 +64,17 @@ def _fragmentation(chosen: set[str], frees: dict[str, IciCoord]) -> int:
 
 
 def combo_score(
-    combo: Sequence[DeviceUsage], free_coords: dict[str, IciCoord]
+    combo: Sequence[DeviceUsage],
+    free_coords: dict[str, IciCoord],
+    idle=None,
 ) -> float:
-    """Lower is better."""
+    """Lower is better. *idle* says whether a chip counts as unshared for the
+    rectangle bonus (default: used == 0; post-allocation callers pass a
+    predicate that discounts their own pod's usage)."""
+    idle = idle or (lambda d: d.used == 0)
     coords = [d.ici or IciCoord() for d in combo]
     score = float(_pairwise_distance(coords))
-    if len(coords) > 1 and _is_full_rectangle(coords) and all(
-        d.used == 0 for d in combo
-    ):
+    if len(coords) > 1 and _is_full_rectangle(coords) and all(idle(d) for d in combo):
         score -= RECTANGLE_BONUS
     chosen = {d.id for d in combo}
     score += FRAGMENT_PENALTY * _fragmentation(chosen, free_coords)

@@ -91,7 +91,16 @@ def calc_score(
     def score_node(node_name: str) -> tuple[Optional[NodeScore], str]:
         snapshot = nodes_usage[node_name]
         ns = NodeScore(node_name=node_name, snapshot=snapshot)
-        ns.score = policy_mod.compute_default_node_score(snapshot)
+        # topology-aware REPLACES the usage-based default with the vendors'
+        # combination scores (reference OverrideScore node_policy.go:56); the
+        # default survives only as an epsilon tie-break so topology-neutral
+        # requests (single chip, no ICI data) still binpack instead of
+        # landing on whichever node iterates first. binpack/spread stack
+        # vendor scores on top of the default.
+        if node_policy == t.NODE_POLICY_TOPOLOGY:
+            ns.score = 1e-6 * policy_mod.compute_default_node_score(snapshot)
+        else:
+            ns.score = policy_mod.compute_default_node_score(snapshot)
         node_info = node_infos.get(node_name) or NodeInfo(node_name=node_name)
         for ctr_index, requests in enumerate(per_container_requests):
             ok, reason = fit_in_devices(ns, requests, ctr_index, pod, node_info, device_policy)
