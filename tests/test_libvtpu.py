@@ -48,3 +48,65 @@ def test_region_layout_matches_python_mirror(libvtpu_build, tmp_path):
     assert snap.devices[0].kernel_count == 3
     assert snap.devices[0].hbm_peak_bytes >= 3 * 16 * 1024 * 1024
     assert any(p.active for p in snap.procs)
+
+
+def test_monitor_block_gates_running_workload(libvtpu_build, tmp_path):
+    """The priority gate end to end across the language boundary: the Python
+    monitor writes recent_kernel=-1 into a LIVE workload's region and the C++
+    shim stalls its executes until unblocked (reference feedback.go:104-134
+    semantics against HAMi-core's gate)."""
+    import os
+    import subprocess as sp
+    import time
+
+    from vtpu.monitor.region import RegionReader
+
+    region = tmp_path / "usage.cache"
+    env = dict(os.environ)
+    env.update({
+        "VTPU_REAL_LIBTPU": str(libvtpu_build / "fake_pjrt.so"),
+        "VTPU_SHARED_REGION": str(region),
+        "TPU_DEVICE_MEMORY_LIMIT_0": "64m",
+    })
+    smoke = [str(libvtpu_build / "pjrt_smoke"), str(libvtpu_build / "libvtpu.so")]
+
+    # 1. a first run creates the region (1 exec recorded)
+    r = sp.run([*smoke, "1", "1", "1"], env=env, capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    reader = RegionReader(str(region))
+    count0 = reader.read().devices[0].kernel_count
+    assert count0 == 1
+
+    # 2. monitor blocks the tenant BEFORE its next burst; the shim re-maps
+    #    the existing region and must respect the gate on its first execute
+    reader.set_recent_kernel(-1)
+    procs_before = len(reader.read().procs)
+    proc = sp.Popen([*smoke, "1", "1", "30"], env=env,
+                    stdout=sp.PIPE, stderr=sp.PIPE, text=True)
+    try:
+        # wait until the child has MAPPED the region (Region::open registers
+        # its proc slot before the first execute) so the blocked assertion
+        # can't pass vacuously on a slow-starting process
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            if len(reader.read().procs) > procs_before:
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError("child never mapped the shared region")
+        time.sleep(1.0)  # it is past init and gated; give it time to misbehave
+        blocked_count = reader.read().devices[0].kernel_count
+        assert blocked_count == count0, (
+            f"blocked tenant executed anyway ({count0}->{blocked_count})"
+        )
+        assert proc.poll() is None, "workload exited while blocked"
+
+        # 3. unblock: the run drains to completion and every exec is recorded
+        reader.set_recent_kernel(1)
+        _out, err = proc.communicate(timeout=30)
+        assert proc.returncode == 0, err
+        assert reader.read().devices[0].kernel_count == count0 + 30
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
