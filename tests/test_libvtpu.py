@@ -80,16 +80,16 @@ def test_monitor_block_gates_running_workload(libvtpu_build, tmp_path):
     # 2. monitor blocks the tenant BEFORE its next burst; the shim re-maps
     #    the existing region and must respect the gate on its first execute
     reader.set_recent_kernel(-1)
-    procs_before = len(reader.read().procs)
     proc = sp.Popen([*smoke, "1", "1", "30"], env=env,
                     stdout=sp.PIPE, stderr=sp.PIPE, text=True)
     try:
-        # wait until the child has MAPPED the region (Region::open registers
-        # its proc slot before the first execute) so the blocked assertion
-        # can't pass vacuously on a slow-starting process
+        # wait until the child has MAPPED the region (Region::open claims a
+        # proc slot with its pid — possibly reclaiming the dead first run's —
+        # before the first execute) so the blocked assertion can't pass
+        # vacuously on a slow-starting process
         deadline = time.monotonic() + 15
         while time.monotonic() < deadline:
-            if len(reader.read().procs) > procs_before:
+            if any(p.pid == proc.pid for p in reader.read().procs):
                 break
             time.sleep(0.05)
         else:
