@@ -126,3 +126,33 @@ def test_quota_weird_quantities_do_not_crash():
                                     "limits.google.com/tpu": "2500m"}}})
     assert not qm.fit_quota("ns", "TPU", memreq=1024 * 1024 + 1, coresreq=0)
     assert qm.fit_quota("ns", "TPU", memreq=0, coresreq=10**9)  # garbage skipped
+
+
+def test_quota_memory_factor_scales_limit():
+    """Classes whose quota is counted in chunks of N MiB multiply the mem
+    limit by memoryFactor (reference quota.go:75-76). The factor lives in
+    the QuotaManager (from the registered backend's config) so the webhook
+    pre-check and Fit agree, and snapshot() exports MiB on both sides."""
+    from vtpu.device.registry import register_backend
+    from vtpu.device.tpu.device import TpuConfig, TpuDevices
+
+    qm = QuotaManager()
+    register_backend(TpuDevices(TpuConfig(memory_factor=1024), quota=qm))
+    qm.refresh_managed_resources()
+    qm.add_quota({
+        "metadata": {"name": "q", "namespace": "team-f"},
+        "spec": {"hard": {"limits.google.com/tpumem": "4"}},  # 4 GiB chunks
+    })
+    assert qm.fit_quota("team-f", "TPU", memreq=4096, coresreq=0)
+    assert not qm.fit_quota("team-f", "TPU", memreq=4097, coresreq=0)
+    # snapshot denominates the limit like usage (MiB)
+    qm.add_usage(_pod("a", ns="team-f"), _devices(mem=2048))
+    snap = qm.snapshot()["team-f"]["google.com/tpumem"]
+    assert snap == {"limit": 4096, "used": 2048}
+    # factor 1 (default class): the raw limit applies
+    qm2 = _quota_mgr()
+    qm2.add_quota({
+        "metadata": {"name": "q", "namespace": "team-f"},
+        "spec": {"hard": {"limits.google.com/tpumem": "4"}},
+    })
+    assert not qm2.fit_quota("team-f", "TPU", memreq=4096, coresreq=0)

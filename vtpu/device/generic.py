@@ -84,6 +84,7 @@ class DeviceClassConfig:
     count_only: bool = False  # amd-style: whole devices from node allocatable
     cores_per_device: int = 1  # awsneuron-style core-level granularity
     qos: bool = False  # metax-style QoS annotations honored
+    memory_factor: int = 1  # mem quota in chunks of N MiB (reference memoryFactor)
     topology_aware: bool = True  # ICI sub-slice selection on multi-chip asks
     templates: list[PartitionTemplate] = field(default_factory=list)
     allowed_types: list[str] = field(default_factory=list)

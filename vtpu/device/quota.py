@@ -93,6 +93,9 @@ class QuotaManager:
         self._managed: dict[str, tuple[str, str]] = {}
         # vendor -> physical cores per device (for coreUnit-role accounting)
         self._cores_per_device: dict[str, int] = {}
+        # vendor -> mem-quota chunk size (reference memoryFactor): the quota
+        # limit counts chunks of N MiB; usage stays MiB
+        self._memory_factor: dict[str, int] = {}
 
     # ---------------------------------------------------------------- registry
 
@@ -102,12 +105,15 @@ class QuotaManager:
         with self._lock:
             self._managed.clear()
             self._cores_per_device.clear()
+            self._memory_factor.clear()
             for word, dev in DEVICES_MAP.items():
                 for role, res in dev.resource_names().items():
                     self._managed[res] = (word, role)
                 cfg = getattr(dev, "config", None)
                 cpd = getattr(cfg, "cores_per_device", 1) if cfg else 1
                 self._cores_per_device[word] = max(1, int(cpd))
+                mf = getattr(cfg, "memory_factor", 1) if cfg else 1
+                self._memory_factor[word] = max(1, int(mf))
             # Quotas observed before the registry existed parse to nothing;
             # re-parse every raw spec now that roles are known.
             for entry in self._ns.values():
@@ -169,7 +175,10 @@ class QuotaManager:
         core_units: int = 0,
     ) -> bool:
         """Would this additional usage stay within the namespace quota?
-        (reference FitQuota; called from vendor Fit paths)."""
+        (reference FitQuota; called from vendor Fit paths and the admission
+        pre-check). The vendor's memoryFactor — quota counted in chunks of
+        N MiB (reference quota.go:75-76) — is looked up here so every caller
+        agrees on the effective limit."""
         with self._lock:
             entry = self._ns.get(namespace)
             if not entry:
@@ -180,8 +189,11 @@ class QuotaManager:
             for res, (word, role) in self._managed.items():
                 if word != vendor or res not in limits:
                     continue
+                limit = limits[res]
                 if role in ("mem", "memPercentage"):
                     add = memreq
+                    if role == "mem":  # percentage limits are not chunked
+                        limit *= self._memory_factor.get(word, 1)
                 elif role == "cores":
                     add = coresreq
                 elif role == "count":
@@ -190,7 +202,7 @@ class QuotaManager:
                     add = core_units
                 else:
                     add = 0
-                if add and entry.used.get(res, 0) + add > limits[res]:
+                if add and entry.used.get(res, 0) + add > limit:
                     return False
             return True
 
@@ -233,15 +245,25 @@ class QuotaManager:
             for res, n in self._usage_of(devices).items():
                 entry.used[res] = max(0, entry.used.get(res, 0) - n)
 
+    def _effective_limit(self, res: str, lim: int) -> int:
+        """Chunk-counted mem limits export in MiB so limit/used stay
+        comparable (memoryFactor)."""
+        word_role = self._managed.get(res)
+        if word_role and word_role[1] == "mem":
+            return lim * self._memory_factor.get(word_role[0], 1)
+        return lim
+
     def snapshot(self) -> dict[str, dict[str, dict[str, int]]]:
-        """{namespace: {resource: {'limit': x, 'used': y}}} for metrics."""
+        """{namespace: {resource: {'limit': x, 'used': y}}} for metrics;
+        limits are denominated like usage (MiB for mem roles)."""
         with self._lock:
             out = {}
             for ns, entry in self._ns.items():
                 limits = entry.effective_limits()
                 if limits:
                     out[ns] = {
-                        res: {"limit": lim, "used": entry.used.get(res, 0)}
+                        res: {"limit": self._effective_limit(res, lim),
+                              "used": entry.used.get(res, 0)}
                         for res, lim in limits.items()
                     }
             return out

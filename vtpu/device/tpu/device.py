@@ -72,6 +72,8 @@ class TpuConfig:
     # HBM oversubscription factor (reference --device-memory-scaling)
     device_memory_scaling: float = 1.0
     device_cores_scaling: float = 1.0
+    # namespace mem quota expressed in chunks of N MiB (reference memoryFactor)
+    memory_factor: int = 1
     default_memory: int = 0  # 0 -> whole-chip HBM when unspecified
     default_cores: int = 0  # 0 -> no core guarantee (share freely)
     # type allow/deny configured cluster-wide (reference type selectors)

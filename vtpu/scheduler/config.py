@@ -122,6 +122,7 @@ def tpu_config_from_dict(d: dict) -> TpuConfig:
         default_memory=int(d.get("defaultMemory", 0)),
         default_cores=int(d.get("defaultCores", 0)),
         allowed_types=list(d.get("allowedTypes", []) or []),
+        memory_factor=int(d.get("memoryFactor", 1)),
     )
 
 
@@ -139,6 +140,7 @@ def device_class_from_dict(d: dict) -> DeviceClassConfig:
         cores_per_device=int(d.get("coresPerDevice", 1)),
         resource_core_unit_name=d.get("resourceCoreUnitName", ""),
         qos=bool(d.get("qos", False)),
+        memory_factor=int(d.get("memoryFactor", 1)),
         topology_aware=bool(d.get("topologyAware", True)),
         templates=[
             PartitionTemplate(
