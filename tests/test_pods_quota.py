@@ -156,3 +156,38 @@ def test_quota_memory_factor_scales_limit():
         "spec": {"hard": {"limits.google.com/tpumem": "4"}},
     })
     assert not qm2.fit_quota("team-f", "TPU", memreq=4096, coresreq=0)
+
+
+def test_quota_suffixed_quantity_never_chunk_scaled():
+    """'4Gi' is an absolute quantity (4096 MiB) even on a chunked class —
+    memoryFactor applies only to bare chunk counts."""
+    from vtpu.device.registry import register_backend
+    from vtpu.device.tpu.device import TpuConfig, TpuDevices
+
+    qm = QuotaManager()
+    register_backend(TpuDevices(TpuConfig(memory_factor=1024), quota=qm))
+    qm.refresh_managed_resources()
+    qm.add_quota({
+        "metadata": {"name": "q", "namespace": "team-g"},
+        "spec": {"hard": {"limits.google.com/tpumem": "4Gi"}},
+    })
+    assert qm.fit_quota("team-g", "TPU", memreq=4096, coresreq=0)
+    assert not qm.fit_quota("team-g", "TPU", memreq=4097, coresreq=0)
+    assert qm.snapshot()["team-g"]["google.com/tpumem"]["limit"] == 4096
+
+
+def test_quota_percentage_resource_not_enforceable():
+    """A quota over a percentage resource is ignored with a warning, never
+    compared against MiB usage."""
+    from vtpu.device.registry import register_backend
+    from vtpu.device.tpu.device import TpuConfig, TpuDevices
+
+    qm = QuotaManager()
+    register_backend(TpuDevices(TpuConfig(), quota=qm))
+    qm.refresh_managed_resources()
+    qm.add_quota({
+        "metadata": {"name": "q", "namespace": "team-p"},
+        "spec": {"hard": {"limits.google.com/tpumem-percentage": "100"}},
+    })
+    # a 50% ask resolved to 8192 MiB must NOT be rejected against "100"
+    assert qm.fit_quota("team-p", "TPU", memreq=8192, coresreq=0)

@@ -32,16 +32,19 @@ _SUFFIXES = (
 )
 
 
-def _parse_quantity(v, role: str = "") -> int | None:
-    """Parse a k8s quantity into the resource's native unit; None if invalid.
+def _parse_quantity(v, role: str = "") -> tuple[int, bool] | None:
+    """Parse a k8s quantity into (value-in-native-unit, was_suffixed); None if
+    invalid.
 
     Bare numbers pass through unchanged (device resources are denominated in
     MiB / percent / count). Byte suffixes are normalized to **MiB** for
     mem-role resources so ``limits.google.com/tpumem: 16Gi`` means 16384.
-    Milli quantities ('500m') round down to whole units.
+    Milli quantities ('500m') round down to whole units. The suffixed flag
+    lets the caller distinguish absolute byte quantities from bare chunk
+    counts (memoryFactor applies only to the latter).
     """
     if isinstance(v, (int, float)):
-        return int(v)
+        return int(v), False
     s = str(v).strip()
     mult = 1.0
     suffixed = False
@@ -59,9 +62,9 @@ def _parse_quantity(v, role: str = "") -> int | None:
         n = float(s) * mult
     except ValueError:
         return None
-    if suffixed and role in ("mem", "memPercentage"):
+    if suffixed and role == "mem":
         n /= 1024**2
-    return int(n)
+    return int(n), suffixed
 
 
 @dataclass
@@ -128,15 +131,35 @@ class QuotaManager:
         return quota_resource[len(QUOTA_PREFIX):] in self._managed
 
     def _parse_hard(self, hard: dict) -> dict[str, int]:
+        """Parse 'limits.*' entries into the units usage is accounted in.
+
+        memoryFactor (reference quota.go:75-76) is applied HERE, once: a bare
+        number on a chunked class means N chunks and becomes N*factor MiB; a
+        byte-suffixed quantity ('4Gi') is already absolute and is never
+        chunk-scaled. Every consumer (fit, snapshot) then reads plain MiB.
+        Percentage-role resources cannot be quota'd (usage is accounted in
+        MiB, a percent limit has no consistent denominator) and are ignored
+        with a warning.
+        """
         out: dict[str, int] = {}
         for name, v in hard.items():
             if not self.is_managed_quota(name):
                 continue
             res = name[len(QUOTA_PREFIX):]
-            n = _parse_quantity(v, self._managed[res][1])
-            if n is None:
+            word, role = self._managed[res]
+            if role == "memPercentage":
+                log.warning(
+                    "quota %s targets a percentage resource; not enforceable "
+                    "(quota the mem resource instead)", name,
+                )
+                continue
+            parsed = _parse_quantity(v, role)
+            if parsed is None:
                 log.warning("unparseable quota quantity %s=%r; ignoring entry", name, v)
                 continue
+            n, suffixed = parsed
+            if role == "mem" and not suffixed:
+                n *= self._memory_factor.get(word, 1)
             out[res] = n
         return out
 
@@ -176,9 +199,8 @@ class QuotaManager:
     ) -> bool:
         """Would this additional usage stay within the namespace quota?
         (reference FitQuota; called from vendor Fit paths and the admission
-        pre-check). The vendor's memoryFactor — quota counted in chunks of
-        N MiB (reference quota.go:75-76) — is looked up here so every caller
-        agrees on the effective limit."""
+        pre-check). Limits are already denominated like usage — memoryFactor
+        chunking resolves at parse time — so every caller agrees."""
         with self._lock:
             entry = self._ns.get(namespace)
             if not entry:
@@ -190,10 +212,8 @@ class QuotaManager:
                 if word != vendor or res not in limits:
                     continue
                 limit = limits[res]
-                if role in ("mem", "memPercentage"):
+                if role == "mem":
                     add = memreq
-                    if role == "mem":  # percentage limits are not chunked
-                        limit *= self._memory_factor.get(word, 1)
                 elif role == "cores":
                     add = coresreq
                 elif role == "count":
@@ -245,14 +265,6 @@ class QuotaManager:
             for res, n in self._usage_of(devices).items():
                 entry.used[res] = max(0, entry.used.get(res, 0) - n)
 
-    def _effective_limit(self, res: str, lim: int) -> int:
-        """Chunk-counted mem limits export in MiB so limit/used stay
-        comparable (memoryFactor)."""
-        word_role = self._managed.get(res)
-        if word_role and word_role[1] == "mem":
-            return lim * self._memory_factor.get(word_role[0], 1)
-        return lim
-
     def snapshot(self) -> dict[str, dict[str, dict[str, int]]]:
         """{namespace: {resource: {'limit': x, 'used': y}}} for metrics;
         limits are denominated like usage (MiB for mem roles)."""
@@ -262,8 +274,7 @@ class QuotaManager:
                 limits = entry.effective_limits()
                 if limits:
                     out[ns] = {
-                        res: {"limit": self._effective_limit(res, lim),
-                              "used": entry.used.get(res, 0)}
+                        res: {"limit": lim, "used": entry.used.get(res, 0)}
                         for res, lim in limits.items()
                     }
             return out

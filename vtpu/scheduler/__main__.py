@@ -22,6 +22,7 @@ from vtpu.scheduler.config import (
 from vtpu.scheduler.routes import SchedulerServer
 from vtpu.scheduler.scheduler import Scheduler
 from vtpu.scheduler.webhook import WebHook
+from vtpu.util import types as t
 from vtpu.util.k8sclient import FakeKubeClient, RealKubeClient, init_global_client
 
 
@@ -42,15 +43,19 @@ def make_fake_cluster(n_nodes: int, chips_per_node: int = 8) -> FakeKubeClient:
             )
             for c in range(chips_per_node)
         ]
+        annos = {REGISTER_ANNO: codec.encode_node_devices(devices)}
+        if i // 2 < n_nodes // 2:  # only complete 2-host pairs form a slice
+            # fabricate 2-host slices (tpu-node-0+1 = slice fab-0, ...) so the
+            # multi-host gang path is demoable without hardware:
+            #   vtpu.io/slice-workers: "2" + a pod-group marker
+            from vtpu.device.types import SliceInfo
+
+            annos[t.NODE_SLICE_ANNO] = SliceInfo(
+                slice_id=f"fab-{i // 2}", worker_id=i % 2, num_workers=2,
+                accel_type="v5e-16", topology="4x4",
+            ).encode()
         client.put_node(
-            {
-                "metadata": {
-                    "name": f"tpu-node-{i}",
-                    "annotations": {
-                        REGISTER_ANNO: codec.encode_node_devices(devices)
-                    },
-                }
-            }
+            {"metadata": {"name": f"tpu-node-{i}", "annotations": annos}}
         )
     return client
 
