@@ -162,6 +162,14 @@ def test_full_pipeline_schedule_allocate_enforce(stack, libvtpu_build, tmp_path)
     snap = RegionReader(str(region)).read()
     assert snap.devices[0].hbm_limit_bytes == 4096 * 1024 * 1024
 
+    # the dashboard inspection route exposes the allocation (reference
+    # InspectAllNodesUsage feeding the WebUI ecosystem)
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}/inspect", timeout=10) as r:
+        usage = json.loads(r.read())
+    tpu_devs = usage[NODE]["TPU"]
+    assert sum(d["usedmem"] for d in tpu_devs) == 4096
+    assert any("default/workload" in d["pods"] for d in tpu_devs)
+
 
 def test_multihost_gang_over_real_transports(monkeypatch, tmp_path):
     """Two slice-workers pods gang onto both hosts of one slice via the HTTP

@@ -131,3 +131,44 @@ def test_informer_replay_vs_filter_race(cluster):
     for vendors in usage.values():
         for dev in vendors["TPU"]:
             assert dev.used == 0, f"leaked usage on {dev.id}: {dev.used}"
+
+
+def test_concurrent_gang_filters_one_worker_per_host():
+    """Multi-host gang invariant under concurrency: N workers filed from N
+    threads must land on N DISTINCT hosts of one slice even when every
+    Filter runs simultaneously (the filter lock serializes snapshot->record,
+    and gang state is derived inside it)."""
+    from vtpu.device.types import SliceInfo
+
+    client = fake_cluster({f"h{i}": v5e_devices(4, prefix=f"h{i}") for i in range(4)})
+    for i in range(4):
+        client.patch_node_annotations(
+            f"h{i}", {t.NODE_SLICE_ANNO: SliceInfo("fab", i, 4, "v5p-32", "").encode()}
+        )
+    sched = Scheduler(client)
+    register_tpu_backend(quota=sched.quota_manager)
+    sched.start(register_interval=3600)
+    try:
+        gang = {t.SLICE_WORKERS_ANNO: "4",
+                "pod-group.scheduling.sigs.k8s.io/name": "racegang"}
+        results: dict[str, list] = {}
+        errors: list = []
+
+        def file_worker(i: int) -> None:
+            try:
+                pod = client.put_pod(tpu_pod(f"w{i}", tpu=4, annotations=gang))
+                r = sched.filter({"Pod": pod, "NodeNames": [f"h{j}" for j in range(4)]})
+                results[f"w{i}"] = r["NodeNames"]
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+
+        workers = [threading.Thread(target=file_worker, args=(i,)) for i in range(4)]
+        for th in workers:
+            th.start()
+        for th in workers:
+            th.join()
+        assert not errors, errors
+        placed = [r[0] for r in results.values() if r]
+        assert len(placed) == 4 and len(set(placed)) == 4, results
+    finally:
+        sched.stop()

@@ -82,6 +82,26 @@ def make_handler(scheduler: Scheduler, webhook: WebHook, profiling: bool = False
                 from vtpu.version import build_info
 
                 self._reply(200, build_info())
+            elif self.path == "/inspect":
+                # cluster usage view for dashboards/WebUI tooling (reference
+                # InspectAllNodesUsage feeding the WebUI ecosystem)
+                usage = {
+                    node: {
+                        vendor: [
+                            {
+                                "id": d.id, "type": d.type, "used": d.used,
+                                "count": d.count, "usedmem": d.usedmem,
+                                "totalmem": d.totalmem, "usedcores": d.usedcores,
+                                "totalcore": d.totalcore, "health": d.health,
+                                "pods": list(d.pods_on_device),
+                            }
+                            for d in devices
+                        ]
+                        for vendor, devices in vendors.items()
+                    }
+                    for node, vendors in scheduler.inspect_all_nodes_usage().items()
+                }
+                self._reply(200, usage)
             elif profiling and self.path == "/debug/threads":
                 # Python analog of pprof's goroutine dump (reference opt-in
                 # --profiling, cmd/scheduler/main.go:93-110)
