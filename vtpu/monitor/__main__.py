@@ -86,9 +86,20 @@ def main() -> None:
     # cmd/vGPUmonitor/main.go:101-116). The lock lives under the hook path --
     # the hostPath volume shared with the plugin container.
     partition_dir = lock_dir_for(args.hook_path)
-    FeedbackLoop(lister, interval=args.feedback_interval).run_forever(
-        pause_check=lambda: lock_held(partition_dir)
-    )
+    loop = FeedbackLoop(lister, interval=args.feedback_interval)
+
+    import signal
+    import sys
+
+    def _terminate(signum, _frame):
+        # the handler runs on the main thread (the one inside run_forever),
+        # so SystemExit unwinds the loop directly — no cooperative stop needed
+        logging.info("signal %d: stopping feedback loop", signum)
+        sys.exit(0)
+
+    signal.signal(signal.SIGTERM, _terminate)
+    signal.signal(signal.SIGINT, _terminate)
+    loop.run_forever(pause_check=lambda: lock_held(partition_dir))
 
 
 if __name__ == "__main__":

@@ -11,6 +11,8 @@ from __future__ import annotations
 import argparse
 import logging
 import os
+import signal
+import sys
 import time
 
 from vtpu.plugin.api import grpc_api
@@ -127,31 +129,49 @@ def main() -> None:
             )
             raise SystemExit(1)
 
-    while True:
-        plugin = TpuDevicePlugin(rm, client, config)
-        server = PluginServer(plugin, socket_path)
-        server.start()
-        try:
-            server.register_with_kubelet(args.kubelet_socket)
-        except Exception:
-            logging.exception("kubelet registration failed; retrying in 5s")
-            server.stop()
-            count_crash()
-            time.sleep(5)
-            continue
-        # watch for kubelet restarts: socket inode change -> re-register
-        try:
-            start_stat = os.stat(args.kubelet_socket)
-            while True:
-                time.sleep(2)
-                cur = os.stat(args.kubelet_socket)
-                if (cur.st_ino, cur.st_dev) != (start_stat.st_ino, start_stat.st_dev):
-                    logging.info("kubelet restarted; re-serving")
-                    break
-        except FileNotFoundError:
-            logging.info("kubelet socket vanished; waiting for restart")
-            time.sleep(5)
-        finally:
+    # Graceful termination (reference nvinternal/watch signal watchers): a
+    # DaemonSet SIGTERM must deregister the node (handshake Deleted marker +
+    # label removal) so the scheduler withdraws the chips promptly instead of
+    # waiting out the 60 s staleness rule.
+    def _terminate(signum, _frame):
+        logging.info("signal %d: deregistering and shutting down", signum)
+        sys.exit(0)
+
+    signal.signal(signal.SIGTERM, _terminate)
+    signal.signal(signal.SIGINT, _terminate)
+
+    server = None
+    try:
+        while True:
+            plugin = TpuDevicePlugin(rm, client, config)
+            server = PluginServer(plugin, socket_path)
+            server.start()
+            try:
+                server.register_with_kubelet(args.kubelet_socket)
+            except Exception:
+                logging.exception("kubelet registration failed; retrying in 5s")
+                server.stop()
+                count_crash()
+                time.sleep(5)
+                continue
+            # watch for kubelet restarts: socket inode change -> re-register
+            try:
+                start_stat = os.stat(args.kubelet_socket)
+                while True:
+                    time.sleep(2)
+                    cur = os.stat(args.kubelet_socket)
+                    if (cur.st_ino, cur.st_dev) != (start_stat.st_ino, start_stat.st_dev):
+                        logging.info("kubelet restarted; re-serving")
+                        break
+            except FileNotFoundError:
+                logging.info("kubelet socket vanished; waiting for restart")
+                time.sleep(5)
+            finally:
+                server.stop()
+    finally:
+        health.stop()
+        registrar.stop()  # withdraws the handshake + node label
+        if server is not None:
             server.stop()
 
 

@@ -140,8 +140,22 @@ def main() -> None:
         tls_key=args.tls_key,
         profiling=args.profiling,
     )
+    import signal
+    import threading
+
+    def _terminate(signum, _frame):
+        # shutdown() joins serve_forever's loop — which runs in THIS (main)
+        # thread — so it must be called from another thread or we deadlock.
+        # server_close() (inside shutdown) then drains in-flight handlers
+        # (daemon_threads=False + block_on_close).
+        logging.info("signal %d: shutting down", signum)
+        threading.Thread(target=server.shutdown, daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _terminate)
+    signal.signal(signal.SIGINT, _terminate)
     logging.info("vtpu-scheduler serving on :%d", server.port)
     server.serve_forever()
+    scheduler.stop()
 
 
 if __name__ == "__main__":

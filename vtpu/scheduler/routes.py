@@ -161,6 +161,11 @@ class SchedulerServer:
         self.httpd = ThreadingHTTPServer(
             (host, port), make_handler(scheduler, webhook, profiling=profiling)
         )
+        # graceful shutdown must DRAIN in-flight Filter/Bind handlers: a bind
+        # killed between the allocating annotation and the Binding call
+        # strands the pod and the node lock until timeout recovery
+        self.httpd.daemon_threads = False
+        self.httpd.block_on_close = True
         self._stop_watch = threading.Event()
         if tls_cert and tls_key:
             ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)

@@ -369,14 +369,20 @@ class RealKubeClient(KubeClient):
     # ------------------------------------------------------------- plumbing
 
     def _req(self, method: str, path: str, body=None, headers=None, params=None) -> dict:
-        r = self._session.request(
-            method,
-            self._base + path,
-            json=body,
-            headers=headers,
-            params=params,
-            timeout=self._timeout,
-        )
+        try:
+            r = self._session.request(
+                method,
+                self._base + path,
+                json=body,
+                headers=headers,
+                params=params,
+                timeout=self._timeout,
+            )
+        except self._requests.RequestException as e:
+            # transport-level failures surface as ApiError so every caller's
+            # existing except-ApiError recovery path covers them (an
+            # unreachable apiserver must degrade, not crash the agent)
+            raise ApiError(0, f"{method} {path}: {e}") from e
         if r.status_code == 404:
             raise NotFoundError(path)
         if r.status_code == 409:
