@@ -8,7 +8,7 @@ XLA lowers onto ICI, and a ring-attention sequence-parallel kernel built on
 `shard_map` + `ppermute`.
 """
 
-from vtpu.parallel.mesh import make_mesh, mesh_shape_for, make_axis_mesh, make_dp_ep_mesh
+from vtpu.parallel.mesh import make_mesh, mesh_shape_for, make_axis_mesh, make_dp_ep_mesh, make_multislice_mesh
 from vtpu.parallel.sharding import param_shardings, shard_params
 from vtpu.parallel.ring import ring_attention
 from vtpu.parallel.ulysses import ulysses_attention

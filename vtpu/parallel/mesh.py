@@ -56,6 +56,53 @@ def make_sp_mesh(n_devices: int | None = None, devices: list | None = None) -> M
     return make_axis_mesh("sp", n_devices, devices)
 
 
+def make_multislice_mesh(
+    n_slices: int,
+    per_slice: int | None = None,
+    tp: int | None = None,
+    devices: list | None = None,
+) -> Mesh:
+    """3D ('slice', 'dp', 'tp') mesh for multislice jobs.
+
+    The 'slice' axis is the DCN boundary (inter-slice traffic crosses the
+    data-center network, wired by the middleware's MEGASCALE_* env injection);
+    'dp'/'tp' stay inside each slice's ICI. Shard batch over ('slice', 'dp')
+    and params over 'tp' and XLA emits a hierarchical gradient reduction:
+    reduce-scatter/all-gather inside the slice over ICI, one slow all-reduce
+    hop over DCN per step — the scaling-book multislice recipe, with the axis
+    order making 'tp' the innermost (fastest) links.
+    """
+    devs = devices if devices is not None else jax.devices()
+    if per_slice is None:
+        if len(devs) % n_slices:
+            raise ValueError(f"{len(devs)} devices do not split into {n_slices} slices")
+        per_slice = len(devs) // n_slices
+    total = n_slices * per_slice
+    if total > len(devs):
+        raise ValueError(f"requested {total} devices, have {len(devs)}")
+    # On real multislice hardware device enumeration is NOT guaranteed
+    # slice-contiguous; group by the runtime's slice_index so the 'slice'
+    # axis actually sits on the DCN boundary (a naive reshape would route
+    # per-layer tp collectives across slices). Virtual/CPU devices carry no
+    # slice_index and fall back to contiguous grouping.
+    slice_ids = {getattr(d, "slice_index", None) for d in devs[:total]}
+    if None not in slice_ids and len(slice_ids) == n_slices:
+        by_slice: dict = {}
+        for d in devs[:total]:
+            by_slice.setdefault(d.slice_index, []).append(d)
+        groups = [by_slice[s] for s in sorted(by_slice)]
+        if any(len(g) != per_slice for g in groups):
+            raise ValueError(
+                f"slices are uneven: {[len(g) for g in groups]} != {per_slice} each"
+            )
+        ordered = [d for g in groups for d in g]
+    else:
+        ordered = list(devs[:total])
+    dp, tpn = mesh_shape_for(per_slice, tp)
+    grid = np.asarray(ordered).reshape(n_slices, dp, tpn)
+    return Mesh(grid, ("slice", "dp", "tp"))
+
+
 def make_dp_ep_mesh(
     n_devices: int | None = None, ep: int | None = None, devices: list | None = None
 ) -> Mesh:

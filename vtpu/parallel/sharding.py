@@ -38,7 +38,11 @@ def param_shardings(mesh: Mesh) -> dict[str, Any]:
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:
-    """Tokens [B, S]: batch over 'dp', sequence replicated."""
+    """Tokens [B, S]: batch over 'dp' (and 'slice' on a multislice mesh so
+    the gradient reduction is hierarchical: ICI within the slice, one DCN
+    hop across slices), sequence replicated."""
+    if "slice" in mesh.axis_names:
+        return NamedSharding(mesh, P(("slice", "dp"), None))
     return NamedSharding(mesh, P("dp", None))
 
 

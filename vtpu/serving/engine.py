@@ -173,11 +173,13 @@ class ServingEngine:
         else:
             from vtpu.parallel.sharding import kv_cache_shardings, shard_params
 
-            if mesh.shape.get("dp", 1) != 1:
-                # decode ticks would replicate across dp groups with zero
-                # throughput gain; slots are the batch axis and stay local
+            extra = {a: n for a, n in mesh.shape.items() if a != "tp" and n != 1}
+            if extra:
+                # decode ticks would replicate across every non-tp axis
+                # (dp, slice, ...) with zero throughput gain; slots are the
+                # batch axis and stay local
                 raise ValueError(
-                    f"serving mesh must be tp-only (dp=1), got {dict(mesh.shape)}"
+                    f"serving mesh must be tp-only, got extra axes {extra}"
                 )
             self.params = shard_params(params, mesh)
             # allocate the cache directly sharded: a head-sharded cache that
