@@ -11,6 +11,7 @@ XLA lowers onto ICI, and a ring-attention sequence-parallel kernel built on
 from vtpu.parallel.mesh import make_mesh, mesh_shape_for, make_axis_mesh, make_dp_ep_mesh, make_multislice_mesh
 from vtpu.parallel.sharding import param_shardings, shard_params
 from vtpu.parallel.ring import ring_attention
+from vtpu.parallel.longctx import place_sp_tokens, sp_loss, sp_prefill
 from vtpu.parallel.ulysses import ulysses_attention
 from vtpu.parallel.expert import ep_moe_forward, make_ep_ffn, moe_param_shardings
 from vtpu.parallel.pipeline import pipeline_apply, pp_transformer_forward, pp_loss, microbatch
