@@ -48,7 +48,7 @@ def bench_scale():
             vocab=8192, d_model=1024, n_heads=8, n_layers=12, d_ff=4096,
             max_seq=1280, head_dim=128, dtype=jnp.bfloat16, use_pallas=True,
         )
-        return cfg, 1024, 40
+        return cfg, 1024, 60
     cfg = ModelConfig(
         vocab=512, d_model=128, n_heads=4, n_layers=2, d_ff=256,
         max_seq=160, head_dim=32, dtype=jnp.float32, use_pallas=False,
@@ -91,7 +91,7 @@ def main() -> None:
     log(f"backend={jax.default_backend()} devices={jax.devices()}")
     request, runs = build_request()
 
-    for _ in range(5):
+    for _ in range(10):  # warmup: compile + steady-state clocks
         request()
 
     exclusive = [request() for _ in range(runs)]
