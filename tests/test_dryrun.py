@@ -1,0 +1,54 @@
+"""Hermeticity lock for the driver's multi-chip dryrun (VERDICT r1 weak #1).
+
+MULTICHIP_r01 failed because eager ops inside ``dryrun_multichip`` dispatched
+to the ambient default platform — a wedged TPU client in the driver env whose
+first executed op raised. The fix pins ``jax_default_device`` to the resolved
+dryrun mesh for the whole body. These tests lock the property in: the second
+test breaks eager dispatch for any op that would consult the *unpinned*
+ambient platform (exactly the driver failure mode) and asserts the dryrun
+still completes.
+"""
+
+import pathlib
+import sys
+
+import jax
+import pytest
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+import __graft_entry__ as graft  # noqa: E402
+
+
+def test_dryrun_multichip_cpu_mesh():
+    graft.dryrun_multichip(8)
+    assert jax.config.jax_default_device is None  # restored after the run
+
+
+def test_dryrun_hermetic_to_wedged_default_platform(monkeypatch):
+    """Simulate the MULTICHIP_r01 driver env: any eager primitive that runs
+    while jax_default_device is unpinned explodes (as the wedged TPU client
+    did). The dryrun must pin every eager op to its own mesh and pass."""
+    from jax._src import core as jcore
+
+    real = jcore.EvalTrace.process_primitive
+
+    def wedged(self, primitive, *rest, **kw):
+        if jax.config.jax_default_device is None:
+            raise RuntimeError(
+                f"simulated wedged default platform: eager {primitive} "
+                "dispatched without a pinned default device"
+            )
+        return real(self, primitive, *rest, **kw)
+
+    monkeypatch.setattr(jcore.EvalTrace, "process_primitive", wedged)
+    graft.dryrun_multichip(8)
+    assert jax.config.jax_default_device is None
+
+
+def test_dryrun_device_resolution_falls_back_to_cpu():
+    if len(jax.devices()) >= 8 and jax.devices()[0].platform != "cpu":
+        pytest.skip("ambient backend already wide; fallback branch not reachable")
+    devs = graft._devices_for_dryrun(8)
+    assert len(devs) == 8
+    assert all(d.platform == "cpu" for d in devs)
