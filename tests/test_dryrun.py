@@ -21,8 +21,9 @@ import __graft_entry__ as graft  # noqa: E402
 
 
 def test_dryrun_multichip_cpu_mesh():
+    prev = jax.config.jax_default_device
     graft.dryrun_multichip(8)
-    assert jax.config.jax_default_device is None  # restored after the run
+    assert jax.config.jax_default_device is prev  # restored after the run
 
 
 def test_dryrun_hermetic_to_wedged_default_platform(monkeypatch):
@@ -41,14 +42,25 @@ def test_dryrun_hermetic_to_wedged_default_platform(monkeypatch):
             )
         return real(self, primitive, *rest, **kw)
 
+    prev = jax.config.jax_default_device
     monkeypatch.setattr(jcore.EvalTrace, "process_primitive", wedged)
     graft.dryrun_multichip(8)
-    assert jax.config.jax_default_device is None
+    assert jax.config.jax_default_device is prev
 
 
-def test_dryrun_device_resolution_falls_back_to_cpu():
-    if len(jax.devices()) >= 8 and jax.devices()[0].platform != "cpu":
-        pytest.skip("ambient backend already wide; fallback branch not reachable")
+def test_dryrun_device_resolution_falls_back_to_cpu(monkeypatch):
+    """Drive the narrow-ambient-backend fallback (branch 2): jax.devices()
+    reports a single non-CPU-mesh device, so resolution must go through
+    jax.devices('cpu') — the driver-env shape, where the default platform is
+    the one-chip TPU and XLA_FLAGS made the CPU client 8-wide."""
+    real_devices = jax.devices
+
+    def narrow(platform=None):
+        if platform is None:
+            return real_devices()[:1]
+        return real_devices(platform)
+
+    monkeypatch.setattr(jax, "devices", narrow)
     devs = graft._devices_for_dryrun(8)
     assert len(devs) == 8
     assert all(d.platform == "cpu" for d in devs)
