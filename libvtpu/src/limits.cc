@@ -59,6 +59,10 @@ Limits parse_limits_from_env() {
   if (const char* v = std::getenv("VTPU_SHARED_REGION")) {
     limits.region_path = v;
   }
+  if (const char* v = std::getenv("VTPU_ATTACH_WAIT_MS")) {
+    long long ms = std::atoll(v);
+    limits.attach_wait_ms = ms > 0 ? (uint64_t)ms : 0;
+  }
   return limits;
 }
 

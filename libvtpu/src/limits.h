@@ -18,6 +18,12 @@ struct Limits {
   bool disable_control = false;
   int task_priority = 0;
   std::string region_path;  // VTPU_SHARED_REGION
+  // Attach queueing (multi-process tenancy fallback, docs/multitenancy.md):
+  // when >0, a busy-class PJRT_Client_Create failure (UNAVAILABLE/ABORTED/
+  // RESOURCE_EXHAUSTED — an exclusive-attach runtime with another tenant
+  // holding the chip) retries with backoff up to this many ms instead of
+  // failing the tenant. 0 = surface the failure immediately.
+  uint64_t attach_wait_ms = 0;
 
   bool mem_enforced() const { return !disable_control; }
   bool core_enforced() const {

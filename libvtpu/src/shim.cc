@@ -27,6 +27,8 @@
 // is bounds-checked against the runtime struct_size before being touched.
 
 #include <dlfcn.h>
+#include <time.h>
+#include <unistd.h>
 
 #include <cstdint>
 #include <cstring>
@@ -305,30 +307,75 @@ PJRT_Error* wrapped_error_getcode(PJRT_Error_GetCode_Args* args) {
   return S().real->PJRT_Error_GetCode(args);
 }
 
+uint64_t mono_ms() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (uint64_t)ts.tv_sec * 1000ull + (uint64_t)ts.tv_nsec / 1000000ull;
+}
+
+void destroy_real_error(PJRT_Error* err) {
+  PJRT_Error_Destroy_Args d;
+  std::memset(&d, 0, sizeof(d));
+  d.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+  d.error = err;
+  S().real->PJRT_Error_Destroy(&d);
+}
+
+PJRT_Error_Code real_error_code(PJRT_Error* err) {
+  PJRT_Error_GetCode_Args code_args;
+  std::memset(&code_args, 0, sizeof(code_args));
+  code_args.struct_size = PJRT_Error_GetCode_Args_STRUCT_SIZE;
+  code_args.error = err;
+  PJRT_Error* code_err = S().real->PJRT_Error_GetCode(&code_args);
+  if (code_err == nullptr) return code_args.code;
+  destroy_real_error(code_err);
+  return PJRT_Error_Code_UNKNOWN;
+}
+
 PJRT_Error* wrapped_client_create(PJRT_Client_Create_Args* args) {
   auto& s = S();
-  PJRT_Error* err = s.real->PJRT_Client_Create(args);
-  if (err == nullptr && args->client != nullptr) {
-    refresh_device_map(args->client);
-  } else if (err != nullptr) {
+  // Attach queueing (docs/multitenancy.md): on an exclusive-attach runtime a
+  // second tenant's create fails busy-class while another tenant holds the
+  // chip. With VTPU_ATTACH_WAIT_MS > 0 the tenant queues here with backoff —
+  // time-multiplexed tenancy at client granularity — instead of failing (and
+  // crash-looping its pod). On concurrent-attach runtimes the first create
+  // succeeds and this loop runs exactly once.
+  const uint64_t wait_ms = s.limits.attach_wait_ms;
+  const uint64_t deadline = wait_ms ? mono_ms() + wait_ms : 0;
+  uint64_t backoff_ms = 50;
+  for (;;) {
+    PJRT_Error* err = s.real->PJRT_Client_Create(args);
+    if (err == nullptr) {
+      if (args->client != nullptr) refresh_device_map(args->client);
+      return nullptr;
+    }
+    PJRT_Error_Code code = real_error_code(err);
+    const bool busy = code == PJRT_Error_Code_UNAVAILABLE ||
+                      code == PJRT_Error_Code_ABORTED ||
+                      code == PJRT_Error_Code_RESOURCE_EXHAUSTED;
+    if (busy && wait_ms > 0) {
+      const uint64_t now = mono_ms();
+      if (now < deadline) {
+        destroy_real_error(err);
+        const uint64_t remaining = deadline - now;
+        const uint64_t sleep_ms = backoff_ms < remaining ? backoff_ms : remaining;
+        VTPU_INFO("chip busy on attach (code %d); queueing, retry in %lu ms",
+                  (int)code, (unsigned long)sleep_ms);
+        usleep((useconds_t)(sleep_ms * 1000));
+        backoff_ms = backoff_ms * 2 < 1000 ? backoff_ms * 2 : 1000;
+        continue;
+      }
+      // Deadline exhausted on a merely-HELD chip: surface the error to the
+      // tenant, but this is contention, not infrastructure — a fatal-health
+      // event here would bench a healthy shared chip for every tenant.
+      VTPU_WARN("attach wait deadline (%lu ms) exceeded; chip still held "
+                "(code %d)", (unsigned long)wait_ms, (int)code);
+      return err;
+    }
     // Only infrastructure-class failures are health events; app-caused ones
     // (bad options, double init -> INVALID_ARGUMENT/FAILED_PRECONDITION/...)
     // must not bench a shared chip for every tenant (reference rm/health.go
     // skipping application-caused XIDs 13/31/43/45/68).
-    PJRT_Error_GetCode_Args code_args;
-    std::memset(&code_args, 0, sizeof(code_args));
-    code_args.struct_size = PJRT_Error_GetCode_Args_STRUCT_SIZE;
-    code_args.error = err;
-    PJRT_Error* code_err = s.real->PJRT_Error_GetCode(&code_args);
-    PJRT_Error_Code code =
-        code_err == nullptr ? code_args.code : PJRT_Error_Code_UNKNOWN;
-    if (code_err != nullptr) {
-      PJRT_Error_Destroy_Args destroy;
-      std::memset(&destroy, 0, sizeof(destroy));
-      destroy.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
-      destroy.error = code_err;
-      s.real->PJRT_Error_Destroy(&destroy);
-    }
     switch (code) {
       case PJRT_Error_Code_UNKNOWN:
       case PJRT_Error_Code_DEADLINE_EXCEEDED:
@@ -342,8 +389,8 @@ PJRT_Error* wrapped_client_create(PJRT_Client_Create_Args* args) {
         VTPU_WARN("PJRT_Client_Create failed with app-level code %d", (int)code);
         break;
     }
+    return err;
   }
-  return err;
 }
 
 // Reserve est bytes on dev_idx ahead of a real allocation (under the lock,

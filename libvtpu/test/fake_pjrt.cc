@@ -5,9 +5,13 @@
 // Behavior knobs (env):
 //   FAKE_PJRT_EXEC_NS      simulated device-busy ns per execute (default 2ms)
 //   FAKE_PJRT_NUM_OUTPUTS  outputs per execute (default 1, 1KiB each)
+//   FAKE_PJRT_BUSY_FILE    while this path exists, ClientCreate fails
+//                          UNAVAILABLE — simulates an exclusive-attach
+//                          runtime whose chip another tenant holds
 
 #include <string.h>
 #include <time.h>
+#include <unistd.h>
 
 #include <atomic>
 #include <cstdint>
@@ -82,6 +86,12 @@ PJRT_Error* ErrorGetCode(PJRT_Error_GetCode_Args* args) {
 // ------------------------------------------------------------- client fns
 
 PJRT_Error* ClientCreate(PJRT_Client_Create_Args* args) {
+  if (const char* busy = std::getenv("FAKE_PJRT_BUSY_FILE")) {
+    if (access(busy, F_OK) == 0) {
+      return err(PJRT_Error_Code_UNAVAILABLE,
+                 "fake: chip held by another tenant (exclusive attach)");
+    }
+  }
   args->client = reinterpret_cast<PJRT_Client*>(new int(42));
   return nullptr;
 }

@@ -110,3 +110,55 @@ def test_monitor_block_gates_running_workload(libvtpu_build, tmp_path):
         if proc.poll() is None:
             proc.kill()
             proc.communicate()
+
+
+def test_attach_queueing_on_exclusive_runtime(libvtpu_build, tmp_path):
+    """Multi-process tenancy fallback (docs/multitenancy.md): on a runtime
+    that refuses a second concurrent attach, a busy-class Client_Create
+    failure queues with backoff under VTPU_ATTACH_WAIT_MS until the holder
+    releases, instead of failing the tenant's pod."""
+    import os
+    import subprocess as sp
+    import time
+
+    holder = tmp_path / "chip.held"
+    holder.touch()
+    env = dict(os.environ)
+    env.update({
+        "VTPU_REAL_LIBTPU": str(libvtpu_build / "fake_pjrt.so"),
+        "FAKE_PJRT_BUSY_FILE": str(holder),
+        "TPU_DEVICE_MEMORY_LIMIT_0": "64m",
+    })
+    smoke = [str(libvtpu_build / "pjrt_smoke"), str(libvtpu_build / "libvtpu.so"),
+             "1", "1", "1"]
+
+    # Without queueing: the busy failure surfaces immediately.
+    r = sp.run(smoke, env={**env, "VTPU_ATTACH_WAIT_MS": "0"},
+               capture_output=True, text=True)
+    assert r.returncode != 0
+    assert "another tenant" in r.stderr
+
+    # Queueing armed but the holder never releases: the deadline (even one
+    # shorter than the first backoff step) must produce at least one retry,
+    # then surface the busy error WITHOUT a fatal-health event — contention
+    # on a shared chip is not infrastructure failure.
+    health = tmp_path / "health.err"
+    r = sp.run(smoke, env={**env, "VTPU_ATTACH_WAIT_MS": "30",
+                           "VTPU_HEALTH_FILE": str(health)},
+               capture_output=True, text=True)
+    assert r.returncode != 0
+    assert not health.exists(), health.read_text()
+
+    # With queueing: the tenant waits out the holder and then attaches.
+    proc = sp.Popen(smoke, env={**env, "VTPU_ATTACH_WAIT_MS": "20000"},
+                    stdout=sp.PIPE, stderr=sp.PIPE, text=True)
+    try:
+        time.sleep(1.0)
+        assert proc.poll() is None, "tenant gave up while chip was held"
+        holder.unlink()  # holder releases the chip
+        _out, err = proc.communicate(timeout=30)
+        assert proc.returncode == 0, err
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()

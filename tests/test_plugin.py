@@ -138,6 +138,9 @@ def test_allocate_full_slice(served_plugin):
     assert env[envs.ENV_CORE_LIMIT] == "25"
     assert env[envs.ENV_TASK_PRIORITY] == "1"
     assert env[envs.ENV_VISIBLE_CHIPS] != ""
+    # fractional share on a non-exclusive chip: attach queueing armed
+    # (docs/multitenancy.md exclusive-attach fallback)
+    assert env[envs.ENV_ATTACH_WAIT] == "120000"
     mounts = {m.container_path: m.host_path for m in ctr.mounts}
     assert mounts["/etc/ld.so.preload"].endswith("ld.so.preload")
     assert "/usr/local/vtpu/libvtpu.so" in mounts

@@ -26,6 +26,10 @@ ENV_LOG_LEVEL = "LIBVTPU_LOG_LEVEL"
 ENV_VISIBLE_CHIPS = "TPU_VISIBLE_CHIPS"
 # Disable all enforcement (escape hatch; reference CUDA_DISABLE_CONTROL).
 ENV_DISABLE_CONTROL = "VTPU_DISABLE_CONTROL"
+# Shared-mode attach queueing deadline in ms: libvtpu retries a busy-class
+# client create (exclusive-attach runtime, chip held by another tenant) with
+# backoff up to this long (docs/multitenancy.md). Unset/0 = fail fast.
+ENV_ATTACH_WAIT = "VTPU_ATTACH_WAIT_MS"
 # Fatal-health marker file: libvtpu appends a line on fatal PJRT errors; the
 # HealthWatcher promotes it to chip Unhealthy (the XID-event analog).
 ENV_HEALTH_FILE = "VTPU_HEALTH_FILE"

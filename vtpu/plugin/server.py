@@ -60,6 +60,10 @@ class PluginConfig:
     # --cdi-enabled + nvinternal/cdi); the spec file is written at startup.
     cdi_enabled: bool = False
     cdi_dir: str = ""
+    # Shared-mode attach queueing deadline (docs/multitenancy.md): on an
+    # exclusive-attach runtime the 2nd..Nth tenant's client create queues in
+    # libvtpu up to this long instead of crash-looping the pod. 0 disables.
+    attach_wait_ms: int = 120_000
     # extra passthrough envs (reference vgpucfg.go node overrides)
     extra_envs: dict[str, str] = field(default_factory=dict)
     # multi-host slice membership of this node (rm.discover_slice()); when a
@@ -256,10 +260,13 @@ class TpuDevicePlugin:
         core_limit = 0
         device_specs = []
         cdi_devices = []
+        all_exclusive = True
         for i, dev in enumerate(devices):
             env[envs.ENV_DEVICE_MEMORY_LIMIT.format(index=i)] = f"{dev.usedmem}m"
             core_limit = max(core_limit, dev.usedcores)
             chip = self.rm.chip_by_uuid(dev.uuid)
+            if chip is None or (chip.mode or "") != "exclusive":
+                all_exclusive = False
             if chip is not None:
                 visible.append(str(chip.index))
                 if cfg.cdi_enabled:
@@ -287,6 +294,10 @@ class TpuDevicePlugin:
         qos_core_policy = t.QOS_CORE_POLICY.get(qos, "")
         env[envs.ENV_CORE_POLICY] = qos_core_policy or cfg.core_policy
         env[envs.ENV_LOG_LEVEL] = cfg.log_level
+        if cfg.attach_wait_ms > 0 and not all_exclusive:
+            # Shared chips: queue behind an exclusive-attach runtime's holder
+            # instead of crash-looping the pod (docs/multitenancy.md).
+            env[envs.ENV_ATTACH_WAIT] = str(cfg.attach_wait_ms)
         if cfg.oversubscribe:
             env[envs.ENV_OVERSUBSCRIBE] = "true"
         prio = pod_annotations(pod).get(t.TASK_PRIORITY_ANNO, "")
