@@ -604,6 +604,18 @@ PJRT_Error* wrapped_buffer_destroy(PJRT_Buffer_Destroy_Args* args) {
   return s.real->PJRT_Buffer_Destroy(args);
 }
 
+PJRT_Error* wrapped_loaded_executable_destroy(
+    PJRT_LoadedExecutable_Destroy_Args* args) {
+  // Drop the cached output count BEFORE the real destroy: the allocator can
+  // reuse this address for a new executable with a different output count,
+  // and a stale hit would walk past output_lists into garbage pointers.
+  {
+    std::lock_guard<std::mutex> lock(g_numout_mu);
+    g_numout_cache.erase(args->executable);
+  }
+  return S().real->PJRT_LoadedExecutable_Destroy(args);
+}
+
 struct ExecDoneCtx {
   size_t dev_idx;
   uint64_t submit_ns;
@@ -755,6 +767,8 @@ const PJRT_Api* wrap_api(const PJRT_Api* real) {
   }
   replace_field(&s.wrapped.PJRT_Buffer_Destroy, real, wrapped_buffer_destroy);
   replace_field(&s.wrapped.PJRT_LoadedExecutable_Execute, real, wrapped_execute);
+  replace_field(&s.wrapped.PJRT_LoadedExecutable_Destroy, real,
+                wrapped_loaded_executable_destroy);
   VTPU_INFO("wrapped PJRT api (struct_size=%zu, version %d.%d)",
             real->struct_size, real->pjrt_api_version.major_version,
             real->pjrt_api_version.minor_version);
