@@ -134,6 +134,8 @@ def tenant_main(a: argparse.Namespace) -> None:
     # Block protocol: "RUN <n> <interval_ms> <stagger_ms>" -> n requests
     # (open-loop arrival clock when interval_ms > 0) -> "BLOCK {json}";
     # "BYE" -> drain and exit.
+    import threading
+
     for line in sys.stdin:
         parts = line.split()
         if not parts or parts[0] == "BYE":
@@ -143,15 +145,30 @@ def tenant_main(a: argparse.Namespace) -> None:
         ttfts: list[float] = []
         totals: list[float] = []
         if interval_ms > 0:
+            # TRUE open-loop: arrivals fire on the clock regardless of
+            # whether earlier requests finished (submit is async; a worker
+            # thread per in-flight request collects its TTFT), so queueing
+            # delay under contention is sampled instead of backed off from.
+            lock = threading.Lock()
+            workers = []
+
+            def worker():
+                ttft, total = one_request()
+                with lock:
+                    ttfts.append(ttft)
+                    totals.append(total)
+
             start = time.perf_counter() + stagger_ms / 1000.0
             for i in range(n):
                 t_next = start + i * interval_ms / 1000.0
                 now = time.perf_counter()
                 if t_next > now:
                     time.sleep(t_next - now)
-                ttft, total = one_request()
-                ttfts.append(ttft)
-                totals.append(total)
+                th = threading.Thread(target=worker)
+                th.start()
+                workers.append(th)
+            for th in workers:
+                th.join()
         else:
             for _ in range(n):
                 ttft, total = one_request()
@@ -178,12 +195,14 @@ def wrap_available() -> bool:
 
 
 class Tenant:
-    def __init__(self, rank: int, wrap: bool):
+    def __init__(self, rank: int, wrap: bool, tag: str):
         env = dict(os.environ)
         (ROOT / "build").mkdir(exist_ok=True)
         # stderr to a file, not a pipe: a chatty runtime would fill a 64KB
-        # pipe nobody drains mid-run and deadlock the whole benchmark.
-        self.errpath = ROOT / "build" / f"bench_{'stack' if wrap else 'native'}{rank}.err"
+        # pipe nobody drains mid-run and deadlock the whole benchmark. The
+        # tag keeps names unique even when wrap is unavailable and every
+        # tenant runs unwrapped.
+        self.errpath = ROOT / "build" / f"bench_{tag}{rank}.err"
         self.errfile = open(self.errpath, "w")
         if wrap:
             env.pop("PALLAS_AXON_POOL_IPS", None)  # suppress sitecustomize boot
@@ -253,8 +272,8 @@ def main() -> None:
     rounds, block = (3, 8) if wrap else (2, 3)
     shared_block = 6 if wrap else 2
 
-    native = Tenant(rank=0, wrap=False)
-    stacks = [Tenant(rank=r, wrap=wrap) for r in range(TENANTS)]
+    native = Tenant(rank=0, wrap=False, tag="native")
+    stacks = [Tenant(rank=r, wrap=wrap, tag="stack") for r in range(TENANTS)]
     tenants = [native, *stacks]
     try:
         for t in tenants:  # compile + warm everywhere before any window

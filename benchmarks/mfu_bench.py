@@ -1,0 +1,157 @@
+"""MFU + attention-kernel benchmark for the flagship prefill path.
+
+VERDICT r1 weak #4: the round-1 TTFT numbers implied ~21% MFU and no
+in-tree measurement existed. This harness measures, on the real chip:
+
+  1. prefill MFU: exact matmul FLOPs of the flagship forward (projections,
+     attention score/out, MLP, LM head) / wall time / chip peak. K prefills
+     are chained inside ONE executable (lax.scan) so the tunneled platform's
+     per-call enqueue+D2H latency is amortized out of the kernel timing.
+  2. flash_attention (Pallas) vs causal_attention (XLA) at serving shapes.
+
+Writes MFU.json at the repo root and prints a summary; run with
+JAX_PLATFORMS=cpu for a tiny smoke (numbers meaningless off-TPU).
+
+Peak FLOP/s defaults to the v5e bf16 peak (197e12); override with
+VTPU_PEAK_FLOPS for other chips.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import pathlib
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+from vtpu.models import ModelConfig, init_params, prefill  # noqa: E402
+from vtpu.ops import causal_attention, flash_attention  # noqa: E402
+
+PEAK_FLOPS = float(__import__("os").environ.get("VTPU_PEAK_FLOPS", 197e12))
+
+
+def prefill_flops(cfg: ModelConfig, b: int, s: int) -> int:
+    """Matmul FLOPs of one forward pass (2*M*N*K per matmul, full causal
+    scores counted as computed)."""
+    d, qd, f = cfg.d_model, cfg.qkv_dim, cfg.d_ff
+    proj = 4 * 2 * b * s * d * qd  # wq, wk, wv, wo
+    attn = 2 * 2 * b * cfg.n_heads * s * s * cfg.head_dim  # scores + out
+    mlp = 3 * 2 * b * s * d * f  # gate, up, down
+    head = 2 * b * s * d * cfg.vocab
+    return cfg.n_layers * (proj + attn + mlp) + head
+
+
+def timed(fn, *args, iters: int = 5) -> float:
+    """Median wall seconds of fn(*args) synced via a tiny D2H fetch."""
+    fn(*args)  # compile + warm
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        np.asarray(fn(*args))
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times))
+
+
+def bench_prefill(cfg: ModelConfig, b: int, s: int, k_chain: int) -> dict:
+    params = jax.jit(lambda key: init_params(key, cfg))(jax.random.key(0))
+    jax.block_until_ready(params)
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(0, cfg.vocab, (b, s)), jnp.int32)
+
+    @jax.jit
+    def chained(params, tokens):
+        # xor-feed the summary back into the tokens so XLA cannot collapse
+        # the K iterations; the perturbation keeps token ids in range.
+        def body(carry, _):
+            logits, _cache = prefill(params, cfg, tokens ^ (carry & 1))
+            return jnp.sum(logits).astype(jnp.int32) & 1, None
+
+        out, _ = jax.lax.scan(body, jnp.int32(0), None, length=k_chain)
+        return out
+
+    sec = timed(chained, params, tokens)
+    flops = prefill_flops(cfg, b, s) * k_chain
+    mfu = flops / sec / PEAK_FLOPS
+    return {
+        "batch": b, "seq": s, "k_chain": k_chain,
+        "wall_ms": round(sec * 1e3, 2),
+        "ms_per_prefill": round(sec / k_chain * 1e3, 2),
+        "tflops_per_prefill": round(prefill_flops(cfg, b, s) / 1e12, 3),
+        "mfu_percent": round(100 * mfu, 2),
+        "tokens_per_sec": round(b * s * k_chain / sec),
+    }
+
+
+def bench_attention(b: int, s: int, h: int, dh: int, dtype, k_chain: int = 8) -> dict:
+    rng = np.random.RandomState(0)
+    q, k, v = (jnp.asarray(rng.standard_normal((b, s, h, dh)), dtype) for _ in range(3))
+
+    def chain(attn_fn):
+        @jax.jit
+        def run(q, k, v):
+            def body(carry, _):
+                o = attn_fn(q + carry, k, v)
+                return jnp.max(o).astype(q.dtype) * 0, None
+
+            out, _ = jax.lax.scan(body, q.dtype.type(0), None, length=k_chain)
+            return out
+
+        return run
+
+    flash_s = timed(chain(flash_attention), q, k, v) / k_chain
+    xla_s = timed(chain(causal_attention), q, k, v) / k_chain
+    flops = 2 * 2 * b * h * s * s * dh  # scores + out, full causal as computed
+    return {
+        "shape": [b, s, h, dh], "dtype": str(dtype.__name__ if hasattr(dtype, "__name__") else dtype),
+        "flash_ms": round(flash_s * 1e3, 3),
+        "xla_ms": round(xla_s * 1e3, 3),
+        "flash_tflops": round(flops / flash_s / 1e12, 1),
+        "xla_tflops": round(flops / xla_s / 1e12, 1),
+        "flash_speedup": round(xla_s / flash_s, 2),
+    }
+
+
+def main() -> None:
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        cfg = ModelConfig(
+            vocab=8192, d_model=1024, n_heads=8, n_layers=12, d_ff=4096,
+            max_seq=2048, head_dim=128, dtype=jnp.bfloat16, use_pallas=True,
+        )
+        shapes = [(16, 1024), (32, 1024), (16, 2048)]
+        attn_shapes = [(16, 1024, 8, 128), (16, 2048, 8, 128), (4, 2048, 8, 128)]
+        k_chain = 8
+        dtype = jnp.bfloat16
+    else:  # CPU smoke
+        cfg = ModelConfig(
+            vocab=512, d_model=128, n_heads=4, n_layers=2, d_ff=256,
+            max_seq=256, head_dim=32, dtype=jnp.float32, use_pallas=False,
+        )
+        shapes = [(2, 128)]
+        attn_shapes = [(2, 128, 4, 32)]
+        k_chain = 2
+        dtype = jnp.float32
+
+    out = {"backend": jax.default_backend(), "peak_flops": PEAK_FLOPS,
+           "prefill": [], "attention": []}
+    for b, s in shapes:
+        r = bench_prefill(cfg, b, s, k_chain)
+        out["prefill"].append(r)
+        print("prefill", r, flush=True)
+    for b, s, h, dh in attn_shapes:
+        r = bench_attention(b, s, h, dh, dtype, k_chain)
+        out["attention"].append(r)
+        print("attention", r, flush=True)
+    if on_tpu:
+        (ROOT / "MFU.json").write_text(json.dumps(out, indent=2) + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -76,3 +76,14 @@ def test_deployment_manifests_parse():
     for name in ("job-exclusive.yaml", "job-on-vtpu.yaml"):
         docs = list(yaml.safe_load_all((ROOT / "benchmarks" / "deployments" / name).read_text()))
         assert docs and all(d.get("kind") for d in docs)
+
+
+def test_mfu_bench_cpu_smoke():
+    """MFU harness runs end to end on the CPU mesh (numbers meaningless off
+    TPU; the real-chip artifact is MFU.json)."""
+    r = subprocess.run(
+        [sys.executable, str(ROOT / "benchmarks" / "mfu_bench.py")],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert r.returncode == 0, r.stderr
+    assert "prefill" in r.stdout and "attention" in r.stdout
