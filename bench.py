@@ -151,9 +151,15 @@ def tenant_main(a: argparse.Namespace) -> None:
             # delay under contention is sampled instead of backed off from.
             lock = threading.Lock()
             workers = []
+            errors: list[BaseException] = []
 
             def worker():
-                ttft, total = one_request()
+                try:
+                    ttft, total = one_request()
+                except BaseException as exc:  # re-raised after join
+                    with lock:
+                        errors.append(exc)
+                    return
                 with lock:
                     ttfts.append(ttft)
                     totals.append(total)
@@ -169,6 +175,10 @@ def tenant_main(a: argparse.Namespace) -> None:
                 workers.append(th)
             for th in workers:
                 th.join()
+            if errors:
+                # A silently dropped sample would overstate the results;
+                # fail the block loudly instead (the parent sees the crash).
+                raise errors[0]
         else:
             for _ in range(n):
                 ttft, total = one_request()

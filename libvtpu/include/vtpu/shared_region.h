@@ -15,6 +15,7 @@
 #ifndef VTPU_SHARED_REGION_H_
 #define VTPU_SHARED_REGION_H_
 
+#include <stddef.h>
 #include <stdint.h>
 
 #ifdef __cplusplus
@@ -22,7 +23,7 @@ extern "C" {
 #endif
 
 #define VTPU_REGION_MAGIC 0x56545055u /* "VTPU" */
-#define VTPU_REGION_VERSION 1u
+#define VTPU_REGION_VERSION 2u
 #define VTPU_MAX_DEVICES 16
 #define VTPU_MAX_PROCS 64
 #define VTPU_UUID_LEN 64
@@ -54,6 +55,18 @@ typedef struct vtpu_shared_region {
   int32_t utilization_switch;  /* monitor: 1 = enforce core limit, 0 = off */
   uint64_t heartbeat_ns;       /* writer liveness */
   uint64_t owner_init_ns;      /* region creation time */
+  /* v2: priority-gate contract. The gate blocks until the monitor lifts it
+   * (reference feedback.go:104-134 — no silent fall-through). The only two
+   * release-without-unblock paths are explicit and counted:
+   *   - gate_timeout_ms elapsed (region-controlled, monitor/operator-set;
+   *     0 = block unbounded, the default), or
+   *   - the monitor's own heartbeat went stale (crashed monitor must not
+   *     wedge the workload forever). */
+  uint64_t monitor_heartbeat_ns; /* monitor feedback-loop liveness */
+  uint32_t gate_timeout_ms;      /* max block per execute; 0 = unbounded */
+  uint32_t _pad1;
+  uint64_t gate_blocked_ns;      /* cumulative ns executes spent gated */
+  uint64_t gate_forced_releases; /* releases without unblock (timeout/stale) */
   vtpu_device_slot devices[VTPU_MAX_DEVICES];
   int32_t num_procs;
   int32_t _pad0;
@@ -67,6 +80,8 @@ static_assert(sizeof(vtpu_device_slot) == 64 + 8 * 3 + 4 * 2 + 8 * 3,
               "vtpu_device_slot layout drifted");
 static_assert(sizeof(vtpu_proc_slot) == 8 + 8 * VTPU_MAX_DEVICES,
               "vtpu_proc_slot layout drifted");
+static_assert(offsetof(vtpu_shared_region, devices) == 72,
+              "vtpu_shared_region v2 header layout drifted");
 #endif
 
 #endif /* VTPU_SHARED_REGION_H_ */

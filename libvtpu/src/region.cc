@@ -22,6 +22,14 @@ uint64_t now_ns() {
   return uint64_t(ts.tv_sec) * 1000000000ull + ts.tv_nsec;
 }
 
+// Elapsed-time math (gate timeout, blocked duration) must survive wall-clock
+// steps; only cross-process comparisons (monitor heartbeat) use now_ns().
+static uint64_t mono_ns() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return uint64_t(ts.tv_sec) * 1000000000ull + ts.tv_nsec;
+}
+
 static std::atomic<uint64_t>* as_atomic(uint64_t* p) {
   return reinterpret_cast<std::atomic<uint64_t>*>(p);
 }
@@ -60,7 +68,8 @@ Region* Region::open(const std::string& path, int priority) {
   self->region_ = region;
   // Initialization and slot claiming happen under the file lock so two
   // processes starting concurrently can't both memset or share a slot.
-  if (region->magic != VTPU_REGION_MAGIC) {
+  if (region->magic != VTPU_REGION_MAGIC ||
+      region->version != VTPU_REGION_VERSION) {
     std::memset(region, 0, sizeof(*region));
     region->magic = VTPU_REGION_MAGIC;
     region->version = VTPU_REGION_VERSION;
@@ -139,6 +148,57 @@ bool Region::blocked() const {
 
 bool Region::utilization_enforced() const {
   return !region_ || region_->utilization_switch != 0;
+}
+
+// A monitor that has not touched its heartbeat for this long is presumed
+// dead; its stale block must not wedge the workload forever.
+static const uint64_t kGateStaleNs = 60ull * 1000000000ull;
+
+uint64_t Region::gate_wait(bool* forced) {
+  *forced = false;
+  if (!region_ || !blocked()) return 0;
+  uint64_t start_mono = mono_ns();
+  for (;;) {
+    if (!blocked()) break;
+    uint64_t elapsed = mono_ns() - start_mono;
+    uint32_t timeout_ms = region_->gate_timeout_ms;
+    if (timeout_ms != 0 && elapsed >= (uint64_t)timeout_ms * 1000000ull) {
+      *forced = true;
+      break;
+    }
+    // Liveness: a monitor that ever heartbeat must keep doing so; pre-v2
+    // monitors never write one, so fall back to time-blocked-so-far.
+    uint64_t hb = region_->monitor_heartbeat_ns;
+    uint64_t now_rt = now_ns();
+    bool stale = hb != 0 ? (now_rt > hb && now_rt - hb > kGateStaleNs)
+                         : elapsed > kGateStaleNs;
+    if (stale) {
+      *forced = true;
+      break;
+    }
+    struct timespec ts{0, 1000000};  // 1ms
+    nanosleep(&ts, nullptr);
+  }
+  uint64_t blocked_ns = mono_ns() - start_mono;
+  as_atomic(&region_->gate_blocked_ns)->fetch_add(blocked_ns);
+  if (*forced) {
+    as_atomic(&region_->gate_forced_releases)->fetch_add(1);
+    uint64_t hb = region_->monitor_heartbeat_ns;
+    uint64_t now_rt = now_ns();
+    if (hb != 0 && now_rt > hb) {
+      VTPU_WARN("priority gate released without unblock after %llu ms "
+                "(timeout_ms=%u, monitor heartbeat age %llu ms)",
+                (unsigned long long)(blocked_ns / 1000000ull),
+                region_->gate_timeout_ms,
+                (unsigned long long)((now_rt - hb) / 1000000ull));
+    } else {
+      VTPU_WARN("priority gate released without unblock after %llu ms "
+                "(timeout_ms=%u, monitor never heartbeat)",
+                (unsigned long long)(blocked_ns / 1000000ull),
+                region_->gate_timeout_ms);
+    }
+  }
+  return blocked_ns;
 }
 
 }  // namespace vtpu

@@ -28,6 +28,14 @@ class Region {
   bool blocked() const;             // low-priority kernels suspended
   bool utilization_enforced() const;  // core limiting currently on
 
+  // Block while the monitor gate is down (reference feedback.go:104-134:
+  // suspended work stays suspended until the monitor lifts the gate). The
+  // only release-without-unblock paths are explicit, counted in the region
+  // (gate_forced_releases), and region-controlled: the monitor-set
+  // gate_timeout_ms elapsing, or the monitor's heartbeat going stale.
+  // Returns ns spent blocked; *forced reports a release-without-unblock.
+  uint64_t gate_wait(bool* forced);
+
  private:
   vtpu_shared_region* region_ = nullptr;
   int pid_slot_ = -1;

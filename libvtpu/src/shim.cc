@@ -649,14 +649,12 @@ PJRT_Error* wrapped_execute(PJRT_LoadedExecutable_Execute_Args* args) {
       args->execute_device ? device_index_of(args->execute_device) : 0;
 
   // Priority gate: the monitor suspends low-priority work by writing
-  // recent_kernel = -1 (reference feedback.go:104-134 semantics).
+  // recent_kernel = -1 (reference feedback.go:104-134 semantics). Blocks
+  // until unblocked; any release-without-unblock is region-controlled
+  // (gate_timeout_ms / stale monitor heartbeat) and counted.
   if (s.region != nullptr) {
-    int spins = 0;
-    while (s.region->blocked() && spins < 10000) {
-      struct timespec ts{0, 1000000};  // 1ms
-      nanosleep(&ts, nullptr);
-      spins++;
-    }
+    bool forced = false;
+    s.region->gate_wait(&forced);
   }
 
   uint64_t waited = 0;

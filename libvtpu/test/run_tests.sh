@@ -74,11 +74,11 @@ import struct, sys
 data = open(sys.argv[1], "rb").read()
 magic, version, num_devices, priority = struct.unpack_from("<IIii", data, 0)
 assert magic == 0x56545055, hex(magic)
-assert version == 1, version
+assert version == 2, version
 assert num_devices >= 1, num_devices
 assert priority == 1, priority
-# device slot 0: uuid[64] + hbm_limit
-off = 40
+# device slot 0: uuid[64] + hbm_limit (v2 header is 72 bytes)
+off = 72
 uuid = data[off:off+64].split(b"\0")[0].decode()
 limit, used, peak = struct.unpack_from("<QQQ", data, off+64)
 kernel_count = struct.unpack_from("<Q", data, off+64+24+8+8)[0]

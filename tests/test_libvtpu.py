@@ -105,11 +105,59 @@ def test_monitor_block_gates_running_workload(libvtpu_build, tmp_path):
         reader.set_recent_kernel(1)
         _out, err = proc.communicate(timeout=30)
         assert proc.returncode == 0, err
-        assert reader.read().devices[0].kernel_count == count0 + 30
+        snap = reader.read()
+        assert snap.devices[0].kernel_count == count0 + 30
+        # 4. gate telemetry: the block was recorded, and it ended with an
+        #    unblock — NOT a silent fall-through (the v1 shim leaked after
+        #    10s; any release-without-unblock now increments the counter)
+        assert snap.gate_blocked_ns >= int(0.5e9), snap.gate_blocked_ns
+        assert snap.gate_forced_releases == 0
     finally:
         if proc.poll() is None:
             proc.kill()
             proc.communicate()
+
+
+def test_gate_timeout_is_region_controlled(libvtpu_build, tmp_path):
+    """A gated execute may only proceed without an unblock when the
+    monitor-written gate_timeout_ms elapses, and that release is counted
+    (no silent leak — VERDICT round-1 weak #5)."""
+    import os
+    import subprocess as sp
+    import time
+
+    from vtpu.monitor.region import RegionReader
+
+    region = tmp_path / "usage.cache"
+    env = dict(os.environ)
+    env.update({
+        "VTPU_REAL_LIBTPU": str(libvtpu_build / "fake_pjrt.so"),
+        "VTPU_SHARED_REGION": str(region),
+        "TPU_DEVICE_MEMORY_LIMIT_0": "64m",
+    })
+    smoke = [str(libvtpu_build / "pjrt_smoke"), str(libvtpu_build / "libvtpu.so")]
+
+    r = sp.run([*smoke, "1", "1", "1"], env=env, capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    reader = RegionReader(str(region))
+    count0 = reader.read().devices[0].kernel_count
+
+    # Monitor blocks the tenant but allows at most 300ms of block per execute.
+    reader.set_recent_kernel(-1)
+    reader.set_monitor_heartbeat(time.time_ns())
+    reader.set_gate_timeout_ms(300)
+    t0 = time.monotonic()
+    r = sp.run([*smoke, "1", "1", "2"], env=env, capture_output=True,
+               text=True, timeout=30)
+    elapsed = time.monotonic() - t0
+    assert r.returncode == 0, r.stderr
+    snap = reader.read()
+    # Both executes went through (each waited out its own 300ms window)...
+    assert snap.devices[0].kernel_count == count0 + 2
+    # ...took at least the two gate windows, and each release was counted.
+    assert elapsed >= 0.6, elapsed
+    assert snap.gate_forced_releases == 2, snap.gate_forced_releases
+    assert snap.gate_blocked_ns >= int(0.6e9), snap.gate_blocked_ns
 
 
 def test_attach_queueing_on_exclusive_runtime(libvtpu_build, tmp_path):

@@ -54,6 +54,9 @@ def main() -> None:
     parser.add_argument("--node-name", default=os.environ.get("NODE_NAME", ""))
     parser.add_argument("--metrics-port", type=int, default=9394)
     parser.add_argument("--feedback-interval", type=float, default=5.0)
+    parser.add_argument("--gate-timeout-ms", type=int, default=0,
+                        help="max per-execute block for gated low-priority work "
+                             "(0 = blocked until the gate lifts)")
     parser.add_argument("--kube-api", default="")
     parser.add_argument("--no-gc", action="store_true",
                         help="disable dead-pod cache GC (no API access needed)")
@@ -68,6 +71,12 @@ def main() -> None:
     )
     if not os.path.isdir(args.hook_path):
         parser.error(f"hook path {args.hook_path} does not exist")
+    if args.feedback_interval > 30:
+        # libvtpu presumes a dead monitor after 60s without a heartbeat
+        # (libvtpu/src/region.cc kGateStaleNs); a slower loop would make every
+        # gated execute force-release as "stale monitor".
+        parser.error("--feedback-interval must be <= 30s (libvtpu's 60s "
+                     "monitor-liveness contract)")
 
     pod_checker = None
     if not args.no_gc:
@@ -86,7 +95,8 @@ def main() -> None:
     # cmd/vGPUmonitor/main.go:101-116). The lock lives under the hook path --
     # the hostPath volume shared with the plugin container.
     partition_dir = lock_dir_for(args.hook_path)
-    loop = FeedbackLoop(lister, interval=args.feedback_interval)
+    loop = FeedbackLoop(lister, interval=args.feedback_interval,
+                        gate_timeout_ms=args.gate_timeout_ms)
 
     import signal
     import sys

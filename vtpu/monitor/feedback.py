@@ -50,9 +50,12 @@ def census(entries: list[ContainerUsage], now_ns: int) -> dict[str, DeviceCensus
     return out
 
 
-def apply_feedback(entries: list[ContainerUsage], now_ns: int | None = None) -> None:
+def apply_feedback(entries: list[ContainerUsage], now_ns: int | None = None,
+                   gate_timeout_ms: int = 0) -> None:
     """One feedback pass (reference watchAndFeedback body + CheckBlocking +
-    CheckPriority)."""
+    CheckPriority). ``gate_timeout_ms`` is written into every region as the
+    region-controlled max block per execute (0 = blocked work stays blocked
+    until this loop lifts the gate — reference semantics)."""
     now = now_ns if now_ns is not None else time.time_ns()
     by_device = census(entries, now)
     for entry in entries:
@@ -77,6 +80,10 @@ def apply_feedback(entries: list[ContainerUsage], now_ns: int | None = None) -> 
             # Sole tenant on all its chips -> let it run unthrottled (reference
             # SetUtilizationSwitch semantics).
             entry.reader.set_utilization_switch(0 if sole_tenant else 1)
+            # Gate liveness: a blocked workload only self-releases if this
+            # heartbeat goes stale or the explicit timeout elapses.
+            entry.reader.set_monitor_heartbeat(now)
+            entry.reader.set_gate_timeout_ms(gate_timeout_ms)
         except ValueError:
             # Reader GC'd/closed by a concurrent scan between update() and
             # here; the next tick picks the container up again.
@@ -84,13 +91,15 @@ def apply_feedback(entries: list[ContainerUsage], now_ns: int | None = None) -> 
 
 
 class FeedbackLoop:
-    def __init__(self, lister: ContainerLister, interval: float = 5.0):
+    def __init__(self, lister: ContainerLister, interval: float = 5.0,
+                 gate_timeout_ms: int = 0):
         self.lister = lister
         self.interval = interval
+        self.gate_timeout_ms = gate_timeout_ms
         self._stop = False
 
     def run_once(self) -> None:
-        apply_feedback(self.lister.update())
+        apply_feedback(self.lister.update(), gate_timeout_ms=self.gate_timeout_ms)
 
     def run_forever(self, pause_check=None) -> None:
         while not self._stop:

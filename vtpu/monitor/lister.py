@@ -97,7 +97,12 @@ class ContainerLister:
             path = os.path.join(dir_path, fname)
             try:
                 return RegionReader(path)
-            except (BadRegion, OSError) as e:
+            except BadRegion as e:
+                # A version/layout mismatch means a live workload is invisible
+                # to blocking and metrics (e.g. v1 region during a rolling
+                # monitor upgrade) — that must be operator-visible.
+                log.warning("skipping region %s: %s", path, e)
+            except OSError as e:
                 log.debug("skipping region %s: %s", path, e)
         return None
 

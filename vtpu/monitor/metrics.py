@@ -71,6 +71,16 @@ class MonitorCollector(Collector):
             "vtpu_container_blocked", "1 while suspended by priority feedback",
             labels=["podUid", "container", "nodename"],
         )
+        gate_blocked = CounterMetricFamily(
+            "vtpu_container_gate_blocked_seconds_total",
+            "Cumulative seconds executes spent held by the priority gate",
+            labels=["podUid", "container", "nodename"],
+        )
+        gate_forced = CounterMetricFamily(
+            "vtpu_container_gate_forced_releases_total",
+            "Gate releases without an unblock (timeout or stale monitor)",
+            labels=["podUid", "container", "nodename"],
+        )
         now_ns = time.time_ns()
         for e in entries:
             snap = e.snapshot
@@ -78,6 +88,12 @@ class MonitorCollector(Collector):
             blocked.add_metric(
                 [e.pod_uid, e.container, self.node_name],
                 1.0 if snap.recent_kernel < 0 else 0.0,
+            )
+            gate_blocked.add_metric(
+                [e.pod_uid, e.container, self.node_name], snap.gate_blocked_ns / 1e9
+            )
+            gate_forced.add_metric(
+                [e.pod_uid, e.container, self.node_name], snap.gate_forced_releases
             )
             for dev in snap.devices:
                 lv = [e.pod_uid, e.container, dev.uuid, self.node_name]
@@ -91,7 +107,8 @@ class MonitorCollector(Collector):
                 kernels.add_metric(lv, dev.kernel_count)
                 throttled.add_metric(lv, dev.throttle_wait_ns / 1e9)
         families = (mem_used, mem_limit, mem_peak, core_util, core_limit,
-                    last_kernel, kernels, throttled, priority, blocked)
+                    last_kernel, kernels, throttled, priority, blocked,
+                    gate_blocked, gate_forced)
         yield from families
         if self.legacy_metrics:
             for fam in families:

@@ -9,7 +9,9 @@ static_asserts and the cross-language test in tests/test_libvtpu.py):
 
     header:  magic u32 | version u32 | num_devices i32 | priority i32 |
              recent_kernel i32 | utilization_switch i32 | heartbeat_ns u64 |
-             owner_init_ns u64                                   (40 bytes)
+             owner_init_ns u64 | monitor_heartbeat_ns u64 |
+             gate_timeout_ms u32 | pad u32 | gate_blocked_ns u64 |
+             gate_forced_releases u64                            (72 bytes)
     devices: 16 x { uuid[64] | hbm_limit u64 | hbm_used u64 | hbm_peak u64 |
              core_limit i32 | core_util i32 | last_kernel_ns u64 |
              kernel_count u64 | throttle_wait_ns u64 }          (120 bytes)
@@ -25,13 +27,13 @@ import struct
 from dataclasses import dataclass, field
 
 MAGIC = 0x56545055
-VERSION = 1
+VERSION = 2
 MAX_DEVICES = 16
 MAX_PROCS = 64
 UUID_LEN = 64
 
-HEADER_FMT = "<IIiiiiQQ"
-HEADER_SIZE = struct.calcsize(HEADER_FMT)  # 40
+HEADER_FMT = "<IIiiiiQQQIIQQ"
+HEADER_SIZE = struct.calcsize(HEADER_FMT)  # 72
 DEVICE_FMT = f"<{UUID_LEN}sQQQiiQQQ"
 DEVICE_SIZE = struct.calcsize(DEVICE_FMT)  # 120
 DEVICES_OFF = HEADER_SIZE
@@ -45,6 +47,8 @@ REGION_SIZE = PROCS_OFF + MAX_PROCS * PROC_SIZE
 OFF_RECENT_KERNEL = 16
 OFF_UTILIZATION_SWITCH = 20
 OFF_HEARTBEAT = 24
+OFF_MONITOR_HEARTBEAT = 40
+OFF_GATE_TIMEOUT_MS = 48
 
 
 @dataclass
@@ -77,6 +81,10 @@ class RegionSnapshot:
     utilization_switch: int = 0
     heartbeat_ns: int = 0
     owner_init_ns: int = 0
+    monitor_heartbeat_ns: int = 0
+    gate_timeout_ms: int = 0
+    gate_blocked_ns: int = 0
+    gate_forced_releases: int = 0
     devices: list[DeviceSnapshot] = field(default_factory=list)
     procs: list[ProcSnapshot] = field(default_factory=list)
 
@@ -119,6 +127,8 @@ class RegionReader:
             magic=hdr[0], version=hdr[1], num_devices=hdr[2], priority=hdr[3],
             recent_kernel=hdr[4], utilization_switch=hdr[5],
             heartbeat_ns=hdr[6], owner_init_ns=hdr[7],
+            monitor_heartbeat_ns=hdr[8], gate_timeout_ms=hdr[9],
+            gate_blocked_ns=hdr[11], gate_forced_releases=hdr[12],
         )
         n_dev = min(max(snap.num_devices, 0), MAX_DEVICES)
         for i in range(n_dev):
@@ -148,3 +158,14 @@ class RegionReader:
 
     def set_utilization_switch(self, value: int) -> None:
         struct.pack_into("<i", self._mm, OFF_UTILIZATION_SWITCH, value)
+
+    def set_monitor_heartbeat(self, now_ns: int) -> None:
+        """Feedback-loop liveness: a blocked workload only self-releases if
+        this goes stale (crashed monitor must not wedge it forever)."""
+        struct.pack_into("<Q", self._mm, OFF_MONITOR_HEARTBEAT, now_ns)
+
+    def set_gate_timeout_ms(self, value: int) -> None:
+        """Region-controlled max block per execute; 0 = unbounded (default).
+        Clamped to u32 so a bad flag value can't abort the feedback pass."""
+        struct.pack_into("<I", self._mm, OFF_GATE_TIMEOUT_MS,
+                         min(max(value, 0), 2**32 - 1))
