@@ -1,0 +1,110 @@
+#!/usr/bin/env bash
+# Real-cluster e2e: deploy charts/vtpu onto a kind cluster with the mock
+# device plugin and assert the webhook -> Filter -> Bind -> Allocate pipeline
+# against REAL apiserver/kubelet semantics (patch handling, resourceVersion
+# conflicts, admission wiring) — the layer the in-process pytest e2e
+# necessarily simulates. Mirrors reference hack/e2e-test.sh +
+# .github/workflows/call-e2e.yaml (kind + mock plugin DaemonSet).
+set -euo pipefail
+
+CLUSTER=${CLUSTER:-vtpu-e2e}
+IMAGE=${IMAGE:-vtpu:e2e}
+NS=${NS:-vtpu-system}
+KUBECTL="kubectl --context kind-${CLUSTER}"
+
+cleanup() {
+  if [ "${KEEP_CLUSTER:-0}" != "1" ]; then
+    kind delete cluster --name "${CLUSTER}" || true
+  fi
+}
+trap cleanup EXIT
+
+echo "== 1. kind cluster =="
+kind get clusters | grep -qx "${CLUSTER}" || kind create cluster --name "${CLUSTER}" --wait 120s
+
+echo "== 2. build + load image =="
+docker build -f docker/Dockerfile -t "${IMAGE}" .
+kind load docker-image "${IMAGE}" --name "${CLUSTER}"
+
+echo "== 3. install chart with the mock device plugin =="
+NODE=$(${KUBECTL} get nodes -o jsonpath='{.items[0].metadata.name}')
+${KUBECTL} label node "${NODE}" vtpu.io/mock-tpu-node=true --overwrite
+helm upgrade --install vtpu charts/vtpu \
+  --namespace "${NS}" --create-namespace \
+  --set image.repository="${IMAGE%:*}" --set image.tag="${IMAGE#*:}" \
+  --set image.pullPolicy=Never \
+  --set devicePlugin.enabled=false \
+  --set mockDevicePlugin.enabled=true \
+  --wait --timeout 300s
+
+echo "== 4. wait for the mock plugin to register capacity =="
+for i in $(seq 1 60); do
+  CAP=$(${KUBECTL} get node "${NODE}" -o jsonpath='{.status.allocatable.google\.com/tpu}' || true)
+  [ -n "${CAP}" ] && [ "${CAP}" != "0" ] && break
+  sleep 2
+done
+[ -n "${CAP:-}" ] && [ "${CAP}" != "0" ] || {
+  echo "mock plugin never registered google.com/tpu"; ${KUBECTL} -n "${NS}" get pods -o wide
+  ${KUBECTL} -n "${NS}" logs -l app.kubernetes.io/component=mock-device-plugin --tail=100 || true
+  exit 1
+}
+echo "node ${NODE} allocatable google.com/tpu=${CAP}"
+
+echo "== 5. a vTPU pod schedules through the full stack =="
+${KUBECTL} apply -f - <<EOF
+apiVersion: v1
+kind: Pod
+metadata:
+  name: e2e-tenant
+  namespace: default
+spec:
+  restartPolicy: Never
+  containers:
+    - name: main
+      image: busybox:1.36
+      command: ["sh", "-c", "env | grep -E 'TPU|VTPU' ; sleep 30"]
+      resources:
+        limits:
+          google.com/tpu: "1"
+          google.com/tpumem: "1024"
+EOF
+${KUBECTL} wait pod/e2e-tenant --for=condition=Ready --timeout=180s || {
+  ${KUBECTL} describe pod e2e-tenant; exit 1
+}
+
+echo "== 6. the scheduler's decisions are on the pod (annotations DB) =="
+ANNOS=$(${KUBECTL} get pod e2e-tenant -o jsonpath='{.metadata.annotations}')
+echo "${ANNOS}" | grep -q 'vtpu.io/vtpu-node' || { echo "missing assigned-node: ${ANNOS}"; exit 1; }
+echo "${ANNOS}" | grep -q 'vtpu.io/bind-phase":"success' || { echo "bind-phase not success: ${ANNOS}"; exit 1; }
+
+echo "== 7. the allocate env contract reached the container =="
+${KUBECTL} logs e2e-tenant | grep -q 'TPU_DEVICE_MEMORY_LIMIT_0=1024m' || {
+  echo "container missing HBM cap env"; ${KUBECTL} logs e2e-tenant; exit 1
+}
+
+echo "== 8. an overcommit pod stays Pending with a scheduler event =="
+${KUBECTL} apply -f - <<EOF
+apiVersion: v1
+kind: Pod
+metadata:
+  name: e2e-glutton
+  namespace: default
+spec:
+  restartPolicy: Never
+  containers:
+    - name: main
+      image: busybox:1.36
+      command: ["sleep", "30"]
+      resources:
+        limits:
+          google.com/tpu: "1"
+          google.com/tpumem: "9999999"
+EOF
+sleep 10
+PHASE=$(${KUBECTL} get pod e2e-glutton -o jsonpath='{.status.phase}')
+[ "${PHASE}" = "Pending" ] || { echo "overcommit pod phase=${PHASE}, want Pending"; exit 1; }
+${KUBECTL} get events --field-selector involvedObject.name=e2e-glutton | grep -qi 'filter' || {
+  echo "no FilteringFailed event"; ${KUBECTL} get events | tail -20; exit 1
+}
+
+echo "ALL KIND E2E TESTS PASSED"

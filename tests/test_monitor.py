@@ -107,6 +107,38 @@ def test_monitor_collector_exports(hook):
     assert any(s.value == 3 for s in kernel_samples)
 
 
+def test_host_level_chip_metrics(hook):
+    """Host-level per-chip families (reference metrics.go:88-148 hami_host_*):
+    container regions aggregate per REAL chip uuid via the plugin's <dir>/chips
+    mapping, capacity comes from the plugin-published <hook>/chips.json."""
+    import json
+
+    hook_path, dirs = hook
+    # the plugin assigned both containers the same physical chip
+    for d in dirs.values():
+        (d / "chips").write_text("chipA")
+    (hook_path / "chips.json").write_text(json.dumps([
+        {"uuid": "chipA", "index": 0, "devmem_mb": 16384, "devcore": 100,
+         "type": "TPU-v5e", "numa": 0, "healthy": True, "mode": ""},
+        {"uuid": "chipB", "index": 1, "devmem_mb": 16384, "devcore": 100,
+         "type": "TPU-v5e", "numa": 0, "healthy": True, "mode": ""},
+    ]))
+    lister = ContainerLister(str(hook_path))
+    metrics = {m.name: m for m in MonitorCollector(lister, node_name="n1").collect()}
+    tenants = {s.labels["deviceuuid"]: s.value
+               for s in metrics["vtpu_host_chip_tenants"].samples}
+    assert tenants["chipA"] == 2  # both containers share the chip
+    assert tenants["chipB"] == 0  # idle chip still visible from the inventory
+    totals = {s.labels["deviceuuid"]: s.value
+              for s in metrics["vtpu_host_memory_total_bytes"].samples}
+    assert totals["chipA"] == totals["chipB"] == 16384 * 1024 * 1024
+    used = {s.labels["deviceuuid"]: s.value
+            for s in metrics["vtpu_host_memory_used_bytes"].samples}
+    assert used["chipA"] >= 0 and used["chipB"] == 0
+    assert all(s.labels["nodename"] == "n1"
+               for s in metrics["vtpu_host_memory_used_bytes"].samples)
+
+
 def test_monitor_collector_legacy_aliases(hook):
     """--legacy-metrics publishes reference-compatible hami_* names so
     dashboards built for the reference keep working."""

@@ -198,6 +198,77 @@ def test_split_gang_refuses_further_placement(cluster):
     assert any("already spans slices" in v for v in r["FailedNodes"].values())
 
 
+def test_gang_rank_assigned_at_filter(cluster):
+    """Filter stamps a gang-own rank 0..N-1 (vtpu.io/gang-rank) so Allocate's
+    TPU_WORKER_ID is correct even on the larger-slice fallback tier, and a
+    re-filtered worker reclaims a free rank instead of colliding."""
+    client, sched = cluster
+    p0, r0 = _filter(sched, client, _worker("w0"))
+    p1, r1 = _filter(sched, client, _worker("w1"))
+    assert r0["NodeNames"] and r1["NodeNames"]
+    a0 = client.get_pod("default", "w0")["metadata"]["annotations"]
+    a1 = client.get_pod("default", "w1")["metadata"]["annotations"]
+    assert a0[t.GANG_RANK_ANNO] == "0"
+    assert a1[t.GANG_RANK_ANNO] == "1"
+    # w0 is re-filtered (still unbound): w1 holds rank 1, so w0 must get 0
+    # back — never a duplicate of a rank assigned after its first placement
+    p0b = client.get_pod("default", "w0")
+    r0b = sched.filter({"Pod": p0b, "NodeNames": list(ALL_NODES)})
+    assert r0b["NodeNames"]
+    assert client.get_pod("default", "w0")["metadata"]["annotations"][
+        t.GANG_RANK_ANNO] == "0"
+
+
+def test_member_on_unknown_slice_node_refuses_placement(cluster):
+    """A gang member on a node whose slice membership vanished must refuse
+    placement (like the spans-slices case), not silently stop pinning."""
+    client, sched = cluster
+    pod = client.put_pod(_worker("w0"))
+    sched.pod_manager.add_pod(pod, "ghost-node", {})
+    _, r = _filter(sched, client, _worker("w1"))
+    assert r["NodeNames"] == []
+    assert any("unknown slice membership" in v for v in r["FailedNodes"].values())
+
+
+def test_worker_envs_gang_rank_and_larger_slice_hostnames(monkeypatch):
+    """TPU_WORKER_ID prefers the scheduler's gang rank over the node's
+    physical slice rank, and the slice-wide hostnames env fallback is NOT
+    injected when the gang is smaller than its slice (the list would
+    misaddress libtpu's cross-host init)."""
+    from vtpu.plugin.server import PluginConfig, TpuDevicePlugin
+    from vtpu.plugin.rm import TpuResourceManager, discover_chips
+
+    monkeypatch.setenv("VTPU_MOCK_DEVICES", "4")
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "h0,h1,h2,h3")
+    chips = discover_chips()
+    rm = TpuResourceManager(chips, split_count=4)
+    client = fake_cluster({})
+    # node is physical worker 3 of a 4-host slice; the gang only has 2 workers
+    sl = SliceInfo("s1", 3, 4, "v5p-32", "2x4x4")
+    plugin = TpuDevicePlugin(
+        rm, client, PluginConfig(node_name="a1", hook_path="/tmp/vtpu-test", slice_info=sl)
+    )
+    pod = _worker("w1", annos={t.GANG_RANK_ANNO: "1"})
+    env = plugin._worker_envs(pod)
+    assert env["TPU_WORKER_ID"] == "1"  # gang rank, not physical rank 3
+    assert "TPU_WORKER_HOSTNAMES" not in env  # 4-host list is wrong for N=2
+    # with the pod-side hostnames annotation, it IS injected
+    pod2 = _worker("w2", annos={t.GANG_RANK_ANNO: "0",
+                                t.WORKER_HOSTNAMES_ANNO: "j-0.svc,j-1.svc"})
+    assert plugin._worker_envs(pod2)["TPU_WORKER_HOSTNAMES"] == "j-0.svc,j-1.svc"
+    # gang covers the slice exactly -> the env fallback (PHYSICAL slice
+    # order) is injected, and the id must be the node's own physical rank so
+    # it still indexes the list — the gang rank would point at a wrong host
+    plugin.config.slice_info = SliceInfo("s1", 1, 4, "v5p-32", "2x4x4")
+    env4 = plugin._worker_envs(_worker("w3", workers=4, annos={t.GANG_RANK_ANNO: "2"}))
+    assert env4["TPU_WORKER_ID"] == "1"
+    assert env4["TPU_WORKER_HOSTNAMES"] == "h0,h1,h2,h3"
+    # no gang-own rank at all: physical rank + slice-wide list (legacy path)
+    env_leg = plugin._worker_envs(_worker("w4", workers=4))
+    assert env_leg["TPU_WORKER_ID"] == "1"  # the node's physical slice rank
+    assert env_leg["TPU_WORKER_HOSTNAMES"] == "h0,h1,h2,h3"
+
+
 def test_single_host_pods_ignore_slices(cluster):
     client, sched = cluster
     _, r = _filter(sched, client, tpu_pod("plain", tpumem=4096))

@@ -158,6 +158,34 @@ def test_allocate_full_slice(served_plugin):
     sched.stop()
 
 
+def test_allocate_mounts_license_hook_when_present(served_plugin):
+    """Operator-provisioned license + validator in the hook dir surface as
+    read-only container mounts (reference server.go:712-724)."""
+    client, rm, stub, config = served_plugin
+    sched = Scheduler(client)
+    register_tpu_backend(quota=sched.quota_manager)
+    sched.start(register_interval=3600)
+    os.makedirs(config.hook_path, exist_ok=True)
+    for fname in (envs.LICENSE_FILE, envs.VALIDATOR_BIN):
+        with open(os.path.join(config.hook_path, fname), "w") as f:
+            f.write("x")
+    try:
+        pod = client.put_pod(tpu_pod("lic", tpumem=1024))
+        assert sched.filter({"Pod": pod, "NodeNames": ["host1"]})["NodeNames"]
+        assert sched.bind({"PodName": "lic", "PodNamespace": "default",
+                           "Node": "host1"})["Error"] == ""
+        resp = stub.Allocate(pb.AllocateRequest(
+            container_requests=[pb.ContainerAllocateRequest(
+                devicesIDs=["host1-tpu-0::0"])]))
+        mounts = {m.container_path: m for m in resp.container_responses[0].mounts}
+        lic = mounts[envs.CONTAINER_LICENSE_PATH]
+        assert lic.host_path.endswith(envs.LICENSE_FILE) and lic.read_only
+        val = mounts[envs.CONTAINER_VALIDATOR_PATH]
+        assert val.host_path.endswith(envs.VALIDATOR_BIN) and val.read_only
+    finally:
+        sched.stop()
+
+
 def test_allocate_qos_policy_maps_to_core_policy(served_plugin):
     """QoS annotation drives libvtpu's core-utilization policy (reference
     metax qos.go: best-effort never throttles, fixed-share always does)."""

@@ -24,6 +24,7 @@ class PodInfo:
     ctr_ids: list[str] = field(default_factory=list)
     group: str = ""  # gang-scheduling pod group (multi-host slice placement)
     slice_workers: int = 0  # >1: this pod is a multi-host slice worker
+    gang_rank: int = -1  # scheduler-assigned gang-own worker rank (-1: none)
 
     @property
     def key(self) -> str:
@@ -36,7 +37,7 @@ class PodManager:
         self._pods: dict[str, PodInfo] = {}
 
     def add_pod(self, pod: dict, node_id: str, devices: PodDevices) -> None:
-        from vtpu.util.helpers import pod_group_name, slice_workers
+        from vtpu.util.helpers import gang_rank, pod_group_name, slice_workers
 
         meta = pod["metadata"]
         with self._lock:
@@ -52,6 +53,7 @@ class PodManager:
                 ],
                 group=pod_group_name(pod),
                 slice_workers=slice_workers(pod),
+                gang_rank=gang_rank(pod),
             )
 
     def del_pod(self, pod: dict) -> None:

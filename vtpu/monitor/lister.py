@@ -29,6 +29,10 @@ class ContainerUsage:
     dir_path: str
     reader: Optional[RegionReader] = None
     snapshot: RegionSnapshot = field(default_factory=RegionSnapshot)
+    # real chip uuids assigned to this container, in region device-slot order
+    # (the plugin's Allocate writes them to <dir>/chips; the region's own
+    # uuids are positional "device-<i>" names)
+    chips: list[str] = field(default_factory=list)
 
     @property
     def key(self) -> str:
@@ -39,6 +43,7 @@ class ContainerLister:
     def __init__(self, hook_path: str, pod_checker=None):
         """pod_checker(pod_uid) -> bool: does the pod still exist on this node?
         None disables GC (tests, standalone use)."""
+        self.hook_path = hook_path
         self.base = os.path.join(hook_path, CONTAINERS_SUBDIR)
         self.pod_checker = pod_checker
         self._lock = threading.Lock()
@@ -74,6 +79,7 @@ class ContainerLister:
                     self._entries[name] = entry
                 if entry.reader is None:
                     entry.reader = self._open_region(dir_path)
+                    entry.chips = self._read_chips(dir_path)
                 if entry.reader is not None:
                     try:
                         entry.snapshot = entry.reader.read()
@@ -105,6 +111,26 @@ class ContainerLister:
             except OSError as e:
                 log.debug("skipping region %s: %s", path, e)
         return None
+
+    def _read_chips(self, dir_path: str) -> list[str]:
+        """The plugin-written real-chip uuid list for this container."""
+        from vtpu.plugin.envs import read_chips_file
+
+        return read_chips_file(dir_path)
+
+    def host_inventory(self) -> list[dict]:
+        """The plugin's host chip inventory (<hook>/chips.json), or [] when
+        the plugin hasn't published one (standalone monitor, tests)."""
+        import json
+
+        from vtpu.plugin.envs import HOST_CHIPS_FILE
+
+        try:
+            with open(os.path.join(self.hook_path, HOST_CHIPS_FILE)) as f:
+                data = json.load(f)
+            return data if isinstance(data, list) else []
+        except (OSError, ValueError):
+            return []
 
     def _gc(self, name: str, dir_path: str) -> None:
         """Remove a dead pod's cache dir (reference cudevshr.go:184-201)."""

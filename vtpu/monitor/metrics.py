@@ -110,19 +110,71 @@ class MonitorCollector(Collector):
                     last_kernel, kernels, throttled, priority, blocked,
                     gate_blocked, gate_forced)
         yield from families
+        yield from self._host_families(entries)
         if self.legacy_metrics:
-            for fam in families:
-                alias = LEGACY_ALIASES.get(fam.name)
-                if alias is None:
+            yield from self._legacy_aliases(families)
+
+    def _host_families(self, entries):
+        """Host-level per-chip view (reference metrics.go:88-148
+        hami_host_gpu_* via NVML; the TPU analog aggregates every container
+        region per REAL chip uuid — the plugin's <dir>/chips mapping — and
+        takes capacity from the plugin-published <hook>/chips.json)."""
+        hlabels = ["deviceuuid", "nodename"]
+        h_used = GaugeMetricFamily(
+            "vtpu_host_memory_used_bytes",
+            "Host view: vTPU HBM in use per chip (all containers)", labels=hlabels,
+        )
+        h_total = GaugeMetricFamily(
+            "vtpu_host_memory_total_bytes",
+            "Host view: chip HBM capacity", labels=hlabels,
+        )
+        h_core = GaugeMetricFamily(
+            "vtpu_host_core_utilization_ratio",
+            "Host view: summed TensorCore duty-cycle percent per chip",
+            labels=hlabels,
+        )
+        h_tenants = GaugeMetricFamily(
+            "vtpu_host_chip_tenants",
+            "Host view: containers sharing each chip", labels=hlabels,
+        )
+        used: dict[str, int] = {}
+        core: dict[str, int] = {}
+        tenants: dict[str, int] = {}
+        for e in entries:
+            for i, dev in enumerate(e.snapshot.devices):
+                # only the plugin's chips mapping gives REAL chip identity;
+                # the region's own names are positional ("device-<i>") and
+                # would merge unrelated containers into one phantom chip
+                uuid = e.chips[i] if i < len(e.chips) else ""
+                if not uuid:
                     continue
-                legacy = GaugeMetricFamily(
-                    alias, f"{fam.documentation} (legacy alias)",
-                    labels=["podUid", "container", "deviceuuid", "nodename"],
+                used[uuid] = used.get(uuid, 0) + dev.hbm_used_bytes
+                core[uuid] = core.get(uuid, 0) + max(dev.core_util_percent, 0)
+                tenants[uuid] = tenants.get(uuid, 0) + 1
+        inventory = {c.get("uuid", ""): c for c in self.lister.host_inventory()}
+        for uuid in sorted(set(used) | set(inventory) - {""}):
+            lv = [uuid, self.node_name]
+            h_used.add_metric(lv, used.get(uuid, 0))
+            h_core.add_metric(lv, min(core.get(uuid, 0), 100))
+            h_tenants.add_metric(lv, tenants.get(uuid, 0))
+            inv = inventory.get(uuid)
+            if inv:
+                h_total.add_metric(lv, int(inv.get("devmem_mb", 0)) * 1024 * 1024)
+        yield from (h_used, h_total, h_core, h_tenants)
+
+    def _legacy_aliases(self, families):
+        for fam in families:
+            alias = LEGACY_ALIASES.get(fam.name)
+            if alias is None:
+                continue
+            legacy = GaugeMetricFamily(
+                alias, f"{fam.documentation} (legacy alias)",
+                labels=["podUid", "container", "deviceuuid", "nodename"],
+            )
+            for sample in fam.samples:
+                legacy.add_metric(
+                    [sample.labels.get(k, "") for k in
+                     ("podUid", "container", "deviceuuid", "nodename")],
+                    sample.value,
                 )
-                for sample in fam.samples:
-                    legacy.add_metric(
-                        [sample.labels.get(k, "") for k in
-                         ("podUid", "container", "deviceuuid", "nodename")],
-                        sample.value,
-                    )
-                yield legacy
+            yield legacy

@@ -96,6 +96,11 @@ def main() -> None:
     health = HealthWatcher(rm, hook_path=args.hook_path)
     health.start()
 
+    from vtpu.plugin.rm import write_host_inventory
+
+    # host chip inventory for the monitor's host-level metric families
+    write_host_inventory(rm, args.hook_path)
+
     config = PluginConfig(
         resource_name=args.resource_name,
         node_name=args.node_name,

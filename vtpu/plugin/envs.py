@@ -35,6 +35,7 @@ ENV_ATTACH_WAIT = "VTPU_ATTACH_WAIT_MS"
 ENV_HEALTH_FILE = "VTPU_HEALTH_FILE"
 HEALTH_ERR_FILE = "health.err"  # inside the container's rw cache mount
 CHIPS_FILE = "chips"  # host-side: uuids assigned to this container's region dir
+HOST_CHIPS_FILE = "chips.json"  # host-side: the plugin's full chip inventory
 
 # --- Multi-host slice worker wiring (reference nvinternal/imex channel
 # injection; TPU-native: the JAX/libtpu runtime reads these to form the
@@ -58,6 +59,27 @@ CONTAINER_LIB_PATH = "/usr/local/vtpu/libvtpu.so"
 CONTAINER_PRELOAD_PATH = "/etc/ld.so.preload"
 CONTAINER_CACHE_DIR = "/tmp/vtpu"
 
+# Optional operator-provisioned license hook (reference server.go:712-724):
+# when <hook>/license exists it is mounted into every allocated container,
+# along with the validator binary if shipped alongside it.
+LICENSE_FILE = "license"
+VALIDATOR_BIN = "vtpuvalidator"
+CONTAINER_LICENSE_PATH = "/tmp/vtpu-license"
+CONTAINER_VALIDATOR_PATH = "/usr/bin/vtpuvalidator"
+
 
 def shared_region_dir(hook_path: str, pod_uid: str, container: str) -> str:
     return f"{hook_path}/{CONTAINERS_DIR}/{pod_uid}_{container}"
+
+
+def read_chips_file(region_dir: str) -> list[str]:
+    """Parse the plugin-written real-chip uuid list for a container's region
+    dir (single parser for the on-disk format: Allocate writes it, the
+    health watcher and the monitor's host metrics read it)."""
+    import os
+
+    try:
+        with open(os.path.join(region_dir, CHIPS_FILE)) as f:
+            return [u for u in f.read().strip().split(",") if u]
+    except OSError:
+        return []

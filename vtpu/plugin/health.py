@@ -26,6 +26,7 @@ import threading
 import time
 from typing import Callable, Optional
 
+from vtpu.plugin import envs
 from vtpu.plugin.rm import TpuResourceManager
 
 log = logging.getLogger(__name__)
@@ -105,10 +106,8 @@ class HealthWatcher:
             err = os.path.join(region_dir, "health.err")
             if not os.path.exists(err):
                 continue
-            try:
-                with open(os.path.join(region_dir, "chips")) as f:
-                    uuids = [u for u in f.read().strip().split(",") if u]
-            except OSError:
+            uuids = envs.read_chips_file(region_dir)
+            if not uuids:
                 continue
             health_dir = os.path.join(self.hook_path, "health")
             os.makedirs(health_dir, exist_ok=True)

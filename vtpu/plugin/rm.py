@@ -239,3 +239,38 @@ class TpuResourceManager:
         dynamic repartitioning to publish new geometry)."""
         for fn in list(self._health_listeners):
             fn()
+
+
+def write_host_inventory(rm: "TpuResourceManager", hook_path: str) -> str:
+    """Publish this host's chip inventory to ``<hook>/chips.json`` for the
+    monitor's host-level metric families (reference cmd/vGPUmonitor/
+    metrics.go:88-148 reads the host GPU view via NVML; the TPU analog is the
+    plugin's own discovery, shared over the hostPath hook dir).
+
+    Called at plugin startup and after every dynamic repartition (geometry
+    changes devmem/mode). Returns the path written.
+    """
+    import json
+
+    from vtpu.plugin import envs
+
+    path = os.path.join(hook_path, envs.HOST_CHIPS_FILE)
+    os.makedirs(hook_path, exist_ok=True)
+    payload = [
+        {
+            "uuid": c.uuid,
+            "index": c.index,
+            "devmem_mb": c.devmem,
+            "devcore": c.devcore,
+            "type": c.type,
+            "numa": c.numa,
+            "healthy": c.healthy,
+            "mode": c.mode or "",
+        }
+        for c in rm.chips
+    ]
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, path)  # atomic: the monitor never sees a torn file
+    return path

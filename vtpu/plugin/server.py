@@ -25,12 +25,14 @@ import grpc
 from vtpu.device import codec
 from vtpu.device.types import ContainerDevices
 from vtpu.plugin import envs, partition
+from vtpu.plugin import rm as rm_mod
 from vtpu.plugin.api import deviceplugin_pb2 as pb
 from vtpu.plugin.api import grpc_api
 from vtpu.plugin.rm import TpuResourceManager
 from vtpu.util import nodelock
 from vtpu.util import types as t
 from vtpu.util.helpers import (
+    gang_rank,
     get_pending_pod,
     pod_allocation_failed,
     pod_allocation_try_success,
@@ -222,6 +224,8 @@ class TpuDevicePlugin:
             partition.apply_partitions(
                 self.rm, plans, partition.lock_dir_for(self.config.hook_path)
             )
+            # republish the host inventory: geometry (devmem/mode) changed
+            rm_mod.write_host_inventory(self.rm, self.config.hook_path)
 
         responses = []
         consumed: list[int] = []
@@ -327,6 +331,21 @@ class TpuDevicePlugin:
                     read_only=True,
                 ),
             ]
+        # Optional operator-provisioned license + validator hook (reference
+        # server.go:712-724): if the host hook dir carries a license file,
+        # surface it (and the validator, if shipped) inside the container.
+        license_host = f"{cfg.hook_path}/{envs.LICENSE_FILE}"
+        if os.path.exists(license_host):
+            mounts.append(pb.Mount(
+                container_path=envs.CONTAINER_LICENSE_PATH,
+                host_path=license_host, read_only=True,
+            ))
+            validator_host = f"{cfg.hook_path}/{envs.VALIDATOR_BIN}"
+            if os.path.exists(validator_host):
+                mounts.append(pb.Mount(
+                    container_path=envs.CONTAINER_VALIDATOR_PATH,
+                    host_path=validator_host, read_only=True,
+                ))
         return pb.ContainerAllocateResponse(
             envs=env, mounts=mounts, devices=device_specs, cdi_devices=cdi_devices
         )
@@ -337,20 +356,45 @@ class TpuDevicePlugin:
         the cross-host ICI ring, MEGASCALE_* for multislice DCN jobs."""
         annos = pod_annotations(pod)
         sl = self.config.slice_info
-        if not slice_workers(pod) or sl is None:
+        workers = slice_workers(pod)
+        if not workers or sl is None:
             return {}
         labels = pod.get("metadata", {}).get("labels") or {}
-        worker_id = str(sl.worker_id)
+        # TPU_WORKER_ID must index TPU_WORKER_HOSTNAMES, so the rank source
+        # is decided WITH the hostnames source:
+        #   - pod-side hostnames annotation (ordered by the gang's own
+        #     ranks): Job completion index > scheduler-assigned gang rank >
+        #     physical slice rank;
+        #   - host-env slice list (PHYSICAL slice order) — only valid when
+        #     the gang covers its slice exactly, and only the node's own
+        #     physical rank indexes it correctly;
+        #   - larger-slice fallback without the annotation: omit the list
+        #     (a slice-wide list would misaddress libtpu's cross-host init)
+        #     and use the gang-own rank.
+        rank = gang_rank(pod)
+        gang_own = str(rank) if rank >= 0 else ""
         for key in t.COMPLETION_INDEX_LABELS:
             if labels.get(key, "") != "":
-                worker_id = labels[key]
+                gang_own = labels[key]
                 break
+        hostnames = annos.get(t.WORKER_HOSTNAMES_ANNO, "")
+        if hostnames:
+            worker_id = gang_own or str(sl.worker_id)
+        elif sl.num_workers == workers:
+            worker_id = str(sl.worker_id)
+            hostnames = os.environ.get(envs.ENV_WORKER_HOSTNAMES, "")
+        else:
+            worker_id = gang_own or str(sl.worker_id)
+            log.warning(
+                "pod %s/%s: gang of %d on a %d-host slice without %s; "
+                "omitting TPU_WORKER_HOSTNAMES",
+                pod.get("metadata", {}).get("namespace", "default"),
+                pod.get("metadata", {}).get("name", ""),
+                workers, sl.num_workers, t.WORKER_HOSTNAMES_ANNO,
+            )
         env = {envs.ENV_WORKER_ID: worker_id}
         if sl.accel_type:
             env[envs.ENV_ACCELERATOR_TYPE] = sl.accel_type
-        hostnames = annos.get(t.WORKER_HOSTNAMES_ANNO, "") or os.environ.get(
-            envs.ENV_WORKER_HOSTNAMES, ""
-        )
         if hostnames:
             env[envs.ENV_WORKER_HOSTNAMES] = hostnames
         if sl.topology:

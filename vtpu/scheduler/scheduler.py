@@ -203,10 +203,14 @@ class Scheduler:
     # ----------------------------------------------------------------- usage
 
     def get_nodes_usage(
-        self, node_names: Optional[list[str]] = None
+        self, node_names: Optional[list[str]] = None,
+        exclude_uid: str = "",
     ) -> tuple[dict[str, dict[str, list[DeviceUsage]]], dict[str, NodeInfo]]:
         """Fresh usage snapshot per node: registered devices + scheduled-pod
-        replay (reference getNodesUsage:623-707)."""
+        replay (reference getNodesUsage:623-707). ``exclude_uid`` skips one
+        pod's replay: a Filter retry for a still-unbound pod supersedes its
+        previous decision, so counting that decision against the candidates
+        would spuriously reject the very node it came from."""
         node_infos = self.node_manager.list_nodes()
         usages: dict[str, dict[str, list[DeviceUsage]]] = {}
         for name, info in node_infos.items():
@@ -217,6 +221,8 @@ class Scheduler:
                 for vendor, devs in info.devices.items()
             }
         for pinfo in self.pod_manager.list_pods_info():
+            if exclude_uid and pinfo.uid == exclude_uid:
+                continue
             node_usage = usages.get(pinfo.node_id)
             if not node_usage:
                 continue
@@ -282,7 +288,7 @@ class Scheduler:
         pod: dict,
         node_infos: dict[str, NodeInfo],
         candidates: dict[str, dict[str, list[DeviceUsage]]],
-    ) -> tuple[list[dict[str, dict[str, list[DeviceUsage]]]], dict[str, str]]:
+    ) -> tuple[list[dict[str, dict[str, list[DeviceUsage]]]], dict[str, str], int]:
         """Multi-host slice gang placement (TPU-native analog of reference
         nvinternal/imex cross-node channels).
 
@@ -294,16 +300,20 @@ class Scheduler:
         this state for free.
 
         Returns candidate tiers in preference order (right-sized slices
-        first, larger slices as fallback) plus per-node exclusion reasons.
+        first, larger slices as fallback), per-node exclusion reasons, and
+        the gang-own rank to assign this worker (-1 for non-gang pods): the
+        smallest rank no member holds, so TPU_WORKER_ID stays in 0..N-1 even
+        on the larger-slice fallback tier and a re-filtered worker cannot
+        collide with ranks assigned after its first placement.
         """
         workers = slice_workers(pod)
         if not workers:
-            return [candidates], {}
+            return [candidates], {}, -1
         group = pod_group_name(pod)
         if not group:
             return [], {
                 n: f"{t.SLICE_WORKERS_ANNO} requires a pod-group marker" for n in candidates
-            }
+            }, -1
         ns = pod["metadata"].get("namespace", "default")
         # only slice-worker members count: a same-gang coordinator pod neither
         # pins the slice nor blacklists its host
@@ -316,11 +326,26 @@ class Scheduler:
             and p.uid != pod["metadata"].get("uid")
         ]
         used_hosts = {p.node_id for p in members}
-        gang_slices = {
-            node_infos[n].slice.slice_id
-            for n in used_hosts
-            if n in node_infos and node_infos[n].slice
-        }
+        used_ranks = {p.gang_rank for p in members if p.gang_rank >= 0}
+        rank = next(r for r in range(len(members) + 1) if r not in used_ranks)
+        # A member whose node's slice membership is unknown (node deregistered
+        # or its slice annotation vanished) must refuse placement like the
+        # spans-slices case: silently dropping it from the pin would let the
+        # next worker land on a DIFFERENT physical slice than the survivor.
+        unknown = sorted(
+            n for n in used_hosts if n not in node_infos or not node_infos[n].slice
+        )
+        if unknown:
+            log.warning(
+                "gang %s/%s has members on nodes with unknown slice membership "
+                "%s; refusing placement", ns, group, unknown,
+            )
+            return [], {
+                n: f"gang {group} member on node with unknown slice membership "
+                   f"({', '.join(unknown)})"
+                for n in candidates
+            }, -1
+        gang_slices = {node_infos[n].slice.slice_id for n in used_hosts}
         if len(gang_slices) > 1:
             # corrupted placement: refusing to widen the split is the only
             # safe move — surface it instead of picking a third slice
@@ -328,7 +353,7 @@ class Scheduler:
             return [], {
                 n: f"gang {group} already spans slices {sorted(gang_slices)}"
                 for n in candidates
-            }
+            }, -1
         pinned = next(iter(gang_slices)) if gang_slices else ""
 
         kept: dict[str, dict[str, list[DeviceUsage]]] = {}
@@ -361,8 +386,8 @@ class Scheduler:
             }
             rest = {n: u for n, u in kept.items() if n not in exact}
             if exact and rest:
-                return [exact, rest], failed
-        return [kept], failed
+                return [exact, rest], failed, rank
+        return [kept], failed, rank
 
     def _filter_locked(self, args: dict, pod: dict, requests) -> dict:
 
@@ -375,12 +400,16 @@ class Scheduler:
         else:
             node_names = args.get("NodeNames") or []
 
-        usages, node_infos = self.get_nodes_usage(node_names or None)
+        usages, node_infos = self.get_nodes_usage(
+            node_names or None, exclude_uid=pod["metadata"].get("uid", "")
+        )
         candidates = {n: u for n, u in usages.items() if not node_names or n in node_names}
         failed: dict[str, str] = {
             n: "no registered devices" for n in node_names if n not in candidates
         }
-        tiers, slice_failed = self._constrain_to_gang_slice(pod, node_infos, candidates)
+        tiers, slice_failed, gang_rank = self._constrain_to_gang_slice(
+            pod, node_infos, candidates
+        )
         failed.update(slice_failed)
         # Tiers are tried in preference order (e.g. right-sized slices before
         # larger ones); a tier whose nodes all fail falls through to the next.
@@ -407,6 +436,13 @@ class Scheduler:
             t.ASSIGNED_TIME: str(int(time.time())),
             t.BIND_PHASE: t.BIND_PHASE_ALLOCATING,
         }
+        if gang_rank >= 0:
+            # Gang-own worker rank for Allocate's TPU_WORKER_ID (annotations
+            # are the database: PodManager re-reads it after a restart).
+            patch[t.GANG_RANK_ANNO] = str(gang_rank)
+            pod.setdefault("metadata", {}).setdefault("annotations", {})[
+                t.GANG_RANK_ANNO
+            ] = str(gang_rank)
         for backend in DEVICES_MAP.values():
             backend.patch_annotations(pod, patch, winner.devices)
         # A Filter retry for a still-unbound pod must supersede, not stack on,

@@ -20,6 +20,7 @@ tenants each run one of these engines against their fractional chip share.
 from __future__ import annotations
 
 import dataclasses
+import logging
 import queue
 import threading
 from typing import Any, Callable, Optional
@@ -36,6 +37,8 @@ from vtpu.models.transformer import (
     prefill,
 )
 from vtpu.ops import causal_attention, rms_norm, rope_angles
+
+log = logging.getLogger(__name__)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -207,9 +210,16 @@ class ServingEngine:
     # ------------------------------------------------------------------ API
 
     def submit(self, tokens, max_new_tokens: int = 0) -> Request:
+        if self._stop.is_set():
+            raise RuntimeError("ServingEngine is stopped")
         req = Request(tokens=jnp.asarray(tokens, jnp.int32),
                       max_new_tokens=max_new_tokens or self.serving.max_new_tokens)
         self._pending.put(req)
+        if self._stop.is_set():
+            # raced with stop(): its drain may have missed this request; an
+            # extra end-of-stream sentinel is harmless, a missing one hangs
+            # the client in Request.stream()
+            req.out.put(None)
         return req
 
     def start(self) -> None:
@@ -220,6 +230,27 @@ class ServingEngine:
         self._stop.set()
         if self._thread:
             self._thread.join(timeout=10)
+            # _loop's finally owns the slot/queue cleanup; touching its state
+            # while it may still be mid-tick would re-create the hang. Only
+            # clean up here when the loop never ran.
+            if self._thread.is_alive():
+                log.warning("serving loop still running 10s after stop; "
+                            "its exit path will retire remaining requests")
+        else:
+            self._drain_all()
+
+    def _drain_all(self) -> None:
+        """End-of-stream for everyone still holding a Request: occupied slots
+        and queued waiters alike — a client blocked in Request.stream() must
+        observe the None sentinel, not hang on a dead engine."""
+        for slot in range(len(self._slot_req)):
+            self._retire(slot)
+        while True:
+            try:
+                req = self._pending.get_nowait()
+            except queue.Empty:
+                break
+            req.out.put(None)
 
     # ----------------------------------------------------------------- loop
 
@@ -256,15 +287,30 @@ class ServingEngine:
         self._slot_budget[slot] = 0
 
     def _loop(self) -> None:
+        try:
+            self._loop_body()
+        finally:
+            # the loop owns slot/queue state, so it also owns the shutdown
+            # sweep: every live Request gets its end-of-stream sentinel the
+            # moment the loop exits (stop() only waits, never mutates)
+            self._drain_all()
+
+    def _loop_body(self) -> None:
         b = self.serving.slots
         while not self._stop.is_set():
-            # 1. admission first: fill every idle slot that has a waiter
+            # 1. admission first: fill every idle slot that has a waiter.
+            # Cancelled waiters are skipped IN PLACE (inner loop) so they
+            # never cost an idle slot a decode tick.
             admitted = False
+            drained = False
             for slot in range(b):
-                if self._slot_req[slot] is None:
+                if drained:
+                    break
+                while self._slot_req[slot] is None:
                     try:
                         req = self._pending.get_nowait()
                     except queue.Empty:
+                        drained = True
                         break
                     if req.cancelled:
                         req.out.put(None)

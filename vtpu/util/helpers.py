@@ -51,6 +51,18 @@ def slice_workers(pod: dict) -> int:
     return n if n > 1 else 0
 
 
+def gang_rank(pod: dict) -> int:
+    """Scheduler-assigned gang-own worker rank (vtpu.io/gang-rank), or -1.
+    Assigned at Filter from the gang's own membership so TPU_WORKER_ID stays
+    in 0..N-1 even on the larger-slice fallback tier, where the node's
+    physical slice rank can be >= N or non-contiguous."""
+    try:
+        r = int(pod_annotations(pod).get(t.GANG_RANK_ANNO, "-1"))
+    except ValueError:
+        return -1
+    return r if r >= 0 else -1
+
+
 def all_containers(pod: dict) -> list[dict]:
     spec = pod.get("spec", {})
     return list(spec.get("containers") or [])

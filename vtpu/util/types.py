@@ -67,8 +67,14 @@ WORKER_HOSTNAMES_ANNO = "vtpu.io/worker-hostnames"  # -> TPU_WORKER_HOSTNAMES
 MEGASCALE_COORDINATOR_ANNO = "vtpu.io/megascale-coordinator"  # -> MEGASCALE_COORDINATOR_ADDRESS
 MEGASCALE_NUM_SLICES_ANNO = "vtpu.io/megascale-num-slices"  # -> MEGASCALE_NUM_SLICES
 MEGASCALE_SLICE_ID_ANNO = "vtpu.io/megascale-slice-id"  # -> MEGASCALE_SLICE_ID
-# Job-style completion index labels that pin a worker's rank (else the node's
-# own slice worker_id is used).
+# Gang-own worker rank, written by the scheduler at Filter time. The node's
+# physical slice rank (SliceInfo.worker_id) is only correct when the gang
+# covers its slice exactly; on the larger-slice fallback tier ranks can be
+# >= N or non-contiguous, so the scheduler assigns 0..N-1 from the gang's own
+# membership and Allocate prefers this for TPU_WORKER_ID.
+GANG_RANK_ANNO = "vtpu.io/gang-rank"
+# Job-style completion index labels that pin a worker's rank (preferred over
+# the gang-rank annotation; else the node's own slice worker_id is used).
 COMPLETION_INDEX_LABELS = (
     "batch.kubernetes.io/job-completion-index",
     "jobset.sigs.k8s.io/job-index",
