@@ -70,7 +70,17 @@ def _post(port: int, path: str, payload: dict) -> dict:
 
 
 def _pct(samples: list[float], q: float) -> float:
+    if len(samples) < 2:  # degenerate run: still report what we saw
+        return samples[0] if samples else 0.0
     return statistics.quantiles(samples, n=100)[int(q) - 1]
+
+
+def _stats_ms(samples: list[float]) -> dict:
+    return {
+        "p50": round(_pct(samples, 50) * 1e3, 2),
+        "p99": round(_pct(samples, 99) * 1e3, 2),
+        "mean": round(statistics.mean(samples) * 1e3, 2) if samples else 0.0,
+    }
 
 
 def _histogram_stats(port: int) -> dict:
@@ -152,16 +162,8 @@ def main() -> None:
         "failed": failed,
         "wall_seconds": round(wall, 2),
         "pods_per_second": round(a.pods / wall, 1),
-        "filter_ms": {
-            "p50": round(_pct(filter_s, 50) * 1e3, 2),
-            "p99": round(_pct(filter_s, 99) * 1e3, 2),
-            "mean": round(statistics.mean(filter_s) * 1e3, 2),
-        },
-        "bind_ms": {
-            "p50": round(_pct(bind_s, 50) * 1e3, 2),
-            "p99": round(_pct(bind_s, 99) * 1e3, 2),
-            "mean": round(statistics.mean(bind_s) * 1e3, 2),
-        },
+        "filter_ms": _stats_ms(filter_s),
+        "bind_ms": _stats_ms(bind_s),
         "histograms": _histogram_stats(server.port),
     }
     server.shutdown()

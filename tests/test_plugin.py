@@ -265,6 +265,13 @@ def test_allocate_exclusive_repartitions_chip(served_plugin):
     from vtpu.plugin.partition import lock_dir_for, lock_held
 
     assert not lock_held(lock_dir_for(config.hook_path))
+    # the host inventory was republished with the new geometry (the
+    # monitor's host-level families read it)
+    import json
+
+    with open(os.path.join(config.hook_path, envs.HOST_CHIPS_FILE)) as f:
+        inv = {c["uuid"]: c for c in json.load(f)}
+    assert inv[allocated[0].uuid]["mode"] == "exclusive"
     sched.stop()
 
 

@@ -170,5 +170,12 @@ def test_concurrent_gang_filters_one_worker_per_host():
         assert not errors, errors
         placed = [r[0] for r in results.values() if r]
         assert len(placed) == 4 and len(set(placed)) == 4, results
+        # gang-own ranks assigned under the same lock: exactly 0..3, no dupes
+        ranks = sorted(
+            int(client.get_pod("default", f"w{i}")["metadata"]["annotations"][
+                t.GANG_RANK_ANNO])
+            for i in range(4)
+        )
+        assert ranks == [0, 1, 2, 3], ranks
     finally:
         sched.stop()

@@ -129,9 +129,9 @@ class MonitorCollector(Collector):
             "Host view: chip HBM capacity", labels=hlabels,
         )
         h_core = GaugeMetricFamily(
-            "vtpu_host_core_utilization_ratio",
-            "Host view: summed TensorCore duty-cycle percent per chip",
-            labels=hlabels,
+            "vtpu_host_core_utilization_percent",
+            "Host view: summed TensorCore duty-cycle percent per chip "
+            "(>100 = oversubscribed)", labels=hlabels,
         )
         h_tenants = GaugeMetricFamily(
             "vtpu_host_chip_tenants",
@@ -155,7 +155,7 @@ class MonitorCollector(Collector):
         for uuid in sorted(set(used) | set(inventory) - {""}):
             lv = [uuid, self.node_name]
             h_used.add_metric(lv, used.get(uuid, 0))
-            h_core.add_metric(lv, min(core.get(uuid, 0), 100))
+            h_core.add_metric(lv, core.get(uuid, 0))
             h_tenants.add_metric(lv, tenants.get(uuid, 0))
             inv = inventory.get(uuid)
             if inv:

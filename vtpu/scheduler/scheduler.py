@@ -327,7 +327,14 @@ class Scheduler:
         ]
         used_hosts = {p.node_id for p in members}
         used_ranks = {p.gang_rank for p in members if p.gang_rank >= 0}
-        rank = next(r for r in range(len(members) + 1) if r not in used_ranks)
+        if len(used_ranks) == len(members):
+            rank = next(r for r in range(len(members) + 1) if r not in used_ranks)
+        else:
+            # A member without a rank annotation (placed by an older
+            # scheduler) will fall back to its PHYSICAL slice rank at
+            # Allocate; stamping gang-own ranks beside it could duplicate a
+            # worker id. Leave the whole gang on physical ranks instead.
+            rank = -1
         # A member whose node's slice membership is unknown (node deregistered
         # or its slice annotation vanished) must refuse placement like the
         # spans-slices case: silently dropping it from the pin would let the
