@@ -118,7 +118,58 @@ def bench_attention(b: int, s: int, h: int, dh: int, dtype, k_chain: int = 8) ->
     }
 
 
+def bench_decode(cfg: ModelConfig, b: int, prompt_len: int, steps: int,
+                 kv_bucket: int = 0) -> dict:
+    """Decode throughput + HBM-bandwidth utilization. Decode is
+    bandwidth-bound on TPU: every step streams the full weights (and the KV
+    cache) through HBM for one token per sequence, so the honest utilization
+    metric is bytes-moved / wall / peak-BW, not FLOPs."""
+    from vtpu.models import decode_step
+
+    params = jax.jit(lambda key: init_params(key, cfg))(jax.random.key(0))
+    jax.block_until_ready(params)
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(0, cfg.vocab, (b, prompt_len)), jnp.int32)
+    _, cache = jax.jit(lambda p, t: prefill(p, cfg, t))(params, tokens)
+    jax.block_until_ready(cache)
+
+    @jax.jit
+    def chained(params, cache, tok):
+        def body(carry, _):
+            cache, tok = carry
+            logits, cache = decode_step(params, cfg, cache, tok,
+                                        kv_bucket=kv_bucket)
+            return (cache, jnp.argmax(logits, -1).astype(jnp.int32)), None
+
+        (cache, tok), _ = jax.lax.scan(body, (cache, tok), None, length=steps)
+        return tok
+
+    sec = timed(chained, params, cache, tokens[:, -1])
+    param_bytes = sum(
+        x.size * x.dtype.itemsize for x in jax.tree_util.tree_leaves(params))
+    read_len = kv_bucket or cfg.max_seq
+    kv_bytes = (2 * cfg.n_layers * b * read_len * cfg.n_heads * cfg.head_dim
+                * jnp.dtype(cfg.dtype).itemsize)
+    bytes_per_step = param_bytes + kv_bytes
+    peak_bw = float(__import__("os").environ.get("VTPU_PEAK_HBM_BW", 819e9))
+    return {
+        "batch": b, "prompt_len": prompt_len, "steps": steps,
+        "kv_bucket": kv_bucket or cfg.max_seq,
+        "wall_ms": round(sec * 1e3, 2),
+        "ms_per_step": round(sec / steps * 1e3, 3),
+        "tokens_per_sec": round(b * steps / sec),
+        "param_bytes_mb": round(param_bytes / 1e6, 1),
+        "hbm_gb_per_sec": round(bytes_per_step * steps / sec / 1e9, 1),
+        "hbm_bw_utilization_percent": round(
+            100 * bytes_per_step * steps / sec / peak_bw, 1),
+    }
+
+
 def main() -> None:
+    # env vars are read before sitecustomize imports jax, so --cpu must go
+    # through jax.config (same trick as tests/conftest.py)
+    if "--cpu" in sys.argv:
+        jax.config.update("jax_platforms", "cpu")
     on_tpu = jax.default_backend() == "tpu"
     if on_tpu:
         cfg = ModelConfig(
@@ -140,7 +191,7 @@ def main() -> None:
         dtype = jnp.float32
 
     out = {"backend": jax.default_backend(), "peak_flops": PEAK_FLOPS,
-           "prefill": [], "attention": []}
+           "prefill": [], "attention": [], "decode": []}
     for b, s in shapes:
         r = bench_prefill(cfg, b, s, k_chain)
         out["prefill"].append(r)
@@ -149,6 +200,13 @@ def main() -> None:
         r = bench_attention(b, s, h, dh, dtype, k_chain)
         out["attention"].append(r)
         print("attention", r, flush=True)
+    # full-cache reads vs the serving engine's bucketed read window
+    decode_shapes = ([(8, 128, 64, 0), (8, 128, 64, 256), (32, 128, 64, 0),
+                      (32, 128, 64, 256)] if on_tpu else [(2, 32, 4, 0)])
+    for b, p, steps, bkt in decode_shapes:
+        r = bench_decode(cfg, b, p, steps, kv_bucket=bkt)
+        out["decode"].append(r)
+        print("decode", r, flush=True)
     if on_tpu:
         (ROOT / "MFU.json").write_text(json.dumps(out, indent=2) + "\n")
 

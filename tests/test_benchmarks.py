@@ -82,8 +82,9 @@ def test_mfu_bench_cpu_smoke():
     """MFU harness runs end to end on the CPU mesh (numbers meaningless off
     TPU; the real-chip artifact is MFU.json)."""
     r = subprocess.run(
-        [sys.executable, str(ROOT / "benchmarks" / "mfu_bench.py")],
+        [sys.executable, str(ROOT / "benchmarks" / "mfu_bench.py"), "--cpu"],
         capture_output=True, text=True, timeout=300,
     )
     assert r.returncode == 0, r.stderr
     assert "prefill" in r.stdout and "attention" in r.stdout
+    assert "decode" in r.stdout

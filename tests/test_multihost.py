@@ -219,6 +219,94 @@ def test_gang_rank_assigned_at_filter(cluster):
         t.GANG_RANK_ANNO] == "0"
 
 
+def test_gang_rank_repairs_unranked_members():
+    """A member placed by an older scheduler (no rank annotation) is repaired
+    at the next gang filter with its PHYSICAL slice rank — the worker id its
+    container already holds from Allocate's fallback — so a freshly stamped
+    gang rank can never collide with a live worker's env."""
+    client = fake_cluster({f"h{i}": v5e_devices(4, prefix=f"h{i}") for i in range(4)})
+    for i in range(4):
+        client.patch_node_annotations(
+            f"h{i}", {t.NODE_SLICE_ANNO: _slice_anno("fab", i, 4)})
+    sched = Scheduler(client)
+    register_tpu_backend(quota=sched.quota_manager)
+    sched.start(register_interval=3600)
+    try:
+        gang = {t.SLICE_WORKERS_ANNO: "4", **GANG}
+        ranked = client.put_pod(tpu_pod("w0", tpu=4,
+                                        annotations={**gang, t.GANG_RANK_ANNO: "0"}))
+        # legacy member on h2: its container runs with TPU_WORKER_ID=2
+        legacy = client.put_pod(tpu_pod("w1", tpu=4, annotations=dict(gang)))
+        sched.pod_manager.add_pod(ranked, "h0", {})
+        sched.pod_manager.add_pod(legacy, "h2", {})
+        pod = client.put_pod(tpu_pod("w2", tpu=4, annotations=dict(gang)))
+        r = sched.filter({"Pod": pod, "NodeNames": [f"h{i}" for i in range(4)]})
+        assert r["NodeNames"], r
+        ranks = {
+            name: client.get_pod("default", name)["metadata"]["annotations"].get(
+                t.GANG_RANK_ANNO)
+            for name in ("w0", "w1", "w2")
+        }
+        assert ranks["w0"] == "0"
+        assert ranks["w1"] == "2"  # repaired to the id it actually holds
+        assert ranks["w2"] == "1"  # smallest rank no live worker uses
+    finally:
+        sched.stop()
+
+
+def test_gang_rank_repair_respects_completion_index():
+    """A legacy member with a Job completion-index label runs with THAT id
+    (Allocate ranks by it above the physical rank), so repair must stamp the
+    label value, not the node's physical rank."""
+    client = fake_cluster({f"h{i}": v5e_devices(4, prefix=f"h{i}") for i in range(4)})
+    for i in range(4):
+        client.patch_node_annotations(
+            f"h{i}", {t.NODE_SLICE_ANNO: _slice_anno("fab", i, 4)})
+    sched = Scheduler(client)
+    register_tpu_backend(quota=sched.quota_manager)
+    sched.start(register_interval=3600)
+    try:
+        gang = {t.SLICE_WORKERS_ANNO: "4", **GANG}
+        legacy = tpu_pod("w0", tpu=4, annotations=dict(gang))
+        legacy["metadata"]["labels"] = {
+            "batch.kubernetes.io/job-completion-index": "3"}
+        legacy = client.put_pod(legacy)
+        sched.pod_manager.add_pod(legacy, "h2", {})  # physical rank 2, label 3
+        pod = client.put_pod(tpu_pod("w1", tpu=4, annotations=dict(gang)))
+        r = sched.filter({"Pod": pod, "NodeNames": [f"h{i}" for i in range(4)]})
+        assert r["NodeNames"], r
+        a0 = client.get_pod("default", "w0")["metadata"]["annotations"]
+        a1 = client.get_pod("default", "w1")["metadata"]["annotations"]
+        assert a0[t.GANG_RANK_ANNO] == "3"  # the id the container holds
+        assert a1[t.GANG_RANK_ANNO] == "0"
+    finally:
+        sched.stop()
+
+
+def test_gang_rank_refuses_unrepairable_legacy_member():
+    """A legacy member whose physical worker id is outside the gang's 0..N-1
+    (larger-slice placement) has no consistent id; the gang refuses further
+    placement instead of stamping ranks beside a broken live worker."""
+    client = fake_cluster({f"h{i}": v5e_devices(4, prefix=f"h{i}") for i in range(4)})
+    for i in range(4):
+        client.patch_node_annotations(
+            f"h{i}", {t.NODE_SLICE_ANNO: _slice_anno("fab", i, 4)})
+    sched = Scheduler(client)
+    register_tpu_backend(quota=sched.quota_manager)
+    sched.start(register_interval=3600)
+    try:
+        gang = {t.SLICE_WORKERS_ANNO: "2", **GANG}  # gang of 2 on a 4-host slice
+        legacy = client.put_pod(tpu_pod("w0", tpu=4, annotations=dict(gang)))
+        sched.pod_manager.add_pod(legacy, "h3", {})  # physical rank 3 >= N=2
+        pod = client.put_pod(tpu_pod("w1", tpu=4, annotations=dict(gang)))
+        r = sched.filter({"Pod": pod, "NodeNames": [f"h{i}" for i in range(4)]})
+        assert r["NodeNames"] == []
+        assert any("unrepairable worker id 3" in v
+                   for v in r["FailedNodes"].values()), r["FailedNodes"]
+    finally:
+        sched.stop()
+
+
 def test_member_on_unknown_slice_node_refuses_placement(cluster):
     """A gang member on a node whose slice membership vanished must refuse
     placement (like the spans-slices case), not silently stop pinning."""

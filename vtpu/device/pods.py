@@ -25,6 +25,7 @@ class PodInfo:
     group: str = ""  # gang-scheduling pod group (multi-host slice placement)
     slice_workers: int = 0  # >1: this pod is a multi-host slice worker
     gang_rank: int = -1  # scheduler-assigned gang-own worker rank (-1: none)
+    completion_index: int = -1  # job-controller rank label (-1: none)
 
     @property
     def key(self) -> str:
@@ -37,7 +38,12 @@ class PodManager:
         self._pods: dict[str, PodInfo] = {}
 
     def add_pod(self, pod: dict, node_id: str, devices: PodDevices) -> None:
-        from vtpu.util.helpers import gang_rank, pod_group_name, slice_workers
+        from vtpu.util.helpers import (
+            completion_index,
+            gang_rank,
+            pod_group_name,
+            slice_workers,
+        )
 
         meta = pod["metadata"]
         with self._lock:
@@ -54,6 +60,7 @@ class PodManager:
                 group=pod_group_name(pod),
                 slice_workers=slice_workers(pod),
                 gang_rank=gang_rank(pod),
+                completion_index=completion_index(pod),
             )
 
     def del_pod(self, pod: dict) -> None:

@@ -133,26 +133,43 @@ def prefill(
 
 
 def decode_step(
-    params: Params, cfg: ModelConfig, cache: dict[str, jax.Array], token: jax.Array
+    params: Params, cfg: ModelConfig, cache: dict[str, jax.Array], token: jax.Array,
+    kv_bucket: int = 0,
 ) -> tuple[jax.Array, dict[str, jax.Array]]:
-    """One autoregressive step. token: [B] int32. Static shapes throughout."""
+    """One autoregressive step. token: [B] int32. Static shapes throughout.
+
+    kv_bucket (static; 0 = max_seq) bounds the attention READS to the given
+    prefix of the cache — decode is HBM-bandwidth-bound, so callers that know
+    their sequences are short pass the smallest bucket covering them (the
+    serving engine does this per tick). Writes still land in the full cache.
+    """
     b = token.shape[0]
+    bucket = kv_bucket or cfg.max_seq
     cos, sin = rope_angles(cfg.max_seq, cfg.head_dim)
     positions = cache["len"][:, None]  # [B, 1]
     x = params["embed"][token[:, None]].astype(cfg.dtype)
     pos0 = cache["len"][0]  # uniform batch position (benchmark decodes in lockstep)
 
-    def layer(x, inp):
-        lp, layer_k, layer_v = inp
+    # fori_loop carrying the STACKED cache (not a scan stacking fresh
+    # per-layer outputs): the dynamic_update_slice aliases in place, so a
+    # step writes one token column instead of copying the whole cache —
+    # decode is bandwidth-bound and that copy dominated the step.
+    def layer(l, carry):
+        x, ks, vs = carry
+        lp = jax.tree_util.tree_map(lambda a: a[l], params["layers"])
         q, k, v = _qkv(cfg, lp, x, cos, sin, positions)
-        full_k = jax.lax.dynamic_update_slice(layer_k, k, (0, pos0, 0, 0))
-        full_v = jax.lax.dynamic_update_slice(layer_v, v, (0, pos0, 0, 0))
-        attn = causal_attention(q, full_k, full_v, kv_len=cache["len"] + 1)
+        ks = jax.lax.dynamic_update_slice(ks, k[None], (l, 0, pos0, 0, 0))
+        vs = jax.lax.dynamic_update_slice(vs, v[None], (l, 0, pos0, 0, 0))
+        k_view = jax.lax.dynamic_index_in_dim(ks, l, 0, keepdims=False)[:, :bucket]
+        v_view = jax.lax.dynamic_index_in_dim(vs, l, 0, keepdims=False)[:, :bucket]
+        attn = causal_attention(q, k_view, v_view, kv_len=cache["len"] + 1)
         x = x + attn.reshape(b, 1, cfg.qkv_dim) @ lp["wo"]
         x = x + _mlp_block(lp, x)
-        return x, (full_k, full_v)
+        return x, ks, vs
 
-    x, (new_ks, new_vs) = jax.lax.scan(layer, x, (params["layers"], cache["k"], cache["v"]))
+    x, new_ks, new_vs = jax.lax.fori_loop(
+        0, cfg.n_layers, layer, (x, cache["k"], cache["v"])
+    )
     x = rms_norm(x, params["final_norm"])
     logits = (x[:, 0] @ params["embed"].T).astype(jnp.float32)
     new_cache = {"k": new_ks, "v": new_vs, "len": cache["len"] + 1}

@@ -326,15 +326,6 @@ class Scheduler:
             and p.uid != pod["metadata"].get("uid")
         ]
         used_hosts = {p.node_id for p in members}
-        used_ranks = {p.gang_rank for p in members if p.gang_rank >= 0}
-        if len(used_ranks) == len(members):
-            rank = next(r for r in range(len(members) + 1) if r not in used_ranks)
-        else:
-            # A member without a rank annotation (placed by an older
-            # scheduler) will fall back to its PHYSICAL slice rank at
-            # Allocate; stamping gang-own ranks beside it could duplicate a
-            # worker id. Leave the whole gang on physical ranks instead.
-            rank = -1
         # A member whose node's slice membership is unknown (node deregistered
         # or its slice annotation vanished) must refuse placement like the
         # spans-slices case: silently dropping it from the pin would let the
@@ -361,6 +352,70 @@ class Scheduler:
                 n: f"gang {group} already spans slices {sorted(gang_slices)}"
                 for n in candidates
             }, -1
+        # Members placed by an older scheduler carry no rank annotation, and
+        # their containers may ALREADY be running with the physical-slice
+        # rank that Allocate's fallback injected — an annotation patch can't
+        # change a live env. Repair therefore stamps each legacy member with
+        # its own PHYSICAL rank (the id it actually holds; also what its
+        # next restart would re-derive), so new members can only be assigned
+        # ranks no live worker uses. A legacy member whose physical rank is
+        # outside 0..N-1 (larger-slice placement) has no consistent id at
+        # all — refuse like the other corrupted-state cases. (Runs after the
+        # unknown-slice/spans-slices guards: both make physical ranks
+        # meaningless.)
+        unranked = sorted(
+            (p for p in members if p.gang_rank < 0),
+            key=lambda p: (p.namespace, p.name),
+        )
+        ranked = [p for p in members if p.gang_rank >= 0]
+        used_ranks = {p.gang_rank for p in ranked}
+        if len(used_ranks) != len(ranked):
+            # two members stamped the same rank (crash mid-assign): two live
+            # workers share a TPU_WORKER_ID — corrupted, refuse to extend
+            log.warning("gang %s/%s has duplicate ranks %s; refusing placement",
+                        ns, group, sorted(p.gang_rank for p in ranked))
+            return [], {
+                n: f"gang {group} members hold duplicate ranks; delete one"
+                for n in candidates
+            }, -1
+        for member in unranked:
+            # the id the live container actually holds: completion-index
+            # label first (Allocate ranks by it above everything), else the
+            # physical slice rank its env fallback used
+            repair = member.completion_index
+            if repair < 0:
+                repair = node_infos[member.node_id].slice.worker_id
+            if repair >= workers or repair in used_ranks:
+                log.warning(
+                    "gang %s/%s: legacy member %s holds physical worker id "
+                    "%d (gang size %d, taken ranks %s); refusing placement",
+                    ns, group, member.key, repair, workers, sorted(used_ranks),
+                )
+                return [], {
+                    n: f"gang {group} member {member.key} holds an "
+                       f"unrepairable worker id {repair}; restart it"
+                    for n in candidates
+                }, -1
+            try:
+                self.client.patch_pod_annotations(
+                    member.namespace, member.name,
+                    {t.GANG_RANK_ANNO: str(repair)},
+                )
+            except ApiError as e:
+                log.warning("gang %s/%s: cannot repair rank of member %s: %s",
+                            ns, group, member.key, e)
+                return [], {
+                    n: f"gang {group} member {member.key} lacks a rank and "
+                       "repair failed"
+                    for n in candidates
+                }, -1
+            log.info("gang %s/%s: repaired member %s -> physical rank %d",
+                     ns, group, member.key, repair)
+            member.gang_rank = repair
+            used_ranks.add(repair)
+        rank = next(
+            r for r in range(len(members) + 1) if r not in used_ranks
+        )
         pinned = next(iter(gang_slices)) if gang_slices else ""
 
         kept: dict[str, dict[str, list[DeviceUsage]]] = {}

@@ -63,6 +63,21 @@ def gang_rank(pod: dict) -> int:
     return r if r >= 0 else -1
 
 
+def completion_index(pod: dict) -> int:
+    """Job-controller completion index label value, or -1. Allocate ranks a
+    worker by this label ABOVE everything else, so any logic reasoning about
+    the rank a container actually holds must consult it first."""
+    labels = pod.get("metadata", {}).get("labels") or {}
+    for key in t.COMPLETION_INDEX_LABELS:
+        val = labels.get(key, "")
+        if val != "":
+            try:
+                return int(val)
+            except ValueError:
+                return -1
+    return -1
+
+
 def all_containers(pod: dict) -> list[dict]:
     spec = pod.get("spec", {})
     return list(spec.get("containers") or [])
