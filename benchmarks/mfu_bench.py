@@ -126,6 +126,11 @@ def bench_decode(cfg: ModelConfig, b: int, prompt_len: int, steps: int,
     metric is bytes-moved / wall / peak-BW, not FLOPs."""
     from vtpu.models import decode_step
 
+    # an undersized read window would silently drop freshly written tokens
+    # (decode_layer_loop never errors) and publish wrong bandwidth numbers
+    assert prompt_len + steps <= (kv_bucket or cfg.max_seq), (
+        prompt_len, steps, kv_bucket)
+
     params = jax.jit(lambda key: init_params(key, cfg))(jax.random.key(0))
     jax.block_until_ready(params)
     tokens = jnp.asarray(

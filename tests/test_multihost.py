@@ -85,9 +85,9 @@ def test_gang_overflow_fails_when_slice_full(cluster):
     _filter(sched, client, _worker("w1"))
     _, r3 = _filter(sched, client, _worker("w2"))
     assert r3["NodeNames"] == []
-    # hosts of the pinned slice are "already runs a worker", others are
-    # "pinned to" the gang's slice
-    assert any("already runs a worker" in v for v in r3["FailedNodes"].values())
+    # every rank 0..N-1 is held by a live member: the gang-full refusal
+    # fires before per-node reasons (stamping rank N would be out of range)
+    assert any("already has 2 live workers" in v for v in r3["FailedNodes"].values())
 
 
 def test_slice_workers_requires_pod_group(cluster):

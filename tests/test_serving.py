@@ -89,7 +89,7 @@ def test_slot_reuse_more_requests_than_slots(params):
 
 def test_oversized_prompt_rejected(params):
     eng = ServingEngine(params, CFG, ServingConfig(slots=1, prefill_buckets=(8,)))
-    with pytest.raises(ValueError, match="exceeds the largest bucket"):
+    with pytest.raises(ValueError, match="exceeds the largest usable bucket"):
         eng._bucket(9)
 
 

@@ -143,26 +143,50 @@ def decode_step(
     their sequences are short pass the smallest bucket covering them (the
     serving engine does this per tick). Writes still land in the full cache.
     """
+    pos0 = cache["len"][0]  # uniform batch position (benchmark decodes in lockstep)
+
+    def write_kv(l, ks, vs, k, v):
+        ks = jax.lax.dynamic_update_slice(ks, k[None], (l, 0, pos0, 0, 0))
+        vs = jax.lax.dynamic_update_slice(vs, v[None], (l, 0, pos0, 0, 0))
+        return ks, vs
+
+    logits, new_ks, new_vs = decode_layer_loop(
+        params, cfg, cache, token, kv_bucket, write_kv
+    )
+    new_cache = {"k": new_ks, "v": new_vs, "len": cache["len"] + 1}
+    return logits, new_cache
+
+
+def decode_layer_loop(
+    params: Params,
+    cfg: ModelConfig,
+    cache: dict[str, jax.Array],
+    token: jax.Array,
+    kv_bucket: int,
+    write_kv,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Shared decode-step body: a fori_loop carrying the STACKED cache (not a
+    scan stacking fresh per-layer outputs), so the cache write — supplied by
+    the caller as ``write_kv(l, ks, vs, k, v)`` (lockstep column update here,
+    per-slot scatter in the serving engine) — aliases in place instead of
+    copying the whole cache. Decode is bandwidth-bound and that copy
+    dominated the step. The read view is bounded to ``kv_bucket`` (static;
+    0 = max_seq). Returns (logits, new_ks, new_vs)."""
     b = token.shape[0]
     bucket = kv_bucket or cfg.max_seq
     cos, sin = rope_angles(cfg.max_seq, cfg.head_dim)
     positions = cache["len"][:, None]  # [B, 1]
     x = params["embed"][token[:, None]].astype(cfg.dtype)
-    pos0 = cache["len"][0]  # uniform batch position (benchmark decodes in lockstep)
+    kv_len = cache["len"] + 1
 
-    # fori_loop carrying the STACKED cache (not a scan stacking fresh
-    # per-layer outputs): the dynamic_update_slice aliases in place, so a
-    # step writes one token column instead of copying the whole cache —
-    # decode is bandwidth-bound and that copy dominated the step.
     def layer(l, carry):
         x, ks, vs = carry
         lp = jax.tree_util.tree_map(lambda a: a[l], params["layers"])
         q, k, v = _qkv(cfg, lp, x, cos, sin, positions)
-        ks = jax.lax.dynamic_update_slice(ks, k[None], (l, 0, pos0, 0, 0))
-        vs = jax.lax.dynamic_update_slice(vs, v[None], (l, 0, pos0, 0, 0))
+        ks, vs = write_kv(l, ks, vs, k, v)
         k_view = jax.lax.dynamic_index_in_dim(ks, l, 0, keepdims=False)[:, :bucket]
         v_view = jax.lax.dynamic_index_in_dim(vs, l, 0, keepdims=False)[:, :bucket]
-        attn = causal_attention(q, k_view, v_view, kv_len=cache["len"] + 1)
+        attn = causal_attention(q, k_view, v_view, kv_len=kv_len)
         x = x + attn.reshape(b, 1, cfg.qkv_dim) @ lp["wo"]
         x = x + _mlp_block(lp, x)
         return x, ks, vs
@@ -172,8 +196,7 @@ def decode_step(
     )
     x = rms_norm(x, params["final_norm"])
     logits = (x[:, 0] @ params["embed"].T).astype(jnp.float32)
-    new_cache = {"k": new_ks, "v": new_vs, "len": cache["len"] + 1}
-    return logits, new_cache
+    return logits, new_ks, new_vs
 
 
 def greedy_generate(

@@ -416,6 +416,15 @@ class Scheduler:
         rank = next(
             r for r in range(len(members) + 1) if r not in used_ranks
         )
+        if rank >= workers:
+            # every rank 0..N-1 is held by a live member (e.g. a replacement
+            # pod filtering while its terminating predecessor is still
+            # tracked): stamping N would put an out-of-range TPU_WORKER_ID
+            # on a sticky annotation — wait for the old member to go away
+            return [], {
+                n: f"gang {group} already has {workers} live workers"
+                for n in candidates
+            }, -1
         pinned = next(iter(gang_slices)) if gang_slices else ""
 
         kept: dict[str, dict[str, list[DeviceUsage]]] = {}

@@ -31,12 +31,10 @@ import jax.numpy as jnp
 from vtpu.models.transformer import (
     ModelConfig,
     Params,
-    _mlp_block,
-    _qkv,
+    decode_layer_loop,
     init_kv_cache,
     prefill,
 )
-from vtpu.ops import causal_attention, rms_norm, rope_angles
 
 log = logging.getLogger(__name__)
 
@@ -97,20 +95,10 @@ def batched_decode_step(
     still target the full cache — only the read view shrinks.
     """
     b = tokens.shape[0]
-    bucket = kv_bucket or cfg.max_seq
-    cos, sin = rope_angles(cfg.max_seq, cfg.head_dim)
     lens = cache["len"]
-    positions = lens[:, None]  # [B, 1] per-slot write position
-    x = params["embed"][tokens[:, None]].astype(cfg.dtype)
     rows = jnp.arange(b)
 
-    # fori_loop carrying the STACKED cache: the per-slot scatters alias in
-    # place, so a tick writes one token per live slot instead of copying the
-    # whole cache (the copy dominated the bandwidth-bound decode step).
-    def layer(l, carry):
-        x, ks, vs = carry
-        lp = jax.tree_util.tree_map(lambda a: a[l], params["layers"])
-        q, k, v = _qkv(cfg, lp, x, cos, sin, positions)
+    def write_kv(l, ks, vs, k, v):
         # per-slot scatter at (l, row, lens[row]); inactive rows keep old KV
         ks = ks.at[l, rows, lens].set(
             jnp.where(active[:, None, None], k[:, 0], ks[l, rows, lens])
@@ -118,18 +106,11 @@ def batched_decode_step(
         vs = vs.at[l, rows, lens].set(
             jnp.where(active[:, None, None], v[:, 0], vs[l, rows, lens])
         )
-        k_view = jax.lax.dynamic_index_in_dim(ks, l, 0, keepdims=False)[:, :bucket]
-        v_view = jax.lax.dynamic_index_in_dim(vs, l, 0, keepdims=False)[:, :bucket]
-        attn = causal_attention(q, k_view, v_view, kv_len=lens + 1)
-        x = x + attn.reshape(b, 1, cfg.qkv_dim) @ lp["wo"]
-        x = x + _mlp_block(lp, x)
-        return x, ks, vs
+        return ks, vs
 
-    x, new_ks, new_vs = jax.lax.fori_loop(
-        0, cfg.n_layers, layer, (x, cache["k"], cache["v"])
+    logits, new_ks, new_vs = decode_layer_loop(
+        params, cfg, cache, tokens, kv_bucket, write_kv
     )
-    x = rms_norm(x, params["final_norm"])
-    logits = (x[:, 0] @ params["embed"].T).astype(jnp.float32)
     new_cache = {
         "k": new_ks,
         "v": new_vs,
@@ -210,12 +191,16 @@ class ServingEngine:
             self.cache = jax.jit(
                 lambda: init_kv_cache(cfg, b), out_shardings=kv_cache_shardings(mesh)
             )()
+        # the cache is donated through both jits: the engine is its only
+        # holder and reassigns self.cache from the result, so XLA can alias
+        # input to output instead of copying the whole pool cache per call
         self._decode = jax.jit(
             lambda params, cache, tokens, active, kv_bucket: batched_decode_step(
                 cfg=cfg, params=params, cache=cache, tokens=tokens,
                 active=active, kv_bucket=kv_bucket,
             ),
             static_argnames=("kv_bucket",),
+            donate_argnums=(1,),
         )
         # decode read-buckets: one compiled executable per size, chosen per
         # tick from the longest LIVE sequence (decode bandwidth scales with
@@ -229,7 +214,8 @@ class ServingEngine:
         self._prefill = jax.jit(
             lambda params, cache, tokens, slot, true_len: prefill_into_slot(
                 params, cfg, cache, tokens, slot, true_len
-            )
+            ),
+            donate_argnums=(1,),
         )
         self._pending: "queue.Queue[Request]" = queue.Queue()
         self._slot_req: list[Optional[Request]] = [None] * b
@@ -287,11 +273,16 @@ class ServingEngine:
     # ----------------------------------------------------------------- loop
 
     def _bucket(self, n: int) -> int:
+        # candidates cap at max_seq: a bucket past it would prefill against
+        # out-of-range rope positions (and was never warmed)
+        limit = self.cfg.max_seq
         for b in self.serving.prefill_buckets:
-            if n <= b:
+            if b <= limit and n <= b:
                 return b
-        raise ValueError(f"prompt length {n} exceeds the largest bucket "
-                         f"{self.serving.prefill_buckets[-1]}")
+        raise ValueError(
+            f"prompt length {n} exceeds the largest usable bucket "
+            f"{min(self.serving.prefill_buckets[-1], limit)}"
+        )
 
     def _admit(self, slot: int, req: Request) -> None:
         prompt = req.tokens
@@ -320,11 +311,13 @@ class ServingEngine:
         self._slot_budget[slot] = 0
         self._slot_len[slot] = 0
 
-    def _warm_decode_buckets(self) -> None:
-        """Compile every decode bucket before serving: a first-use compile
-        mid-serving would stall every live stream for seconds at each bucket
-        boundary. Runs on the loop thread (start() stays fast); an all-
-        inactive tick neither advances lengths nor touches cache contents."""
+    def _warm_executables(self) -> None:
+        """Compile every decode and prefill bucket before serving: a
+        first-use compile mid-serving would stall every live stream for
+        seconds at each bucket boundary. Runs on the loop thread (start()
+        stays fast). The decode warm tick is all-inactive (advances nothing);
+        the prefill warm writes junk into slot 0's row, which is harmless —
+        no request occupies it and admission overwrites slot state."""
         b = self.serving.slots
         tokens = jnp.zeros((b,), jnp.int32)
         inactive = jnp.zeros((b,), bool)
@@ -332,10 +325,17 @@ class ServingEngine:
             _, self.cache = self._decode(
                 self.params, self.cache, tokens, inactive, bucket
             )
+        for bucket in self.serving.prefill_buckets:
+            if bucket > self.cfg.max_seq:
+                continue
+            _, self.cache = self._prefill(
+                self.params, self.cache, jnp.zeros((1, bucket), jnp.int32),
+                jnp.int32(0), jnp.int32(1),
+            )
 
     def _loop(self) -> None:
         try:
-            self._warm_decode_buckets()
+            self._warm_executables()
             self._loop_body()
         finally:
             # the loop owns slot/queue state, so it also owns the shutdown
