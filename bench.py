@@ -279,7 +279,7 @@ class Tenant:
 def main() -> None:
     wrap = wrap_available()
     log(f"stack-in-the-loop: wrap={'libvtpu' if wrap else 'UNAVAILABLE (plain)'}")
-    rounds, block = (3, 8) if wrap else (2, 3)
+    rounds, block = (4, 8) if wrap else (2, 3)
     shared_block = 6 if wrap else 2
 
     native = Tenant(rank=0, wrap=False, tag="native")
@@ -310,7 +310,9 @@ def main() -> None:
         base_ttfts: list[float] = []
         shared_ttfts: list[float] = []
         for _ in range(rounds):
-            base_ttfts += native.run_block(block // 2 or 1)["ttfts"]
+            # full-size baseline block: the degradation denominator deserves
+            # as many samples as the overhead windows (12 medians drift)
+            base_ttfts += native.run_block(block)["ttfts"]
             for i, s in enumerate(stacks):  # all 4 at once, staggered arrivals
                 s.start_block(shared_block, interval_ms, i * interval_ms / TENANTS)
             for s in stacks:

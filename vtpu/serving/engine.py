@@ -211,6 +211,16 @@ class ServingEngine:
         )
         use_buckets = serving.kv_read_buckets
         self._use_kv_buckets = b <= 16 if use_buckets is None else use_buckets
+        # prefill buckets past max_seq are unusable (out-of-range rope
+        # positions); sanitize once so every consumer agrees
+        self._prefill_buckets = tuple(
+            bkt for bkt in serving.prefill_buckets if bkt <= cfg.max_seq
+        )
+        if not self._prefill_buckets:
+            raise ValueError(
+                f"no prefill bucket fits max_seq={cfg.max_seq}: "
+                f"{serving.prefill_buckets}"
+            )
         self._prefill = jax.jit(
             lambda params, cache, tokens, slot, true_len: prefill_into_slot(
                 params, cfg, cache, tokens, slot, true_len
@@ -273,15 +283,12 @@ class ServingEngine:
     # ----------------------------------------------------------------- loop
 
     def _bucket(self, n: int) -> int:
-        # candidates cap at max_seq: a bucket past it would prefill against
-        # out-of-range rope positions (and was never warmed)
-        limit = self.cfg.max_seq
-        for b in self.serving.prefill_buckets:
-            if b <= limit and n <= b:
+        for b in self._prefill_buckets:
+            if n <= b:
                 return b
         raise ValueError(
             f"prompt length {n} exceeds the largest usable bucket "
-            f"{min(self.serving.prefill_buckets[-1], limit)}"
+            f"{self._prefill_buckets[-1]}"
         )
 
     def _admit(self, slot: int, req: Request) -> None:
@@ -325,9 +332,7 @@ class ServingEngine:
             _, self.cache = self._decode(
                 self.params, self.cache, tokens, inactive, bucket
             )
-        for bucket in self.serving.prefill_buckets:
-            if bucket > self.cfg.max_seq:
-                continue
+        for bucket in self._prefill_buckets:
             _, self.cache = self._prefill(
                 self.params, self.cache, jnp.zeros((1, bucket), jnp.int32),
                 jnp.int32(0), jnp.int32(1),
