@@ -88,9 +88,19 @@ def test_slot_reuse_more_requests_than_slots(params):
 
 
 def test_oversized_prompt_rejected(params):
-    eng = ServingEngine(params, CFG, ServingConfig(slots=1, prefill_buckets=(8,)))
-    with pytest.raises(ValueError, match="exceeds the largest usable bucket"):
-        eng._bucket(9)
+    """Raised to the SUBMITTER on its own thread — the serving loop must
+    survive and keep serving other clients."""
+    eng = ServingEngine(params, CFG, ServingConfig(
+        slots=1, prefill_buckets=(8,), max_new_tokens=2))
+    eng.start()
+    try:
+        with pytest.raises(ValueError, match="exceeds the largest usable bucket"):
+            eng.submit(list(range(9)))
+        # the loop is still alive and serves a valid request afterwards
+        out = list(eng.submit([1, 2, 3], max_new_tokens=2).stream())
+        assert len(out) == 2
+    finally:
+        eng.stop()
 
 
 def test_cancellation_frees_slot(params):
@@ -168,3 +178,83 @@ def test_request_stream_api():
     q.out.put(5)
     q.out.put(None)
     assert list(q.stream()) == [5]
+
+
+def test_ssm_prefill_state_matches_stepped_decode():
+    """ssm_prefill's scan-derived state equals stepping the recurrent decode
+    over the prompt, within platform matmul precision (the exactness claim
+    lives HERE, with tolerances — not as token equality, where a small
+    numeric gap could flip an argmax on another seed/backend)."""
+    import numpy as np
+
+    from vtpu.models.ssm import (
+        SSMConfig, init_ssm_params, init_ssm_state, ssm_decode_step,
+        ssm_prefill,
+    )
+
+    cfg = SSMConfig(vocab=96, d_model=32, n_layers=2, d_state=8,
+                    dtype=jnp.float32)
+    params = init_ssm_params(jax.random.key(3), cfg)
+    prompt = [int(t) % cfg.vocab for t in _prompt(7, 9)]
+    state = init_ssm_state(cfg, 1)
+    for t in prompt:
+        logits_ref, state = ssm_decode_step(
+            params, cfg, state, jnp.asarray([t], jnp.int32))
+    padded = jnp.zeros((1, 16), jnp.int32).at[0, :len(prompt)].set(
+        jnp.asarray(prompt))
+    logits_seq, state_pf = ssm_prefill(params, cfg, padded,
+                                       jnp.int32(len(prompt)))
+    np.testing.assert_allclose(np.asarray(state_pf["h"]),
+                               np.asarray(state["h"]), rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(state_pf["conv"]),
+                               np.asarray(state["conv"]), rtol=1e-3, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(logits_seq[0, len(prompt) - 1]),
+                               np.asarray(logits_ref[0]), rtol=1e-3, atol=1e-3)
+
+
+def test_ssm_slot_model_matches_recurrent_reference():
+    """The engine serves the selective-SSM family through its adapter: two
+    staggered slots must each reproduce the single-request composition of
+    the SAME prefill + recurrent-decode path exactly — this isolates the
+    engine machinery (slots, masking, streaming) from numeric path
+    differences, which the prefill-state test above bounds separately."""
+    from vtpu.models.ssm import (
+        SSMConfig, init_ssm_params, ssm_decode_step, ssm_prefill,
+    )
+    from vtpu.serving.adapters import SsmSlotModel
+
+    cfg = SSMConfig(vocab=96, d_model=32, n_layers=2, d_state=8,
+                    dtype=jnp.float32)
+    params = init_ssm_params(jax.random.key(3), cfg)
+
+    def reference(prompt, steps, bucket):
+        padded = jnp.zeros((1, bucket), jnp.int32).at[0, :len(prompt)].set(
+            jnp.asarray(prompt))
+        logits, state = ssm_prefill(params, cfg, padded,
+                                    jnp.int32(len(prompt)))
+        logits = logits[0, len(prompt) - 1]
+        out = []
+        for _ in range(steps):
+            tok = int(jnp.argmax(logits))
+            out.append(tok)
+            logits, state = ssm_decode_step(
+                params, cfg, state, jnp.asarray([tok], jnp.int32))
+            logits = logits[0]
+        return out
+
+    eng = ServingEngine(
+        serving=ServingConfig(slots=2, prefill_buckets=(8, 16),
+                              max_new_tokens=6),
+        model=SsmSlotModel(params, cfg),
+    )
+    eng.start()
+    try:
+        p1 = [int(t) % cfg.vocab for t in _prompt(11, 5)]
+        p2 = [int(t) % cfg.vocab for t in _prompt(12, 9)]
+        r1 = eng.submit(p1, max_new_tokens=6)
+        r2 = eng.submit(p2, max_new_tokens=6)
+        got1, got2 = list(r1.stream()), list(r2.stream())
+        assert got1 == reference(p1, 6, 8)
+        assert got2 == reference(p2, 6, 16)
+    finally:
+        eng.stop()

@@ -111,18 +111,44 @@ def _scan_states(a: jax.Array, b: jax.Array) -> jax.Array:
     return h  # [B,S,Di,N]
 
 
-def ssm_layer(cfg: SSMConfig, lp: dict[str, jax.Array], x: jax.Array) -> jax.Array:
-    """One selective-SSM block over a full sequence. x: [B, S, D]."""
+def ssm_layer(
+    cfg: SSMConfig, lp: dict[str, jax.Array], x: jax.Array,
+    state_at: jax.Array | None = None,
+):
+    """One selective-SSM block over a full sequence. x: [B, S, D].
+
+    With ``state_at`` (a position), also returns the recurrent decode state
+    at that position — (conv window [B, K-1, Di], h [B, Di, N]) — sharing
+    ONE implementation of the layer math with the training forward so the
+    serving prefill can never silently diverge from it.
+    """
+    b = x.shape[0]
+    k = cfg.d_conv
     normed = rms_norm(x, lp["norm"])
     xz = normed @ lp["in_proj"]
-    xi, z = jnp.split(xz, 2, axis=-1)  # [B,S,Di] each
-    xi = jax.nn.silu(_causal_conv(xi, lp["conv_w"]).astype(jnp.float32)).astype(x.dtype)
-    a, b, c = _selective_mix(lp, xi)
-    h = _scan_states(a, b)
+    xi_raw, z = jnp.split(xz, 2, axis=-1)  # [B,S,Di] each
+    xi = jax.nn.silu(
+        _causal_conv(xi_raw, lp["conv_w"]).astype(jnp.float32)
+    ).astype(x.dtype)
+    a, bb, c = _selective_mix(lp, xi)
+    h = _scan_states(a, bb)
     y = jnp.einsum("bsdn,bsn->bsd", h, c)  # readout
     y = y + xi.astype(jnp.float32) * lp["d_skip"].astype(jnp.float32)
     y = (y * jax.nn.silu(z.astype(jnp.float32))).astype(x.dtype)
-    return x + y @ lp["out_proj"]
+    out = x + y @ lp["out_proj"]
+    if state_at is None:
+        return out
+    # decode state at position state_at: padded position state_at maps to
+    # raw positions [state_at-K+1, state_at-1] — exactly the window
+    # ssm_decode_step expects before consuming token state_at
+    padded = jnp.pad(xi_raw, ((0, 0), (k - 1, 0), (0, 0)))
+    window = jax.lax.dynamic_slice(
+        padded, (0, state_at, 0), (b, k - 1, padded.shape[-1])
+    ).astype(cfg.dtype)
+    h_at = jax.lax.dynamic_slice(
+        h, (0, state_at - 1, 0, 0), (b, 1, h.shape[2], h.shape[3])
+    )[:, 0]
+    return out, (window, h_at)
 
 
 def ssm_forward(params: Params, cfg: SSMConfig, tokens: jax.Array) -> jax.Array:
@@ -141,6 +167,25 @@ def ssm_loss(params: Params, cfg: SSMConfig, tokens: jax.Array) -> jax.Array:
     from vtpu.ops.loss import next_token_ce
 
     return next_token_ce(ssm_forward(params, cfg, tokens), tokens)
+
+
+def ssm_prefill(
+    params: Params, cfg: SSMConfig, tokens: jax.Array, true_len: jax.Array
+) -> tuple[jax.Array, dict[str, jax.Array]]:
+    """Full-sequence forward that ALSO returns the recurrent decode state at
+    position ``true_len`` (serving: tokens is one right-padded [1, bucket]
+    prompt). The scan is causal, so padding past true_len cannot corrupt the
+    gathered state: h is read at true_len-1 and the conv window holds the
+    last d_conv-1 raw mixer inputs before true_len."""
+    x = params["embed"][tokens].astype(cfg.dtype)
+
+    def layer(x, lp):
+        return ssm_layer(cfg, lp, x, state_at=true_len)
+
+    x, (wins, hs) = jax.lax.scan(layer, x, params["layers"])
+    x = rms_norm(x, params["final_norm"])
+    logits = (x @ params["embed"].T).astype(jnp.float32)
+    return logits, {"conv": wins, "h": hs}
 
 
 # ---------------------------------------------------------------- O(1) decode

@@ -1,0 +1,122 @@
+"""Slot-model adapters: the contract between the continuous-batching engine
+and a model family.
+
+The reference middleware ships no data plane at all (SURVEY §2.6); vTPU's
+serving engine is model-agnostic so every family it schedules can also be
+served: the dense transformer (KV-cache decode, bounded read windows), and
+the selective SSM (O(1) recurrent state — no cache growth with context, the
+profile attention can't offer). An adapter owns the per-slot device state;
+the engine owns slots, admission, and streaming.
+
+Contract (all shapes static; the engine jits these with the state donated):
+  params                        pytree passed back into every call
+  max_context                   int cap on prompt+generation, or None
+  supports_kv_buckets           True if decode accepts a bounded read window
+  init_state(slots) -> state
+  prefill_into_slot(params, state, padded[1,bucket], slot, true_len)
+      -> (last_logits[V], state)
+  decode_step(params, state, tokens[B], active[B], kv_bucket) -> (logits, state)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class TransformerSlotModel:
+    """Dense transformer with a slot-pooled KV cache (vtpu/models/transformer).
+
+    With ``mesh`` (a ('tp',) Mesh), weights are tensor-parallel and the KV
+    cache shards its head axis — multi-chip serving with the same slot
+    machinery; XLA places the per-layer all-reduces on ICI.
+    """
+
+    supports_kv_buckets = True
+
+    def __init__(self, params: Any, cfg: Any, mesh: Optional[Any] = None):
+        self.cfg = cfg
+        self.mesh = mesh
+        self.max_context = cfg.max_seq
+        if mesh is None:
+            self.params = params
+        else:
+            from vtpu.parallel.sharding import shard_params
+
+            extra = {a: n for a, n in mesh.shape.items() if a != "tp" and n != 1}
+            if extra:
+                # decode ticks would replicate across every non-tp axis
+                # (dp, slice, ...) with zero throughput gain; slots are the
+                # batch axis and stay local
+                raise ValueError(
+                    f"serving mesh must be tp-only, got extra axes {extra}"
+                )
+            self.params = shard_params(params, mesh)
+
+    def init_state(self, slots: int):
+        from vtpu.models.transformer import init_kv_cache
+
+        if self.mesh is None:
+            return init_kv_cache(self.cfg, slots)
+        from vtpu.parallel.sharding import kv_cache_shardings
+
+        # allocate the cache directly sharded: a head-sharded cache that
+        # would not fit one chip must never be materialized unsharded
+        return jax.jit(
+            lambda: init_kv_cache(self.cfg, slots),
+            out_shardings=kv_cache_shardings(self.mesh),
+        )()
+
+    def prefill_into_slot(self, params, state, padded, slot, true_len):
+        from vtpu.serving.engine import prefill_into_slot
+
+        return prefill_into_slot(params, self.cfg, state, padded, slot, true_len)
+
+    def decode_step(self, params, state, tokens, active, kv_bucket):
+        from vtpu.serving.engine import batched_decode_step
+
+        return batched_decode_step(
+            cfg=self.cfg, params=params, cache=state, tokens=tokens,
+            active=active, kv_bucket=kv_bucket,
+        )
+
+
+class SsmSlotModel:
+    """Selective SSM (vtpu/models/ssm): O(1) per-slot recurrent state, so
+    there is no context cap and nothing for a read window to bound — decode
+    cost is independent of how long each sequence has run."""
+
+    supports_kv_buckets = False
+    max_context = None
+
+    def __init__(self, params: Any, cfg: Any):
+        self.params = params
+        self.cfg = cfg
+
+    def init_state(self, slots: int):
+        from vtpu.models.ssm import init_ssm_state
+
+        return init_ssm_state(self.cfg, slots)
+
+    def prefill_into_slot(self, params, state, padded, slot, true_len):
+        from vtpu.models.ssm import ssm_prefill
+
+        logits, row = ssm_prefill(params, self.cfg, padded, true_len)
+        new_state = {
+            "conv": state["conv"].at[:, slot].set(row["conv"][:, 0]),
+            "h": state["h"].at[:, slot].set(row["h"][:, 0]),
+        }
+        return logits[0, true_len - 1], new_state
+
+    def decode_step(self, params, state, tokens, active, kv_bucket):
+        from vtpu.models.ssm import ssm_decode_step
+
+        del kv_bucket  # O(1) state: nothing to window
+        logits, new = ssm_decode_step(params, self.cfg, state, tokens)
+        keep = active[None, :, None, None]
+        return logits, {
+            "conv": jnp.where(keep, new["conv"], state["conv"]),
+            "h": jnp.where(keep, new["h"], state["h"]),
+        }

@@ -32,7 +32,6 @@ from vtpu.models.transformer import (
     ModelConfig,
     Params,
     decode_layer_loop,
-    init_kv_cache,
     prefill,
 )
 
@@ -157,76 +156,57 @@ class ServingEngine:
 
     def __init__(
         self,
-        params: Params,
-        cfg: ModelConfig,
+        params: Params = None,
+        cfg: ModelConfig = None,
         serving: ServingConfig = ServingConfig(),
         sample: Optional[Callable[[jax.Array], int]] = None,
         mesh=None,
+        model=None,
     ):
-        """With *mesh* (a ('dp','tp') Mesh), weights are tensor-parallel over
-        'tp' and the KV cache shards its head axis — multi-chip serving with
-        the same slot machinery; XLA places the per-layer all-reduces on ICI.
+        """Pass either (params, cfg) for the default dense transformer —
+        with *mesh* (a ('tp',) Mesh) weights go tensor-parallel and the KV
+        cache shards its head axis — or ``model=`` with any SlotModel
+        adapter (vtpu/serving/adapters.py: transformer, selective SSM).
         """
-        self.params = params
-        self.cfg = cfg
+        if model is None:
+            from vtpu.serving.adapters import TransformerSlotModel
+
+            model = TransformerSlotModel(params, cfg, mesh=mesh)
+        self.model = model
+        self.params = model.params
+        self.cfg = getattr(model, "cfg", cfg)
         self.serving = serving
         self.sample = sample or (lambda logits: int(jnp.argmax(logits)))
         b = serving.slots
-        if mesh is None:
-            self.cache = init_kv_cache(cfg, b)
-        else:
-            from vtpu.parallel.sharding import kv_cache_shardings, shard_params
-
-            extra = {a: n for a, n in mesh.shape.items() if a != "tp" and n != 1}
-            if extra:
-                # decode ticks would replicate across every non-tp axis
-                # (dp, slice, ...) with zero throughput gain; slots are the
-                # batch axis and stay local
-                raise ValueError(
-                    f"serving mesh must be tp-only, got extra axes {extra}"
-                )
-            self.params = shard_params(params, mesh)
-            # allocate the cache directly sharded: a head-sharded cache that
-            # would not fit one chip must never be materialized unsharded
-            self.cache = jax.jit(
-                lambda: init_kv_cache(cfg, b), out_shardings=kv_cache_shardings(mesh)
-            )()
-        # the cache is donated through both jits: the engine is its only
-        # holder and reassigns self.cache from the result, so XLA can alias
-        # input to output instead of copying the whole pool cache per call
+        self.state = model.init_state(b)
+        # the state is donated through both jits: the engine is its only
+        # holder and reassigns self.state from the result, so XLA can alias
+        # input to output instead of copying the whole pool state per call
         self._decode = jax.jit(
-            lambda params, cache, tokens, active, kv_bucket: batched_decode_step(
-                cfg=cfg, params=params, cache=cache, tokens=tokens,
-                active=active, kv_bucket=kv_bucket,
-            ),
-            static_argnames=("kv_bucket",),
-            donate_argnums=(1,),
+            model.decode_step, static_argnames=("kv_bucket",), donate_argnums=(1,),
         )
+        self._prefill = jax.jit(model.prefill_into_slot, donate_argnums=(1,))
         # decode read-buckets: one compiled executable per size, chosen per
         # tick from the longest LIVE sequence (decode bandwidth scales with
-        # the read window, not max_seq)
+        # the read window, not the context cap)
+        ctx = model.max_context
         self._kv_buckets = tuple(
-            sorted({min(bkt, cfg.max_seq) for bkt in serving.prefill_buckets}
-                   | {cfg.max_seq})
-        )
+            sorted({min(bkt, ctx) for bkt in serving.prefill_buckets} | {ctx})
+        ) if ctx else (0,)
         use_buckets = serving.kv_read_buckets
+        if not model.supports_kv_buckets:
+            use_buckets = False
         self._use_kv_buckets = b <= 16 if use_buckets is None else use_buckets
-        # prefill buckets past max_seq are unusable (out-of-range rope
+        # prefill buckets past the context cap are unusable (out-of-range
         # positions); sanitize once so every consumer agrees
         self._prefill_buckets = tuple(
-            bkt for bkt in serving.prefill_buckets if bkt <= cfg.max_seq
+            bkt for bkt in serving.prefill_buckets if ctx is None or bkt <= ctx
         )
         if not self._prefill_buckets:
             raise ValueError(
-                f"no prefill bucket fits max_seq={cfg.max_seq}: "
+                f"no prefill bucket fits max_context={ctx}: "
                 f"{serving.prefill_buckets}"
             )
-        self._prefill = jax.jit(
-            lambda params, cache, tokens, slot, true_len: prefill_into_slot(
-                params, cfg, cache, tokens, slot, true_len
-            ),
-            donate_argnums=(1,),
-        )
         self._pending: "queue.Queue[Request]" = queue.Queue()
         self._slot_req: list[Optional[Request]] = [None] * b
         self._slot_budget = [0] * b
@@ -240,7 +220,12 @@ class ServingEngine:
     def submit(self, tokens, max_new_tokens: int = 0) -> Request:
         if self._stop.is_set():
             raise RuntimeError("ServingEngine is stopped")
-        req = Request(tokens=jnp.asarray(tokens, jnp.int32),
+        tokens = jnp.asarray(tokens, jnp.int32)
+        # validate HERE, on the caller's thread: an oversized prompt must
+        # raise to its submitter, not kill the serving loop (which would
+        # hang every other client forever)
+        self._bucket(int(tokens.shape[0]))
+        req = Request(tokens=tokens,
                       max_new_tokens=max_new_tokens or self.serving.max_new_tokens)
         self._pending.put(req)
         if self._stop.is_set():
@@ -296,13 +281,14 @@ class ServingEngine:
         n = int(prompt.shape[0])
         bucket = self._bucket(n)
         padded = jnp.zeros((1, bucket), jnp.int32).at[0, :n].set(prompt)
-        logits, self.cache = self._prefill(
-            self.params, self.cache, padded, jnp.int32(slot), jnp.int32(n)
+        logits, self.state = self._prefill(
+            self.params, self.state, padded, jnp.int32(slot), jnp.int32(n)
         )
         first = self.sample(logits)
         self._slot_req[slot] = req
         # the KV cache is a hard wall: never decode past max_seq
-        budget = min(req.max_new_tokens, self.cfg.max_seq - n)
+        ctx = self.model.max_context
+        budget = min(req.max_new_tokens, ctx - n) if ctx else req.max_new_tokens
         self._slot_budget[slot] = budget - 1
         self._tokens[slot] = first
         self._slot_len[slot] = n
@@ -329,12 +315,12 @@ class ServingEngine:
         tokens = jnp.zeros((b,), jnp.int32)
         inactive = jnp.zeros((b,), bool)
         for bucket in (self._kv_buckets if self._use_kv_buckets else (0,)):
-            _, self.cache = self._decode(
-                self.params, self.cache, tokens, inactive, bucket
+            _, self.state = self._decode(
+                self.params, self.state, tokens, inactive, bucket
             )
         for bucket in self._prefill_buckets:
-            _, self.cache = self._prefill(
-                self.params, self.cache, jnp.zeros((1, bucket), jnp.int32),
+            _, self.state = self._prefill(
+                self.params, self.state, jnp.zeros((1, bucket), jnp.int32),
                 jnp.int32(0), jnp.int32(1),
             )
 
@@ -398,12 +384,12 @@ class ServingEngine:
                 need = 1 + max(self._slot_len[i] for i in active_slots)
                 kv_bucket = next(
                     (bkt for bkt in self._kv_buckets if bkt >= need),
-                    self.cfg.max_seq,
+                    self.model.max_context,
                 )
             else:
                 kv_bucket = 0
-            logits, self.cache = self._decode(
-                self.params, self.cache, tokens, active, kv_bucket
+            logits, self.state = self._decode(
+                self.params, self.state, tokens, active, kv_bucket
             )
             for slot in active_slots:
                 tok = self.sample(logits[slot])
