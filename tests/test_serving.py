@@ -258,3 +258,92 @@ def test_ssm_slot_model_matches_recurrent_reference():
         assert got2 == reference(p2, 6, 16)
     finally:
         eng.stop()
+
+
+def test_moe_slot_model_serves_and_matches_prefill_path():
+    """The engine serves the MoE family through its adapter: slot decode with
+    the routed-expert FFN must match the single-request composition of
+    moe_prefill + the shared decode loop (engine machinery isolated from
+    numeric path differences, as with the SSM test)."""
+    from vtpu.models.moe import MoEConfig, init_moe_params, moe_prefill
+    from vtpu.models.transformer import decode_layer_loop
+    from vtpu.models.moe import moe_decode_ffn
+    from vtpu.serving.adapters import MoeSlotModel
+
+    cfg = MoEConfig(vocab=96, d_model=64, n_heads=2, n_layers=2, d_ff=64,
+                    n_experts=4, top_k=2, max_seq=32, head_dim=32,
+                    dtype=jnp.float32)
+    params = init_moe_params(jax.random.key(5), cfg)
+
+    def reference(prompt, steps, bucket):
+        padded = jnp.zeros((1, bucket), jnp.int32).at[0, :len(prompt)].set(
+            jnp.asarray(prompt))
+        logits, cache = moe_prefill(params, cfg, padded)
+        cache["len"] = jnp.asarray([len(prompt)], jnp.int32)
+        logits = logits[0, len(prompt) - 1]
+        out = []
+        for _ in range(steps):
+            tok = int(jnp.argmax(logits))
+            out.append(tok)
+            pos0 = cache["len"][0]
+
+            def write_kv(l, ks, vs, k, v):
+                ks = jax.lax.dynamic_update_slice(ks, k[None], (l, 0, pos0, 0, 0))
+                vs = jax.lax.dynamic_update_slice(vs, v[None], (l, 0, pos0, 0, 0))
+                return ks, vs
+
+            lg, ks, vs = decode_layer_loop(
+                params, cfg, cache, jnp.asarray([tok], jnp.int32), 0,
+                write_kv, ffn_fn=moe_decode_ffn(cfg))
+            cache = {"k": ks, "v": vs, "len": cache["len"] + 1}
+            logits = lg[0]
+        return out
+
+    eng = ServingEngine(
+        serving=ServingConfig(slots=2, prefill_buckets=(8, 16),
+                              max_new_tokens=5),
+        model=MoeSlotModel(params, cfg),
+    )
+    eng.start()
+    try:
+        p1 = [int(t) % cfg.vocab for t in _prompt(21, 5)]
+        p2 = [int(t) % cfg.vocab for t in _prompt(22, 9)]
+        r1 = eng.submit(p1, max_new_tokens=5)
+        r2 = eng.submit(p2, max_new_tokens=5)
+        got1, got2 = list(r1.stream()), list(r2.stream())
+        assert got1 == reference(p1, 5, 8)
+        assert got2 == reference(p2, 5, 16)
+    finally:
+        eng.stop()
+
+
+def test_moe_decode_isolated_from_retired_slots():
+    """Routing in a decode tick sees every slot's token — including stale
+    ones in retired slots. With the decode capacity override, a capacity
+    drop can never be triggered by garbage, so a request's tokens match its
+    solo run regardless of what previously occupied the other slots."""
+    from vtpu.models.moe import MoEConfig, init_moe_params
+    from vtpu.serving.adapters import MoeSlotModel
+
+    # tight routing: 2 experts, top-1-ish pressure via top_k=2 over 4 slots
+    cfg = MoEConfig(vocab=96, d_model=64, n_heads=2, n_layers=2, d_ff=64,
+                    n_experts=2, top_k=2, capacity_factor=1.0, max_seq=32,
+                    head_dim=32, dtype=jnp.float32)
+    params = init_moe_params(jax.random.key(6), cfg)
+    serving = ServingConfig(slots=4, prefill_buckets=(8,), max_new_tokens=6)
+    probe = [int(t) % cfg.vocab for t in _prompt(31, 6)]
+
+    def run(dirty: bool):
+        eng = ServingEngine(serving=serving, model=MoeSlotModel(params, cfg))
+        eng.start()
+        try:
+            if dirty:  # occupy + retire every slot, leaving stale tokens
+                warm = [eng.submit([(i * 7 + 1) % cfg.vocab] * 5,
+                                   max_new_tokens=3) for i in range(4)]
+                for w in warm:
+                    list(w.stream())
+            return list(eng.submit(probe, max_new_tokens=6).stream())
+        finally:
+            eng.stop()
+
+    assert run(dirty=True) == run(dirty=False)

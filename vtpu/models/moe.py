@@ -123,21 +123,39 @@ def expert_ffn(lp_e: dict[str, jax.Array], slots: jax.Array) -> jax.Array:
     return jnp.einsum("ecf,efd->ecd", act, lp_e["w_down"])
 
 
-def moe_ffn(lp: dict[str, jax.Array], x: jax.Array, cfg: MoEConfig) -> tuple[jax.Array, jax.Array]:
+def moe_ffn(lp: dict[str, jax.Array], x: jax.Array, cfg: MoEConfig,
+            capacity: int | None = None) -> tuple[jax.Array, jax.Array]:
     """Single-device (or annotation-sharded) MoE block. x: [B, S, D].
 
     With `w_gate`/`w_up`/`w_down` sharded P('ep') on the expert axis, XLA turns
     the dispatch/combine einsums into all-to-alls over 'ep' by itself -- the
-    pjit path. Returns (out [B, S, D], aux_loss).
+    pjit path. ``capacity`` overrides the config formula (serving decode
+    passes the full token count so routing can never drop a token).
+    Returns (out [B, S, D], aux_loss).
     """
     b, s, d = x.shape
     flat = x.reshape(b * s, d)
-    cap = cfg.capacity(b * s)
+    cap = capacity or cfg.capacity(b * s)
     dispatch, combine, aux = route(lp["router"], flat, cfg, cap)
     slots = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), flat)  # [E, C, D]
     out_slots = expert_ffn(lp, slots)
     out = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), out_slots)
     return out.reshape(b, s, d), aux
+
+
+def _moe_layer(cfg: MoEConfig, lp, x, cos, sin, positions, ffn):
+    """One MoE decoder block over a full sequence: the SINGLE copy of the
+    attention trunk shared by the training forward (moe_forward) and the
+    serving prefill (moe_prefill). Returns (out, aux, (k, v))."""
+    b, s, _ = x.shape
+    h, dh = cfg.n_heads, cfg.head_dim
+    normed = rms_norm(x, lp["attn_norm"])
+    q = apply_rope((normed @ lp["wq"]).reshape(b, s, h, dh), cos, sin, positions)
+    k = apply_rope((normed @ lp["wk"]).reshape(b, s, h, dh), cos, sin, positions)
+    v = (normed @ lp["wv"]).reshape(b, s, h, dh)
+    x = x + causal_attention(q, k, v).reshape(b, s, cfg.qkv_dim) @ lp["wo"]
+    moe_out, aux = ffn(lp, rms_norm(x, lp["mlp_norm"]), cfg)
+    return x + moe_out, aux, (k, v)
 
 
 def moe_forward(
@@ -155,14 +173,8 @@ def moe_forward(
 
     def layer(carry, lp):
         x, aux = carry
-        h, dh = cfg.n_heads, cfg.head_dim
-        normed = rms_norm(x, lp["attn_norm"])
-        q = apply_rope((normed @ lp["wq"]).reshape(b, s, h, dh), cos, sin, positions)
-        k = apply_rope((normed @ lp["wk"]).reshape(b, s, h, dh), cos, sin, positions)
-        v = (normed @ lp["wv"]).reshape(b, s, h, dh)
-        x = x + causal_attention(q, k, v).reshape(b, s, cfg.qkv_dim) @ lp["wo"]
-        moe_out, layer_aux = ffn(lp, rms_norm(x, lp["mlp_norm"]), cfg)
-        return (x + moe_out, aux + layer_aux), None
+        out, layer_aux, _kv = _moe_layer(cfg, lp, x, cos, sin, positions, ffn)
+        return (out, aux + layer_aux), None
 
     (x, aux), _ = jax.lax.scan(layer, (x, jnp.float32(0.0)), params["layers"])
     x = rms_norm(x, params["final_norm"])
@@ -176,3 +188,51 @@ def moe_loss(params: Params, cfg: MoEConfig, tokens: jax.Array, ffn=moe_ffn) -> 
 
     logits, aux = moe_forward(params, cfg, tokens, ffn=ffn)
     return next_token_ce(logits, tokens) + 0.01 * aux
+
+
+# ------------------------------------------------------------------ serving
+
+
+def moe_decode_ffn(cfg: MoEConfig):
+    """The post-attention block for the shared decode trunk
+    (transformer.decode_layer_loop): routed experts instead of the dense
+    MLP; the aux load-balancing term is a training loss, dropped here."""
+
+    def ffn(lp, x):
+        # capacity = the full token count: decode routes every slot's token
+        # jointly (including retired slots' stale ones), and a capacity
+        # drop triggered by garbage would zero a LIVE slot's expert output —
+        # with capacity >= tokens, routing can never drop anyone
+        out, _aux = moe_ffn(lp, rms_norm(x, lp["mlp_norm"]), cfg,
+                            capacity=x.shape[0])
+        return out
+
+    return ffn
+
+
+def moe_prefill(
+    params: Params, cfg: MoEConfig, tokens: jax.Array
+) -> tuple[jax.Array, dict[str, jax.Array]]:
+    """Full-sequence forward that also fills a KV cache — the serving-side
+    sibling of moe_forward (same trunk, same expert routing; the aux term is
+    dropped). tokens: [B, S] -> (logits [B, S, V], cache)."""
+    from vtpu.models.transformer import init_kv_cache
+
+    b, s = tokens.shape
+    cos, sin = rope_angles(cfg.max_seq, cfg.head_dim)
+    positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+    x = params["embed"][tokens].astype(cfg.dtype)
+
+    def layer(x, lp):
+        out, _aux, kv = _moe_layer(cfg, lp, x, cos, sin, positions, moe_ffn)
+        return out, kv
+
+    x, (ks, vs) = jax.lax.scan(layer, x, params["layers"])
+    x = rms_norm(x, params["final_norm"])
+    logits = (x @ params["embed"].T).astype(jnp.float32)
+
+    cache = init_kv_cache(cfg, b)
+    cache["k"] = jax.lax.dynamic_update_slice(cache["k"], ks, (0, 0, 0, 0, 0))
+    cache["v"] = jax.lax.dynamic_update_slice(cache["v"], vs, (0, 0, 0, 0, 0))
+    cache["len"] = jnp.full((b,), s, jnp.int32)
+    return logits, cache

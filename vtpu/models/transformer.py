@@ -164,6 +164,7 @@ def decode_layer_loop(
     token: jax.Array,
     kv_bucket: int,
     write_kv,
+    ffn_fn=None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Shared decode-step body: a fori_loop carrying the STACKED cache (not a
     scan stacking fresh per-layer outputs), so the cache write — supplied by
@@ -171,9 +172,12 @@ def decode_layer_loop(
     per-slot scatter in the serving engine) — aliases in place instead of
     copying the whole cache. Decode is bandwidth-bound and that copy
     dominated the step. The read view is bounded to ``kv_bucket`` (static;
-    0 = max_seq). Returns (logits, new_ks, new_vs)."""
+    0 = max_seq). ``ffn_fn(lp, x)`` swaps the post-attention block (dense
+    MLP here; routed experts for the MoE family — both share this attention
+    trunk). Returns (logits, new_ks, new_vs)."""
     b = token.shape[0]
     bucket = kv_bucket or cfg.max_seq
+    ffn = ffn_fn or _mlp_block
     cos, sin = rope_angles(cfg.max_seq, cfg.head_dim)
     positions = cache["len"][:, None]  # [B, 1]
     x = params["embed"][token[:, None]].astype(cfg.dtype)
@@ -188,7 +192,7 @@ def decode_layer_loop(
         v_view = jax.lax.dynamic_index_in_dim(vs, l, 0, keepdims=False)[:, :bucket]
         attn = causal_attention(q, k_view, v_view, kv_len=kv_len)
         x = x + attn.reshape(b, 1, cfg.qkv_dim) @ lp["wo"]
-        x = x + _mlp_block(lp, x)
+        x = x + ffn(lp, x)
         return x, ks, vs
 
     x, new_ks, new_vs = jax.lax.fori_loop(

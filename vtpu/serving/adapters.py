@@ -83,6 +83,44 @@ class TransformerSlotModel:
         )
 
 
+class MoeSlotModel:
+    """Expert-parallel MoE (vtpu/models/moe): the transformer attention
+    trunk with routed experts as the post-attention block, so it shares the
+    slot-KV-cache machinery (including bounded decode read windows) and only
+    swaps the FFN into the shared decode loop."""
+
+    supports_kv_buckets = True
+
+    def __init__(self, params: Any, cfg: Any):
+        self.params = params
+        self.cfg = cfg
+        self.max_context = cfg.max_seq
+
+    def init_state(self, slots: int):
+        from vtpu.models.transformer import init_kv_cache
+
+        return init_kv_cache(self.cfg, slots)
+
+    def prefill_into_slot(self, params, state, padded, slot, true_len):
+        from vtpu.models.moe import moe_prefill
+        from vtpu.serving.engine import prefill_into_slot
+
+        return prefill_into_slot(
+            params, self.cfg, state, padded, slot, true_len,
+            prefill_fn=moe_prefill,
+        )
+
+    def decode_step(self, params, state, tokens, active, kv_bucket):
+        from vtpu.models.moe import moe_decode_ffn
+        from vtpu.serving.engine import batched_decode_step
+
+        return batched_decode_step(
+            cfg=self.cfg, params=params, cache=state, tokens=tokens,
+            active=active, kv_bucket=kv_bucket,
+            ffn_fn=moe_decode_ffn(self.cfg),
+        )
+
+
 class SsmSlotModel:
     """Selective SSM (vtpu/models/ssm): O(1) per-slot recurrent state, so
     there is no context cap and nothing for a read window to bound — decode

@@ -78,6 +78,7 @@ def batched_decode_step(
     tokens: jax.Array,
     active: jax.Array,
     kv_bucket: int = 0,
+    ffn_fn=None,
 ) -> tuple[jax.Array, dict[str, jax.Array]]:
     """One decode tick for the whole slot pool.
 
@@ -108,7 +109,7 @@ def batched_decode_step(
         return ks, vs
 
     logits, new_ks, new_vs = decode_layer_loop(
-        params, cfg, cache, tokens, kv_bucket, write_kv
+        params, cfg, cache, tokens, kv_bucket, write_kv, ffn_fn=ffn_fn
     )
     new_cache = {
         "k": new_ks,
@@ -125,14 +126,17 @@ def prefill_into_slot(
     tokens: jax.Array,
     slot: jax.Array,
     true_len: jax.Array,
+    prefill_fn=None,
 ) -> tuple[jax.Array, dict[str, jax.Array]]:
     """Prefill a [1, bucket] (right-padded) prompt and install it in *slot*.
 
     Causality makes right padding harmless: real positions never attend to
-    the pad tail, and decode masks the cache past true_len. Returns the first
+    the pad tail, and decode masks the cache past true_len. ``prefill_fn``
+    swaps the full-sequence forward (dense transformer default; the MoE
+    family passes moe_prefill — same cache contract). Returns the first
     generated token's logits ([vocab]) and the updated pool cache.
     """
-    logits, seq_cache = prefill(params, cfg, tokens)
+    logits, seq_cache = (prefill_fn or prefill)(params, cfg, tokens)
     # [L, 1, max_seq, H, Dh] -> the bucket's worth, written at (layer, slot, 0)
     s = tokens.shape[1]
     k = seq_cache["k"][:, 0, :s]
