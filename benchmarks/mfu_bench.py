@@ -170,6 +170,47 @@ def bench_decode(cfg: ModelConfig, b: int, prompt_len: int, steps: int,
     }
 
 
+def bench_ssm_decode(b: int, steps: int, on_tpu: bool) -> dict:
+    """Selective-SSM decode throughput: O(1) recurrent state, so tokens/sec
+    is independent of how long each sequence has run — the contrast point to
+    the transformer's cache-read-bound decode."""
+    from vtpu.models.ssm import (
+        SSMConfig, init_ssm_params, init_ssm_state, ssm_decode_step,
+    )
+
+    if on_tpu:
+        cfg = SSMConfig(vocab=8192, d_model=1024, n_layers=12, d_state=16,
+                        dtype=jnp.bfloat16)
+    else:
+        cfg = SSMConfig(vocab=256, d_model=64, n_layers=2, d_state=8,
+                        dtype=jnp.float32)
+    params = jax.jit(lambda k: init_ssm_params(k, cfg))(jax.random.key(0))
+    jax.block_until_ready(params)
+    state = init_ssm_state(cfg, b)
+    tok0 = jnp.zeros((b,), jnp.int32)
+
+    @jax.jit
+    def chained(params, state, tok):
+        def body(carry, _):
+            state, tok = carry
+            logits, state = ssm_decode_step(params, cfg, state, tok)
+            return (state, jnp.argmax(logits, -1).astype(jnp.int32)), None
+
+        (state, tok), _ = jax.lax.scan(body, (state, tok), None, length=steps)
+        return tok
+
+    sec = timed(chained, params, state, tok0)
+    param_bytes = sum(
+        x.size * x.dtype.itemsize for x in jax.tree_util.tree_leaves(params))
+    return {
+        "batch": b, "steps": steps,
+        "d_model": cfg.d_model, "n_layers": cfg.n_layers,
+        "ms_per_step": round(sec / steps * 1e3, 3),
+        "tokens_per_sec": round(b * steps / sec),
+        "param_bytes_mb": round(param_bytes / 1e6, 1),
+    }
+
+
 def main() -> None:
     # env vars are read before sitecustomize imports jax, so --cpu must go
     # through jax.config (same trick as tests/conftest.py)
@@ -212,6 +253,11 @@ def main() -> None:
         r = bench_decode(cfg, b, p, steps, kv_bucket=bkt)
         out["decode"].append(r)
         print("decode", r, flush=True)
+    out["ssm_decode"] = []
+    for b, steps in ([(8, 64), (32, 64)] if on_tpu else [(2, 4)]):
+        r = bench_ssm_decode(b, steps, on_tpu)
+        out["ssm_decode"].append(r)
+        print("ssm_decode", r, flush=True)
     if on_tpu:
         (ROOT / "MFU.json").write_text(json.dumps(out, indent=2) + "\n")
 

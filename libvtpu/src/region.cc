@@ -10,6 +10,7 @@
 #include <unistd.h>
 
 #include <atomic>
+#include <cstdlib>
 #include <cstring>
 
 #include "log.h"
@@ -151,8 +152,26 @@ bool Region::utilization_enforced() const {
 }
 
 // A monitor that has not touched its heartbeat for this long is presumed
-// dead; its stale block must not wedge the workload forever.
-static const uint64_t kGateStaleNs = 60ull * 1000000000ull;
+// dead; its stale block must not wedge the workload forever. Overridable
+// (ms) for tests; production keeps the 60s default, which the monitor's
+// --feedback-interval validation is pinned against.
+static uint64_t gate_stale_ns() {
+  static const uint64_t v = [] {
+    const char* e = getenv("VTPU_GATE_STALE_MS");
+    if (e != nullptr && *e != '\0') {
+      char* end = nullptr;
+      long ms = strtol(e, &end, 10);
+      if (end != nullptr && *end == '\0' && ms > 0) {
+        return (uint64_t)ms * 1000000ull;
+      }
+      // a silently-misparsed threshold either defeats the gate (too small)
+      // or hangs a test expecting a release (fallback to 60s) — say so
+      VTPU_WARN("ignoring malformed VTPU_GATE_STALE_MS=%s", e);
+    }
+    return 60ull * 1000000000ull;
+  }();
+  return v;
+}
 
 uint64_t Region::gate_wait(bool* forced) {
   *forced = false;
@@ -170,8 +189,8 @@ uint64_t Region::gate_wait(bool* forced) {
     // monitors never write one, so fall back to time-blocked-so-far.
     uint64_t hb = region_->monitor_heartbeat_ns;
     uint64_t now_rt = now_ns();
-    bool stale = hb != 0 ? (now_rt > hb && now_rt - hb > kGateStaleNs)
-                         : elapsed > kGateStaleNs;
+    bool stale = hb != 0 ? (now_rt > hb && now_rt - hb > gate_stale_ns())
+                         : elapsed > gate_stale_ns();
     if (stale) {
       *forced = true;
       break;

@@ -160,6 +160,45 @@ def test_gate_timeout_is_region_controlled(libvtpu_build, tmp_path):
     assert snap.gate_blocked_ns >= int(0.6e9), snap.gate_blocked_ns
 
 
+def test_gate_releases_when_monitor_heartbeat_goes_stale(libvtpu_build, tmp_path):
+    """A monitor that blocked a tenant and then CRASHED must not wedge the
+    workload forever: once its heartbeat goes stale the gate releases, and
+    the release is counted as forced (stale threshold shrunk via env for the
+    test; production default is 60s)."""
+    import os
+    import subprocess as sp
+    import time
+
+    from vtpu.monitor.region import RegionReader
+
+    region = tmp_path / "usage.cache"
+    env = dict(os.environ)
+    env.update({
+        "VTPU_REAL_LIBTPU": str(libvtpu_build / "fake_pjrt.so"),
+        "VTPU_SHARED_REGION": str(region),
+        "TPU_DEVICE_MEMORY_LIMIT_0": "64m",
+        "VTPU_GATE_STALE_MS": "400",
+    })
+    smoke = [str(libvtpu_build / "pjrt_smoke"), str(libvtpu_build / "libvtpu.so")]
+
+    r = sp.run([*smoke, "1", "1", "1"], env=env, capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    reader = RegionReader(str(region))
+    count0 = reader.read().devices[0].kernel_count
+
+    # the "monitor" blocks with a heartbeat already 1s old, then never
+    # heartbeats again (crashed); no gate timeout is set
+    reader.set_recent_kernel(-1)
+    reader.set_monitor_heartbeat(time.time_ns() - int(1e9))
+    reader.set_gate_timeout_ms(0)
+    r = sp.run([*smoke, "1", "1", "1"], env=env, capture_output=True,
+               text=True, timeout=30)
+    assert r.returncode == 0, r.stderr
+    snap = reader.read()
+    assert snap.devices[0].kernel_count == count0 + 1
+    assert snap.gate_forced_releases >= 1, snap.gate_forced_releases
+
+
 def test_attach_queueing_on_exclusive_runtime(libvtpu_build, tmp_path):
     """Multi-process tenancy fallback (docs/multitenancy.md): on a runtime
     that refuses a second concurrent attach, a busy-class Client_Create
