@@ -161,7 +161,8 @@ static uint64_t gate_stale_ns() {
     if (e != nullptr && *e != '\0') {
       char* end = nullptr;
       long ms = strtol(e, &end, 10);
-      if (end != nullptr && *end == '\0' && ms > 0) {
+      if (end != nullptr && *end == '\0' && ms > 0 &&
+          (uint64_t)ms <= UINT64_MAX / 1000000ull) {
         return (uint64_t)ms * 1000000ull;
       }
       // a silently-misparsed threshold either defeats the gate (too small)

@@ -73,8 +73,8 @@ def main() -> None:
         parser.error(f"hook path {args.hook_path} does not exist")
     if args.feedback_interval > 30:
         # libvtpu presumes a dead monitor after 60s without a heartbeat
-        # (libvtpu/src/region.cc kGateStaleNs); a slower loop would make every
-        # gated execute force-release as "stale monitor".
+        # (libvtpu/src/region.cc gate_stale_ns()); a slower loop would make
+        # every gated execute force-release as "stale monitor".
         parser.error("--feedback-interval must be <= 30s (libvtpu's 60s "
                      "monitor-liveness contract)")
 
