@@ -228,7 +228,13 @@ def test_multihost_gang_over_real_transports(monkeypatch, tmp_path):
             env = dict(resp.container_responses[0].envs)
             assert env["TPU_WORKER_HOSTNAMES"] == "train-0.hs,train-1.hs"
             assert env["TPU_ACCELERATOR_TYPE"] == "v5e-16"
-            assert env["TPU_WORKER_ID"] == ("0" if node == "mh-0" else "1")
+            # with the pod-side hostnames annotation, TPU_WORKER_ID is the
+            # GANG-OWN rank the scheduler stamped at Filter (placement
+            # order), independent of which physical host the worker landed
+            # on — it must index the annotation's hostname list
+            assert env["TPU_WORKER_ID"] == str(i)
+            annos = annotations(client.get_pod("default", f"train-{i}"))
+            assert annos[t.GANG_RANK_ANNO] == str(i)
         assert sorted(placed) == list(nodes)  # one worker per host
     finally:
         for s in servers:

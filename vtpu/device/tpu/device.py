@@ -84,6 +84,8 @@ class TpuDevices(Devices):
     def __init__(self, config: Optional[TpuConfig] = None, quota: Optional[QuotaManager] = None):
         self.config = config or TpuConfig()
         self.quota = quota
+        # case-folded once: checked per candidate device on the filter path
+        self._allowed_types_lower = [a.lower() for a in self.config.allowed_types]
 
     # ------------------------------------------------------------- identity
 
@@ -173,23 +175,33 @@ class TpuDevices(Devices):
         raw = annos.get(key, "")
         return [s.strip() for s in raw.split(",") if s.strip()]
 
-    def _check_uuid(self, annos: dict, dev: DeviceUsage) -> bool:
-        use = self._split_anno(annos, t.USE_DEVICE_UUID_ANNO)
+    def _selectors(self, annos: dict):
+        """Parse the four device-selector annotations ONCE per fit — they
+        were re-split per candidate device and dominated the filter profile
+        at 100-node scale."""
+        return (
+            self._split_anno(annos, t.USE_DEVICE_UUID_ANNO),
+            self._split_anno(annos, t.NO_USE_DEVICE_UUID_ANNO),
+            [u.lower() for u in self._split_anno(annos, t.USE_DEVICE_TYPE_ANNO)],
+            [u.lower() for u in self._split_anno(annos, t.NO_USE_DEVICE_TYPE_ANNO)],
+        )
+
+    def _check_uuid(self, selectors, dev: DeviceUsage) -> bool:
+        use, nouse = selectors[0], selectors[1]
         if use and dev.id not in use:
             return False
-        nouse = self._split_anno(annos, t.NO_USE_DEVICE_UUID_ANNO)
         return dev.id not in nouse
 
-    def _check_type(self, annos: dict, dev: DeviceUsage) -> bool:
-        if self.config.allowed_types and not any(
-            dev.type.lower().startswith(a.lower()) for a in self.config.allowed_types
+    def _check_type(self, selectors, dev: DeviceUsage) -> bool:
+        dev_type = dev.type.lower()
+        if self._allowed_types_lower and not any(
+            dev_type.startswith(a) for a in self._allowed_types_lower
         ):
             return False
-        use = self._split_anno(annos, t.USE_DEVICE_TYPE_ANNO)
-        if use and not any(dev.type.lower().startswith(u.lower()) for u in use):
+        use, nouse = selectors[2], selectors[3]
+        if use and not any(dev_type.startswith(u) for u in use):
             return False
-        nouse = self._split_anno(annos, t.NO_USE_DEVICE_TYPE_ANNO)
-        return not any(dev.type.lower().startswith(u.lower()) for u in nouse)
+        return not any(dev_type.startswith(u) for u in nouse)
 
     # ------------------------------------------------------------- scoring
 
@@ -236,6 +248,7 @@ class TpuDevices(Devices):
         pod_mode = annos.get(t.VTPU_MODE_ANNO, "").lower()
         exclusive_ask = request.coresreq == 100 or pod_mode == t.VTPU_MODE_EXCLUSIVE
         coresreq = 100 if exclusive_ask else request.coresreq
+        selectors = self._selectors(annos)
 
         for dev in devices:
             if exclusive_ask:
@@ -250,9 +263,9 @@ class TpuDevices(Devices):
                 memreq = 0
             if not dev.health:
                 reasons[common.CARD_UNHEALTHY] += 1
-            elif not self._check_type(annos, dev):
+            elif not self._check_type(selectors, dev):
                 reasons[common.CARD_TYPE_MISMATCH] += 1
-            elif not self._check_uuid(annos, dev):
+            elif not self._check_uuid(selectors, dev):
                 reasons[common.CARD_UUID_MISMATCH] += 1
             elif dev.used >= dev.count:
                 reasons[common.CARD_TIME_SLICING_EXHAUSTED] += 1

@@ -19,6 +19,12 @@ from vtpu.scheduler.policy import NodeScore
 from vtpu.util import types as t
 from vtpu.util.helpers import pod_annotations
 
+# One persistent pool for the per-node score fan-out: spawning a fresh
+# executor per Filter cost ~10 thread creations per call and showed up as
+# the top lock-contention entry in the 100-node profile. Filters are
+# serialized by the scheduler's atomic filter lock, so sharing is safe.
+_SCORE_POOL = ThreadPoolExecutor(max_workers=8, thread_name_prefix="vtpu-score")
+
 log = logging.getLogger(__name__)
 
 # vendor -> request, one dict per container
@@ -79,7 +85,6 @@ def calc_score(
     per_container_requests: list[ContainerRequests],
     node_policy: str = t.NODE_POLICY_BINPACK,
     device_policy: str = t.DEVICE_POLICY_BINPACK,
-    max_workers: int = 8,
 ) -> tuple[list[NodeScore], dict[str, str]]:
     """Score every candidate node for *pod*; returns (fitting nodes' scores,
     failure reason per failed node). Per-pod annotations override policies
@@ -120,8 +125,7 @@ def calc_score(
     if len(names) == 1:
         results = [score_node(names[0])]
     else:
-        with ThreadPoolExecutor(max_workers=min(max_workers, max(1, len(names)))) as ex:
-            results = list(ex.map(score_node, names))
+        results = list(_SCORE_POOL.map(score_node, names))
     for name, (ns, reason) in zip(names, results):
         if ns is None:
             failures[name] = reason
