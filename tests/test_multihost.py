@@ -371,14 +371,35 @@ def test_scheduler_restart_rederives_gang_state(cluster):
     first = r1["NodeNames"][0]
     sched.stop()
     sched2 = Scheduler(client)
-    sched2.start(register_interval=3600)
+    sched2.start(register_interval=3600)  # start() syncs existing pods
     try:
-        sched2.sync_existing_pods()
         pod = client.put_pod(_worker("w1"))
         r2 = sched2.filter({"Pod": pod, "NodeNames": list(ALL_NODES)})
         second = r2["NodeNames"][0]
         slice_of = {"a0": "s1", "a1": "s1", "b0": "s2", "b1": "s2"}
         assert second != first and slice_of[second] == slice_of[first]
+    finally:
+        sched2.stop()
+
+
+def test_scheduler_restart_rederives_gang_ranks(cluster):
+    """Annotations are the database: a fresh Scheduler reconstructs members'
+    gang ranks from their annotations, so the next worker gets the next free
+    rank instead of colliding after a restart."""
+    client, sched = cluster
+    _, r1 = _filter(sched, client, _worker("w0"))
+    assert r1["NodeNames"]
+    assert client.get_pod("default", "w0")["metadata"]["annotations"][
+        t.GANG_RANK_ANNO] == "0"
+    sched.stop()
+    sched2 = Scheduler(client)
+    sched2.start(register_interval=3600)  # start() syncs existing pods
+    try:
+        pod = client.put_pod(_worker("w1"))
+        r2 = sched2.filter({"Pod": pod, "NodeNames": list(ALL_NODES)})
+        assert r2["NodeNames"]
+        assert client.get_pod("default", "w1")["metadata"]["annotations"][
+            t.GANG_RANK_ANNO] == "1"
     finally:
         sched2.stop()
 
