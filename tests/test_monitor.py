@@ -139,6 +139,74 @@ def test_host_level_chip_metrics(hook):
                for s in metrics["vtpu_host_memory_used_bytes"].samples)
 
 
+def test_monitor_binary_end_to_end(hook, libvtpu_build):
+    """The real `python -m vtpu.monitor` binary over a hook dir with REAL
+    libvtpu-written regions: metrics served over HTTP, the feedback loop
+    blocks the low-priority tenant, and SIGTERM shuts down cleanly."""
+    import signal
+    import socket
+    import subprocess
+    import sys
+    import urllib.request
+
+    hook_path, dirs = hook
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "vtpu.monitor",
+         "--hook-path", str(hook_path), "--node-name", "n1",
+         "--metrics-port", str(port), "--feedback-interval", "0.2",
+         "--gate-timeout-ms", "0", "--no-gc"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    try:
+        def alive():
+            if proc.poll() is not None:
+                raise AssertionError(
+                    f"monitor died rc={proc.returncode}: "
+                    f"{proc.stderr.read()[-800:]}")
+
+        deadline = time.monotonic() + 30
+        body = ""
+        while time.monotonic() < deadline:
+            alive()
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/metrics", timeout=5) as r:
+                    body = r.read().decode()
+                if 'podUid="poda"' in body:
+                    break
+            except OSError:
+                pass
+            time.sleep(0.2)
+        assert 'vtpu_memory_limit_bytes{' in body, body[:500]
+        assert 'podUid="poda"' in body and 'podUid="podb"' in body
+        # FRESH high-priority activity now that the monitor is up (the
+        # census only counts kernels within a 10s window, so the fixture's
+        # earlier run may already be stale on a slow machine), then the
+        # feedback loop must block poda
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            alive()
+            _run_workload(libvtpu_build, dirs["podb"] / "usage.cache", 1)
+            reader = ContainerLister(str(hook_path)).update()
+            by = {e.pod_uid: e for e in reader}
+            if by["poda"].snapshot.recent_kernel == -1 and \
+                    by["poda"].snapshot.monitor_heartbeat_ns > 0:
+                break
+            time.sleep(0.3)
+        else:
+            raise AssertionError("binary's feedback loop never blocked poda")
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=15)
+        assert proc.returncode == 0, proc.stderr.read()[-500:]
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+
+
 def test_monitor_collector_legacy_aliases(hook):
     """--legacy-metrics publishes reference-compatible hami_* names so
     dashboards built for the reference keep working."""
