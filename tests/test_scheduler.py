@@ -237,3 +237,68 @@ def test_sidecar_before_device_container_keeps_slot_alignment(cluster):
     slots = codec_mod.decode_pod_single_device(anno)
     assert len(slots) == 2
     assert slots[0] == [] and len(slots[1]) == 1
+
+
+def test_scheduler_binary_fake_cluster_end_to_end():
+    """The real `python -m vtpu.scheduler --fake-cluster` binary: flags parse,
+    the HTTP extender serves /healthz + /filter + /metrics over a real socket,
+    and SIGTERM exits cleanly."""
+    import json
+    import signal
+    import socket
+    import subprocess
+    import sys
+    import time
+    import urllib.request
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "vtpu.scheduler", "--fake-cluster", "2",
+         "--port", str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    try:
+        def alive():
+            if proc.poll() is not None:
+                raise AssertionError(
+                    f"scheduler died rc={proc.returncode}: "
+                    f"{proc.stderr.read()[-800:]}")
+
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            alive()
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/healthz", timeout=5) as r:
+                    if r.status == 200:
+                        break
+            except OSError:
+                pass
+            time.sleep(0.2)
+        else:
+            raise AssertionError("scheduler never served /healthz")
+
+        pod = tpu_pod("bin-e2e", tpumem=2048)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/filter",
+            data=json.dumps({"Pod": pod, "NodeNames": ["tpu-node-0", "tpu-node-1"]}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            result = json.loads(r.read())
+        assert result["Error"] == "" and len(result["NodeNames"]) == 1, result
+
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+            metrics = r.read().decode()
+        assert "vtpu_scheduler_filter_seconds" in metrics
+
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=15)
+        assert proc.returncode == 0, proc.stderr.read()[-500:]
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
