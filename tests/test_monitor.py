@@ -199,8 +199,10 @@ def test_monitor_binary_end_to_end(hook, libvtpu_build):
         else:
             raise AssertionError("binary's feedback loop never blocked poda")
         proc.send_signal(signal.SIGTERM)
-        proc.wait(timeout=15)
-        assert proc.returncode == 0, proc.stderr.read()[-500:]
+        # communicate() drains the pipes: wait()+PIPE can deadlock if the
+        # child fills a 64 KiB pipe buffer during shutdown
+        _out, err = proc.communicate(timeout=15)
+        assert proc.returncode == 0, err[-500:]
     finally:
         if proc.poll() is None:
             proc.kill()

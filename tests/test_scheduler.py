@@ -296,8 +296,10 @@ def test_scheduler_binary_fake_cluster_end_to_end():
         assert "vtpu_scheduler_filter_seconds" in metrics
 
         proc.send_signal(signal.SIGTERM)
-        proc.wait(timeout=15)
-        assert proc.returncode == 0, proc.stderr.read()[-500:]
+        # communicate() drains the pipes: wait()+PIPE can deadlock if the
+        # child fills a 64 KiB pipe buffer during shutdown
+        _out, err = proc.communicate(timeout=15)
+        assert proc.returncode == 0, err[-500:]
     finally:
         if proc.poll() is None:
             proc.kill()
