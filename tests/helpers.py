@@ -61,3 +61,35 @@ def tpu_pod(name, tpu=None, tpumem=None, tpucores=None, ns="default", annotation
                      "annotations": dict(annotations or {})},
         "spec": {"containers": containers},
     }
+
+
+class BinaryUnderTest:
+    """Shared harness for binary-level e2e tests: spawn `python -m <module>`,
+    fail fast with the child's stderr if it dies, and drain pipes on
+    terminate (wait()+PIPE can deadlock on a full 64 KiB pipe buffer)."""
+
+    def __init__(self, module: str, args: list[str], env: dict | None = None):
+        import subprocess
+        import sys
+
+        self._sp = subprocess
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", module, *args], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+
+    def alive(self) -> None:
+        if self.proc.poll() is not None:
+            raise AssertionError(
+                f"binary died rc={self.proc.returncode}: "
+                f"{self.proc.stderr.read()[-800:]}")
+
+    def terminate(self, sig, timeout: float = 30.0, expect_rc: int = 0) -> None:
+        self.proc.send_signal(sig)
+        _out, err = self.proc.communicate(timeout=timeout)
+        assert self.proc.returncode == expect_rc, err[-800:]
+
+    def cleanup(self) -> None:
+        if self.proc.poll() is None:
+            self.proc.kill()
+            self.proc.communicate()

@@ -145,27 +145,21 @@ def test_monitor_binary_end_to_end(hook, libvtpu_build):
     blocks the low-priority tenant, and SIGTERM shuts down cleanly."""
     import signal
     import socket
-    import subprocess
-    import sys
     import urllib.request
+
+    from tests.helpers import BinaryUnderTest
 
     hook_path, dirs = hook
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
-    proc = subprocess.Popen(
-        [sys.executable, "-m", "vtpu.monitor",
-         "--hook-path", str(hook_path), "--node-name", "n1",
-         "--metrics-port", str(port), "--feedback-interval", "0.2",
-         "--gate-timeout-ms", "0", "--no-gc"],
-        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-    )
+    bin_ = BinaryUnderTest("vtpu.monitor", [
+        "--hook-path", str(hook_path), "--node-name", "n1",
+        "--metrics-port", str(port), "--feedback-interval", "0.2",
+        "--gate-timeout-ms", "0", "--no-gc",
+    ])
+    alive = bin_.alive
     try:
-        def alive():
-            if proc.poll() is not None:
-                raise AssertionError(
-                    f"monitor died rc={proc.returncode}: "
-                    f"{proc.stderr.read()[-800:]}")
 
         deadline = time.monotonic() + 30
         body = ""
@@ -198,15 +192,9 @@ def test_monitor_binary_end_to_end(hook, libvtpu_build):
             time.sleep(0.3)
         else:
             raise AssertionError("binary's feedback loop never blocked poda")
-        proc.send_signal(signal.SIGTERM)
-        # communicate() drains the pipes: wait()+PIPE can deadlock if the
-        # child fills a 64 KiB pipe buffer during shutdown
-        _out, err = proc.communicate(timeout=15)
-        assert proc.returncode == 0, err[-500:]
+        bin_.terminate(signal.SIGTERM, timeout=15)
     finally:
-        if proc.poll() is None:
-            proc.kill()
-            proc.communicate()
+        bin_.cleanup()
 
 
 def test_monitor_collector_legacy_aliases(hook):

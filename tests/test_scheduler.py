@@ -246,25 +246,18 @@ def test_scheduler_binary_fake_cluster_end_to_end():
     import json
     import signal
     import socket
-    import subprocess
-    import sys
     import time
     import urllib.request
+
+    from tests.helpers import BinaryUnderTest
 
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
-    proc = subprocess.Popen(
-        [sys.executable, "-m", "vtpu.scheduler", "--fake-cluster", "2",
-         "--port", str(port)],
-        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-    )
+    bin_ = BinaryUnderTest("vtpu.scheduler", ["--fake-cluster", "2",
+                                              "--port", str(port)])
+    alive = bin_.alive
     try:
-        def alive():
-            if proc.poll() is not None:
-                raise AssertionError(
-                    f"scheduler died rc={proc.returncode}: "
-                    f"{proc.stderr.read()[-800:]}")
 
         deadline = time.monotonic() + 30
         while time.monotonic() < deadline:
@@ -295,12 +288,6 @@ def test_scheduler_binary_fake_cluster_end_to_end():
             metrics = r.read().decode()
         assert "vtpu_scheduler_filter_seconds" in metrics
 
-        proc.send_signal(signal.SIGTERM)
-        # communicate() drains the pipes: wait()+PIPE can deadlock if the
-        # child fills a 64 KiB pipe buffer during shutdown
-        _out, err = proc.communicate(timeout=15)
-        assert proc.returncode == 0, err[-500:]
+        bin_.terminate(signal.SIGTERM, timeout=15)
     finally:
-        if proc.poll() is None:
-            proc.kill()
-            proc.communicate()
+        bin_.cleanup()

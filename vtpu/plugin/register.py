@@ -41,6 +41,7 @@ class Registrar:
         # scheduler can gang multi-host workers onto one fabric.
         self.slice_info = slice_info
         self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
 
     def register_once(self) -> None:
         infos = self.rm.device_infos(mode=self.mode)
@@ -71,10 +72,16 @@ class Registrar:
             target=self.watch_and_register, args=(interval,), daemon=True
         )
         th.start()
+        self._thread = th
         return th
 
     def stop(self) -> None:
         self._stop.set()
+        # join BEFORE deregistering: an in-flight register_once() could
+        # otherwise re-patch the label/annotations AFTER the withdrawal,
+        # leaving a deregistered node looking alive
+        if self._thread is not None:
+            self._thread.join(timeout=10)
         try:
             self.client.patch_node_annotations(
                 self.node_name,
