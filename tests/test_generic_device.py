@@ -257,3 +257,38 @@ def test_device_class_from_dict_roundtrip():
     cfg = device_class_from_dict(d)
     assert cfg.cores_per_device == 2 and cfg.qos
     assert cfg.templates[0].memory_mb == 16384
+
+
+def test_generic_class_schedules_through_full_filter():
+    """A config-driven vendor class (TPU-V5P from the embedded default
+    config) schedules through the REAL scheduler filter: registry fan-out,
+    scoring, and the pod annotation protocol — not just unit-level fit."""
+    from vtpu.device import codec
+    from vtpu.scheduler.scheduler import Scheduler
+    from vtpu.util.k8sclient import FakeKubeClient
+
+    client = FakeKubeClient()
+    sched = Scheduler(client)
+    init_devices_with_config(load_device_config(), quota_manager=sched.quota_manager)
+    v5p = get_devices()["TPU-V5P"]
+    devices = [
+        DeviceInfo(id=f"v5p-{i}", count=4, devmem=96000, devcore=100,
+                   type="TPU-V5P", numa=0, index=i)
+        for i in range(4)
+    ]
+    client.put_node({"metadata": {
+        "name": "v5p-host",
+        "annotations": {v5p.register_annotation(): codec.encode_node_devices(devices)},
+    }})
+    sched.start(register_interval=3600)
+    try:
+        pod = client.put_pod(_pod(**{"google.com/tpu-v5p": "1",
+                                     "google.com/tpu-v5p-mem": "20000"}))
+        r = sched.filter({"Pod": pod, "NodeNames": ["v5p-host"]})
+        assert r["Error"] == "" and r["NodeNames"] == ["v5p-host"], r
+        annos = client.get_pod("default", "p")["metadata"]["annotations"]
+        assigned = [k for k in annos if "devices-to-allocate" in k]
+        assert assigned, annos
+        assert any("v5p" in annos[k] for k in assigned), annos
+    finally:
+        sched.stop()
