@@ -25,8 +25,10 @@ alternate in time —
                      exclusive baseline.
 
 Prints exactly ONE JSON line on stdout:
-  {"metric": ..., "value": <shared-vs-native p50 degradation %>,
-   "unit": "percent", "vs_baseline": <value / 5.0>, ...detail fields}
+  {"metric": ..., "value": <p90 of per-round shared-vs-native degradations %
+   over >=10 sandwiched rounds — a robust "every round passes" bar, not a
+   median-lucky one>, "unit": "percent", "vs_baseline": <value / 5.0>,
+   "libvtpu_attribution": <per-execute wrapper-cost breakdown>, ...}
 """
 
 from __future__ import annotations
@@ -133,6 +135,15 @@ def tenant_main(a: argparse.Namespace) -> None:
 
     for _ in range(warmup):
         one_request()
+    if os.environ.get("VTPU_BENCH_REGISTER") == "1":
+        # Zero the shim counters so the attribution reflects steady state,
+        # not warmup's cold-path size queries and compile traffic.
+        try:
+            import ctypes
+
+            ctypes.CDLL(str(ROOT / "libvtpu" / "build" / "libvtpu.so")).vtpu_stats_reset()
+        except Exception as exc:
+            log(f"stats reset failed: {exc}")
     print("READY", flush=True)
 
     # Block protocol: "RUN <n> <interval_ms> <stagger_ms>" -> n requests
@@ -192,6 +203,19 @@ def tenant_main(a: argparse.Namespace) -> None:
             "rank": a.rank, "backend": backend, "ttfts": ttfts, "totals": totals,
         }), flush=True)
     eng.stop()
+    if os.environ.get("VTPU_BENCH_REGISTER") == "1":
+        # Interception cost attribution: the same libvtpu.so this process
+        # booted through (CDLL on the loaded path returns the live handle).
+        try:
+            import ctypes
+
+            lib = ctypes.CDLL(str(ROOT / "libvtpu" / "build" / "libvtpu.so"))
+            lib.vtpu_stats_json.restype = ctypes.c_size_t
+            buf = ctypes.create_string_buffer(2048)
+            if lib.vtpu_stats_json(buf, ctypes.c_size_t(len(buf))):
+                print("STATS " + buf.value.decode(), flush=True)
+        except Exception as exc:  # stats are best-effort telemetry
+            log(f"stats export failed: {exc}")
 
 
 # --------------------------------------------------------------------- parent
@@ -296,11 +320,26 @@ class Tenant:
         return self.read_block()
 
     def close(self) -> None:
+        self.stats: dict | None = None
         try:
             if self.proc.poll() is None:
                 self.proc.stdin.write("BYE\n")
                 self.proc.stdin.flush()
-                self.proc.wait(timeout=30)
+            # Drain stdout on a side thread even if the tenant already
+            # exited (its STATS line may sit in the pipe buffer); the join
+            # bounds a wedged teardown and finally kills the process.
+            import threading
+
+            def drain():
+                for line in self.proc.stdout:
+                    if line.startswith("STATS "):
+                        self.stats = json.loads(line[len("STATS "):])
+
+            th = threading.Thread(target=drain, daemon=True)
+            th.start()
+            th.join(timeout=30)
+            if self.proc.poll() is None:
+                self.proc.wait(timeout=5)
         except Exception:
             pass
         finally:
@@ -314,10 +353,13 @@ def main() -> None:
     log(f"stack-in-the-loop: wrap={'libvtpu' if wrap else 'UNAVAILABLE (plain)'}")
     rtt_before_ms = probe_dispatch_rtt_ms()
     log(f"dispatch RTT probe (start): {rtt_before_ms:.1f} ms")
-    # odd round count: the headline is the median of per-round degradations,
-    # and a true middle element discards outlier rounds entirely (observed
-    # single-round spikes to +10% from platform drift)
-    rounds, block = (5, 8) if wrap else (2, 3)
+    # r3 robustness bar (VERDICT r2 weak #2): >=10 sandwiched sharing rounds
+    # and the headline is the p90 of per-round degradations (max also
+    # published) — a pass means essentially EVERY round under 5%, not a
+    # median-lucky one. p90 rather than max because single-round transport
+    # spikes (tunnel drift, see dispatch_rtt probes) are not chip contention.
+    overhead_rounds, block = (5, 8) if wrap else (2, 3)
+    sharing_rounds = 10 if wrap else 2
     shared_block = 6 if wrap else 2
 
     native = Tenant(rank=0, wrap=False, tag="native")
@@ -331,7 +373,7 @@ def main() -> None:
         nat_ttfts: list[float] = []
         nat_totals: list[float] = []
         stk_ttfts: list[float] = []
-        for _ in range(rounds):
+        for _ in range(overhead_rounds):
             b = native.run_block(block)
             nat_ttfts += b["ttfts"]
             nat_totals += b["totals"]
@@ -344,17 +386,16 @@ def main() -> None:
             f"through-libvtpu {p50_stk * 1e3:.2f} ms (overhead {overhead:+.2f}%)")
 
         # Sharing windows: native-exclusive <-> 4 stacked tenants, SANDWICHED.
-        # The platform's latency drifts across minutes, so the headline is
-        # the MEDIAN OF PER-ROUND PAIRED degradations; and because drift
-        # WITHIN a round would otherwise land entirely on whichever block
-        # runs second, each shared block is compared to the mean of the
-        # exclusive blocks on BOTH sides of it (B0 S0 B1 S1 ... Bn).
+        # Because drift WITHIN a round would otherwise land entirely on
+        # whichever block runs second, each shared block is compared to the
+        # mean of the exclusive blocks on BOTH sides of it (B0 S0 B1 S1 ...
+        # Bn); the headline aggregates the per-round paired degradations.
         interval_ms = DUTY_FACTOR * statistics.fmean(nat_totals) * 1000.0
         base_ttfts: list[float] = []
         shared_ttfts: list[float] = []
         base_medians: list[float] = [statistics.median(native.run_block(block)["ttfts"])]
         shared_medians: list[float] = []
-        for _ in range(rounds):
+        for _ in range(sharing_rounds):
             shared_r: list[float] = []
             for i, s in enumerate(stacks):  # all 4 at once, staggered arrivals
                 s.start_block(shared_block, interval_ms, i * interval_ms / TENANTS)
@@ -382,9 +423,38 @@ def main() -> None:
     rtt_after_ms = probe_dispatch_rtt_ms()
     log(f"dispatch RTT probe (end): {rtt_after_ms:.1f} ms")
 
-    degradation = statistics.median(round_degradations)
+    # Interception cost attribution (VERDICT r2 weak #1): per-execute /
+    # per-upload breakdown of where libvtpu's time goes, from the shim's own
+    # counters in the stack-exclusive tenant. The derived *_ms fields are the
+    # added wrapper cost — real plugin time (enqueue/upload_real) excluded.
+    attribution = None
+    st = stacks[0].stats if wrap else None
+    if wrap and not st:
+        log("no STATS line from the stack tenant — attribution unavailable")
+    if st and st.get("executes"):
+        ex = st["executes"]
+        # region_ns is NOT added: output-row region writes already run under
+        # the acct_ns timer (upload-path ones under upload_ns); it is
+        # published inside the raw counters for reference only.
+        wrap_ns = (st["gate_ns"] + st["admit_ns"] + st["acct_ns"]
+                   + st["onready_ns"])
+        attribution = {
+            **st,
+            "wrap_cost_per_execute_ms": round(wrap_ns / ex / 1e6, 4),
+            "acct_per_execute_ms": round(st["acct_ns"] / ex / 1e6, 4),
+            "size_rpc_total_ms": round(st["size_rpc_ns"] / 1e6, 3),
+            "upload_wrap_per_call_ms": round(
+                (st["upload_ns"] - st["upload_real_ns"])
+                / max(st["uploads"], 1) / 1e6, 4),
+        }
+        log(f"libvtpu attribution: {attribution['wrap_cost_per_execute_ms']:.4f} ms/"
+            f"execute wrapper cost, {st['size_rpcs']} size RPCs over "
+            f"{ex} executes ({st['size_cache_hits']} cache hits)")
+
+    srt = sorted(round_degradations)
+    degradation = srt[max(0, min(len(srt) - 1, round(0.9 * len(srt)) - 1))]  # p90
     print(json.dumps({
-        "metric": "p50_ttft_degradation_4way_share_stack",
+        "metric": "p90_round_ttft_degradation_4way_share_stack",
         "value": round(degradation, 2),
         "unit": "percent",
         "vs_baseline": round(degradation / 5.0, 3),
@@ -394,9 +464,13 @@ def main() -> None:
         "p50_ttft_exclusive_in_sharing_windows_ms": round(p50_base * 1e3, 2),
         "p50_ttft_shared_ms": round(p50_shared * 1e3, 2),
         "libvtpu_overhead_percent": round(overhead, 2),
+        "libvtpu_attribution": attribution,
         "tenants": TENANTS,
         "samples_shared": len(shared_ttfts),
+        "sharing_rounds": len(round_degradations),
         "per_round_degradation": [round(d, 2) for d in round_degradations],
+        "max_round_degradation": round(max(round_degradations), 2),
+        "median_round_degradation": round(statistics.median(round_degradations), 2),
         # sampled before tenants boot AND after the sharing windows: the
         # tunnel drifts on minute scales, so one point could misdescribe
         # the transport state the sharing windows actually saw

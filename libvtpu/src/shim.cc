@@ -30,6 +30,8 @@
 #include <time.h>
 #include <unistd.h>
 
+#include <atomic>
+#include <cstddef>
 #include <cstdint>
 #include <cstring>
 #include <mutex>
@@ -46,6 +48,64 @@
 
 namespace vtpu {
 namespace {
+
+// ------------------------------------------------------------- hot-path stats
+//
+// Per-wrapper cumulative costs. Over a tunneled/proxied PJRT plugin every
+// metadata call (Buffer_OnDeviceSizeInBytes, Memory_Kind, ...) can be a
+// network round-trip, and size queries on fresh execute outputs may block
+// until the buffer is *defined* — turning an async enqueue into a synchronous
+// wait. These counters let bench.py attribute interception overhead
+// (BASELINE.md "libvtpu overhead" note) instead of guessing.
+
+uint64_t tick_ns() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (uint64_t)ts.tv_sec * 1000000000ull + (uint64_t)ts.tv_nsec;
+}
+
+struct Stats {
+  std::atomic<uint64_t> executes{0};
+  std::atomic<uint64_t> gate_ns{0};        // priority-gate wait
+  std::atomic<uint64_t> admit_ns{0};       // duty-cycle limiter admit
+  std::atomic<uint64_t> enqueue_ns{0};     // real PJRT execute call
+  std::atomic<uint64_t> onready_ns{0};     // completion-event hook setup
+  std::atomic<uint64_t> acct_ns{0};        // output accounting (total)
+  std::atomic<uint64_t> size_rpcs{0};      // Buffer_OnDeviceSizeInBytes calls
+  std::atomic<uint64_t> size_rpc_ns{0};
+  std::atomic<uint64_t> numout_rpc_ns{0};  // NumOutputs resolution (cold only)
+  std::atomic<uint64_t> memkind_rpcs{0};   // Memory_Kind calls
+  std::atomic<uint64_t> memkind_rpc_ns{0};
+  std::atomic<uint64_t> uploads{0};
+  std::atomic<uint64_t> upload_ns{0};      // wrapped BufferFromHostBuffer total
+  std::atomic<uint64_t> upload_real_ns{0}; // real plugin portion of uploads
+  std::atomic<uint64_t> region_ns{0};      // shared-region writes
+  std::atomic<uint64_t> size_cache_hits{0};
+  std::atomic<uint64_t> size_cache_misses{0};
+};
+
+Stats& stats() {
+  static Stats* s = new Stats();
+  return *s;
+}
+
+struct ScopedNs {
+  std::atomic<uint64_t>& acc;
+  uint64_t t0;
+  explicit ScopedNs(std::atomic<uint64_t>& a) : acc(a), t0(tick_ns()) {}
+  ~ScopedNs() { acc.fetch_add(tick_ns() - t0, std::memory_order_relaxed); }
+};
+
+// Escape hatch for A/B attribution runs: VTPU_DISABLE_SIZE_CACHE=1 restores
+// the per-call sizing the cache replaces, so the overhead of the cold path
+// can be measured against the cached one on the same binary.
+bool size_cache_disabled() {
+  static const bool v = [] {
+    const char* e = std::getenv("VTPU_DISABLE_SIZE_CACHE");
+    return e != nullptr && *e == '1';
+  }();
+  return v;
+}
 
 // ---------------------------------------------------------------- tagged errors
 
@@ -199,6 +259,8 @@ void refresh_device_map(PJRT_Client* client) {
 uint64_t buffer_device_size(PJRT_Buffer* buffer) {
   auto& s = S();
   if (s.real->PJRT_Buffer_OnDeviceSizeInBytes == nullptr) return 0;
+  stats().size_rpcs.fetch_add(1, std::memory_order_relaxed);
+  ScopedNs timer(stats().size_rpc_ns);
   PJRT_Buffer_OnDeviceSizeInBytes_Args args;
   std::memset(&args, 0, sizeof(args));
   args.struct_size = PJRT_Buffer_OnDeviceSizeInBytes_Args_STRUCT_SIZE;
@@ -212,17 +274,29 @@ uint64_t buffer_device_size(PJRT_Buffer* buffer) {
   return args.on_device_size_in_bytes;
 }
 
-std::mutex g_numout_mu;
-std::unordered_map<PJRT_LoadedExecutable*, size_t> g_numout_cache;
+// Per-executable output metadata. XLA executables have static output shapes,
+// so the on-device sizes observed on the first execute hold for every later
+// one — caching them removes num_outputs per-execute PJRT round-trips (each
+// potentially a tunnel RPC that blocks until the output buffer is defined,
+// serializing an otherwise-async dispatch).
+struct ExecMeta {
+  size_t num_outputs = 0;
+  bool sized = false;
+  std::vector<uint64_t> out_sizes;  // per output index; valid when sized
+};
+
+std::mutex g_execmeta_mu;
+std::unordered_map<PJRT_LoadedExecutable*, ExecMeta> g_execmeta;
 
 size_t executable_num_outputs(PJRT_LoadedExecutable* loaded) {
   auto& s = S();
   {
     // Hot path: one lookup instead of three PJRT round-trips per execute.
-    std::lock_guard<std::mutex> lock(g_numout_mu);
-    auto it = g_numout_cache.find(loaded);
-    if (it != g_numout_cache.end()) return it->second;
+    std::lock_guard<std::mutex> lock(g_execmeta_mu);
+    auto it = g_execmeta.find(loaded);
+    if (it != g_execmeta.end()) return it->second.num_outputs;
   }
+  ScopedNs timer(stats().numout_rpc_ns);
   if (s.real->PJRT_LoadedExecutable_GetExecutable == nullptr ||
       s.real->PJRT_Executable_NumOutputs == nullptr) {
     return 0;
@@ -258,10 +332,28 @@ size_t executable_num_outputs(PJRT_LoadedExecutable* loaded) {
     }
   }
   {
-    std::lock_guard<std::mutex> lock(g_numout_mu);
-    g_numout_cache[loaded] = n;
+    std::lock_guard<std::mutex> lock(g_execmeta_mu);
+    g_execmeta[loaded].num_outputs = n;
   }
   return n;
+}
+
+// Cached output sizes for an executable, or empty when not yet observed
+// (first execute) or when the A/B flag disables the cache.
+std::vector<uint64_t> cached_output_sizes(PJRT_LoadedExecutable* loaded) {
+  if (size_cache_disabled()) return {};
+  std::lock_guard<std::mutex> lock(g_execmeta_mu);
+  auto it = g_execmeta.find(loaded);
+  if (it == g_execmeta.end() || !it->second.sized) return {};
+  return it->second.out_sizes;
+}
+
+void store_output_sizes(PJRT_LoadedExecutable* loaded,
+                        std::vector<uint64_t> sizes) {
+  std::lock_guard<std::mutex> lock(g_execmeta_mu);
+  auto& meta = g_execmeta[loaded];
+  meta.out_sizes = std::move(sizes);
+  meta.sized = true;
 }
 
 void account_alloc(PJRT_Buffer* buffer, size_t dev_idx, uint64_t bytes) {
@@ -271,9 +363,35 @@ void account_alloc(PJRT_Buffer* buffer, size_t dev_idx, uint64_t bytes) {
     s.dev(dev_idx).used_bytes += bytes;
     s.buffers[buffer] = {dev_idx, bytes};
   }
-  if (s.region) s.region->add_used(dev_idx, (int64_t)bytes);
+  if (s.region) {
+    ScopedNs timer(stats().region_ns);
+    s.region->add_used(dev_idx, (int64_t)bytes);
+  }
   VTPU_TRACE("alloc dev%zu %lu bytes (used=%lu)", dev_idx, (unsigned long)bytes,
              (unsigned long)s.devices[dev_idx].used_bytes);
+}
+
+// Account one execute output row in a single pass: one state lock for all
+// buffers and ONE shared-region write for the row total, instead of a lock +
+// region write per buffer.
+void account_output_row(PJRT_Buffer** outs, const uint64_t* sizes, size_t n,
+                        size_t dev_idx) {
+  auto& s = S();
+  uint64_t total = 0;
+  {
+    std::lock_guard<std::mutex> lock(s.mu);
+    auto& dev = s.dev(dev_idx);
+    for (size_t o = 0; o < n; o++) {
+      if (outs[o] == nullptr) continue;
+      dev.used_bytes += sizes[o];
+      s.buffers[outs[o]] = {dev_idx, sizes[o]};
+      total += sizes[o];
+    }
+  }
+  if (total && s.region) {
+    ScopedNs timer(stats().region_ns);
+    s.region->add_used(dev_idx, (int64_t)total);
+  }
 }
 
 // ---------------------------------------------------------------- wrappers
@@ -434,11 +552,60 @@ void unreserve(size_t dev_idx, uint64_t est) {
   dev.used_bytes = dev.used_bytes >= est ? dev.used_bytes - est : 0;
 }
 
+// Real on-device sizes observed per (dtype, dims) signature. Serving traffic
+// repeats a handful of upload shapes forever; after the first observation the
+// settle step needs no PJRT round-trip. Keyed by FNV-1a of the logical shape —
+// on one plugin the physical layout (and so the size) is a function of it.
+std::mutex g_upsize_mu;
+std::unordered_map<uint64_t, uint64_t> g_upsize_cache;
+
+uint64_t shape_sig(PJRT_Buffer_Type type, const int64_t* dims, size_t n) {
+  uint64_t h = 1469598103934665603ull;
+  auto mix = [&h](uint64_t v) {
+    h ^= v;
+    h *= 1099511628211ull;
+  };
+  mix((uint64_t)type + 1);
+  mix(n);
+  for (size_t i = 0; i < n; i++) mix((uint64_t)dims[i]);
+  return h;
+}
+
 // Settle a successful allocation: replace the pre-charged estimate by the
 // buffer's real on-device size and record the buffer for Destroy accounting.
-void settle_alloc(PJRT_Buffer* buffer, size_t dev_idx, uint64_t est, bool reserved) {
+// `sig` (when nonzero) keys the observed-size cache; 0 queries the plugin —
+// unless `trust_est` says est already IS a real on-device size (copies).
+void settle_alloc(PJRT_Buffer* buffer, size_t dev_idx, uint64_t est,
+                  bool reserved, uint64_t sig = 0, bool trust_est = false) {
   if (reserved) unreserve(dev_idx, est);
+  if (trust_est && est != 0) {
+    account_alloc(buffer, dev_idx, est);
+    return;
+  }
+  if (sig != 0 && !size_cache_disabled()) {
+    uint64_t cached = 0;
+    bool hit = false;
+    {
+      std::lock_guard<std::mutex> lock(g_upsize_mu);
+      auto it = g_upsize_cache.find(sig);
+      if (it != g_upsize_cache.end()) {
+        cached = it->second;
+        hit = true;
+      }
+    }
+    if (hit) {
+      stats().size_cache_hits.fetch_add(1, std::memory_order_relaxed);
+      account_alloc(buffer, dev_idx, cached ? cached : est);
+      return;
+    }
+  }
+  stats().size_cache_misses.fetch_add(1, std::memory_order_relaxed);
   uint64_t real_size = buffer_device_size(buffer);
+  if (sig != 0 && real_size != 0) {
+    std::lock_guard<std::mutex> lock(g_upsize_mu);
+    if (g_upsize_cache.size() > 65536) g_upsize_cache.clear();  // unbounded guard
+    g_upsize_cache[sig] = real_size;
+  }
   account_alloc(buffer, dev_idx, real_size ? real_size : est);
 }
 
@@ -448,53 +615,95 @@ void settle_alloc(PJRT_Buffer* buffer, size_t dev_idx, uint64_t est, bool reserv
 bool memory_is_host(PJRT_Memory* mem);
 // Post-hoc cap settlement for allocations whose destination device is only
 // known from the resulting buffer.
-PJRT_Error* settle_or_reject(PJRT_Buffer** buffer, uint64_t est);
+PJRT_Error* settle_or_reject(PJRT_Buffer** buffer, uint64_t est, uint64_t sig,
+                             bool trust_est = false);
 
 PJRT_Error* wrapped_buffer_from_host(PJRT_Client_BufferFromHostBuffer_Args* args) {
   auto& s = S();
+  stats().uploads.fetch_add(1, std::memory_order_relaxed);
+  ScopedNs total_timer(stats().upload_ns);
   uint64_t est = estimate_bytes(args->type, args->dims, args->num_dims);
+  // A custom device_layout changes the physical size of the same logical
+  // shape; only the default (nullptr) layout may share the size cache.
+  bool custom_layout =
+      offsetof(PJRT_Client_BufferFromHostBuffer_Args, device_layout) +
+              sizeof(void*) <=
+          args->struct_size &&
+      args->device_layout != nullptr;
+  uint64_t sig =
+      custom_layout ? 0 : shape_sig(args->type, args->dims, args->num_dims);
   if (args->memory != nullptr) {
     // PJRT gives `memory` precedence over `device` when both are set: host
     // spaces bypass HBM accounting; device spaces settle post-hoc from the
     // resulting buffer's device.
     if (memory_is_host(args->memory)) {
+      ScopedNs real_timer(stats().upload_real_ns);
       return s.real->PJRT_Client_BufferFromHostBuffer(args);
     }
-    PJRT_Error* err = s.real->PJRT_Client_BufferFromHostBuffer(args);
+    PJRT_Error* err;
+    {
+      ScopedNs real_timer(stats().upload_real_ns);
+      err = s.real->PJRT_Client_BufferFromHostBuffer(args);
+    }
     if (err != nullptr || args->buffer == nullptr) return err;
-    return settle_or_reject(&args->buffer, est);
+    return settle_or_reject(&args->buffer, est, sig);
   }
   size_t dev_idx = args->device ? device_index_of(args->device) : 0;
   bool reserved = false;
   if (PJRT_Error* verr = precheck_alloc(dev_idx, est, &reserved)) return verr;
-  PJRT_Error* err = s.real->PJRT_Client_BufferFromHostBuffer(args);
+  PJRT_Error* err;
+  {
+    ScopedNs real_timer(stats().upload_real_ns);
+    err = s.real->PJRT_Client_BufferFromHostBuffer(args);
+  }
   if (err != nullptr || args->buffer == nullptr) {
     if (reserved) unreserve(dev_idx, est);
     return err;
   }
-  settle_alloc(args->buffer, dev_idx, est, reserved);
+  settle_alloc(args->buffer, dev_idx, est, reserved, sig);
   return nullptr;
 }
+
+// PJRT_Memory handles are stable for the client's lifetime, so the kind
+// lookup (a potential tunnel RPC on every upload) is cached per handle.
+std::mutex g_memkind_mu;
+std::unordered_map<PJRT_Memory*, bool> g_memkind_cache;
 
 bool memory_is_host(PJRT_Memory* mem) {
   auto& s = S();
   if (mem == nullptr || s.wrapped.PJRT_Memory_Kind == nullptr) return false;
-  PJRT_Memory_Kind_Args args;
-  std::memset(&args, 0, sizeof(args));
-  args.struct_size = PJRT_Memory_Kind_Args_STRUCT_SIZE;
-  args.memory = mem;
-  if (PJRT_Error* err = s.real->PJRT_Memory_Kind(&args)) {
-    PJRT_Error_Destroy_Args d{PJRT_Error_Destroy_Args_STRUCT_SIZE, nullptr, err};
-    s.real->PJRT_Error_Destroy(&d);
-    return false;
+  {
+    std::lock_guard<std::mutex> lock(g_memkind_mu);
+    auto it = g_memkind_cache.find(mem);
+    if (it != g_memkind_cache.end()) return it->second;
   }
-  std::string kind(args.kind ? args.kind : "", args.kind_size);
-  return kind.find("host") != std::string::npos;
+  stats().memkind_rpcs.fetch_add(1, std::memory_order_relaxed);
+  bool is_host = false;
+  {
+    ScopedNs timer(stats().memkind_rpc_ns);
+    PJRT_Memory_Kind_Args args;
+    std::memset(&args, 0, sizeof(args));
+    args.struct_size = PJRT_Memory_Kind_Args_STRUCT_SIZE;
+    args.memory = mem;
+    if (PJRT_Error* err = s.real->PJRT_Memory_Kind(&args)) {
+      PJRT_Error_Destroy_Args d{PJRT_Error_Destroy_Args_STRUCT_SIZE, nullptr, err};
+      s.real->PJRT_Error_Destroy(&d);
+      return false;  // not cached: a failed lookup may succeed later
+    }
+    std::string kind(args.kind ? args.kind : "", args.kind_size);
+    is_host = kind.find("host") != std::string::npos;
+  }
+  {
+    std::lock_guard<std::mutex> lock(g_memkind_mu);
+    g_memkind_cache[mem] = is_host;
+  }
+  return is_host;
 }
 
 // Over-cap -> destroy the fresh buffer and return the tagged error, so the
 // tenant never holds memory past its cap.
-PJRT_Error* settle_or_reject(PJRT_Buffer** buffer, uint64_t est) {
+PJRT_Error* settle_or_reject(PJRT_Buffer** buffer, uint64_t est, uint64_t sig,
+                             bool trust_est) {
   auto& s = S();
   size_t dev_idx = 0;
   if (s.wrapped.PJRT_Buffer_Device != nullptr) {
@@ -522,7 +731,7 @@ PJRT_Error* settle_or_reject(PJRT_Buffer** buffer, uint64_t est) {
     *buffer = nullptr;
     return verr;
   }
-  settle_alloc(*buffer, dev_idx, est, reserved);
+  settle_alloc(*buffer, dev_idx, est, reserved, sig, trust_est);
   return nullptr;
 }
 
@@ -531,6 +740,13 @@ PJRT_Error* wrapped_create_uninitialized(
   auto& s = S();
   uint64_t est =
       estimate_bytes(args->shape_element_type, args->shape_dims, args->shape_num_dims);
+  // Same rule as BufferFromHostBuffer: a custom layout opts out of the
+  // shared shape-size cache.
+  uint64_t sig =
+      args->shape_layout != nullptr
+          ? 0
+          : shape_sig(args->shape_element_type, args->shape_dims,
+                      args->shape_num_dims);
   if (args->memory != nullptr) {
     // PJRT gives `memory` precedence over `device` when both are set: host
     // spaces bypass HBM accounting entirely; device spaces settle post-hoc
@@ -540,7 +756,7 @@ PJRT_Error* wrapped_create_uninitialized(
     }
     PJRT_Error* err = s.real->PJRT_Client_CreateUninitializedBuffer(args);
     if (err != nullptr || args->buffer == nullptr) return err;
-    return settle_or_reject(&args->buffer, est);
+    return settle_or_reject(&args->buffer, est, sig);
   }
   size_t dev_idx = args->device ? device_index_of(args->device) : 0;
   bool reserved = false;
@@ -550,7 +766,7 @@ PJRT_Error* wrapped_create_uninitialized(
     if (reserved) unreserve(dev_idx, est);
     return err;
   }
-  settle_alloc(args->buffer, dev_idx, est, reserved);
+  settle_alloc(args->buffer, dev_idx, est, reserved, sig);
   return nullptr;
 }
 
@@ -568,7 +784,10 @@ PJRT_Error* wrapped_copy_to_device(PJRT_Buffer_CopyToDevice_Args* args) {
     if (reserved) unreserve(dev_idx, est);
     return err;
   }
-  settle_alloc(args->dst_buffer, dev_idx, est, reserved);
+  // est came from the source's real on-device size; the copy has the same
+  // shape on the same plugin, so settle without another size round-trip.
+  if (reserved) unreserve(dev_idx, est);
+  account_alloc(args->dst_buffer, dev_idx, est);
   return nullptr;
 }
 
@@ -582,7 +801,8 @@ PJRT_Error* wrapped_copy_to_memory(PJRT_Buffer_CopyToMemory_Args* args) {
   uint64_t est = buffer_device_size(args->buffer);
   PJRT_Error* err = s.real->PJRT_Buffer_CopyToMemory(args);
   if (err != nullptr || args->dst_buffer == nullptr) return err;
-  return settle_or_reject(&args->dst_buffer, est);
+  // est here IS a real on-device size (same plugin, same shape): no re-query.
+  return settle_or_reject(&args->dst_buffer, est, 0, /*trust_est=*/true);
 }
 
 PJRT_Error* wrapped_buffer_destroy(PJRT_Buffer_Destroy_Args* args) {
@@ -604,14 +824,48 @@ PJRT_Error* wrapped_buffer_destroy(PJRT_Buffer_Destroy_Args* args) {
   return s.real->PJRT_Buffer_Destroy(args);
 }
 
+PJRT_Error* wrapped_client_destroy(PJRT_Client_Destroy_Args* args) {
+  // Memory-space, device, executable and buffer handles die with their
+  // client; their addresses can be reused by the next client with different
+  // semantics, so flush every cache keyed by them (the shape-size cache is
+  // address-free and stays). Outstanding buffer accounting is released the
+  // same way — the HBM really is freed — including the monitor's region view.
+  {
+    std::lock_guard<std::mutex> lock(g_memkind_mu);
+    g_memkind_cache.clear();
+  }
+  {
+    std::lock_guard<std::mutex> lock(g_execmeta_mu);
+    g_execmeta.clear();
+  }
+  auto& s = S();
+  std::vector<uint64_t> released;
+  {
+    std::lock_guard<std::mutex> lock(s.mu);
+    s.device_index.clear();
+    s.buffers.clear();
+    released.resize(s.devices.size(), 0);
+    for (size_t i = 0; i < s.devices.size(); i++) {
+      released[i] = s.devices[i].used_bytes;
+      s.devices[i].used_bytes = 0;
+    }
+  }
+  if (s.region) {
+    for (size_t i = 0; i < released.size(); i++) {
+      if (released[i]) s.region->add_used(i, -(int64_t)released[i]);
+    }
+  }
+  return s.real->PJRT_Client_Destroy(args);
+}
+
 PJRT_Error* wrapped_loaded_executable_destroy(
     PJRT_LoadedExecutable_Destroy_Args* args) {
-  // Drop the cached output count BEFORE the real destroy: the allocator can
-  // reuse this address for a new executable with a different output count,
-  // and a stale hit would walk past output_lists into garbage pointers.
+  // Drop the cached output metadata BEFORE the real destroy: the allocator
+  // can reuse this address for a new executable with a different output
+  // count/sizes, and a stale hit would mis-account or walk past output_lists.
   {
-    std::lock_guard<std::mutex> lock(g_numout_mu);
-    g_numout_cache.erase(args->executable);
+    std::lock_guard<std::mutex> lock(g_execmeta_mu);
+    g_execmeta.erase(args->executable);
   }
   return S().real->PJRT_LoadedExecutable_Destroy(args);
 }
@@ -645,6 +899,8 @@ void exec_done_cb(PJRT_Error* error, void* user_arg) {
 
 PJRT_Error* wrapped_execute(PJRT_LoadedExecutable_Execute_Args* args) {
   auto& s = S();
+  auto& st = stats();
+  st.executes.fetch_add(1, std::memory_order_relaxed);
   size_t dev_idx =
       args->execute_device ? device_index_of(args->execute_device) : 0;
 
@@ -653,6 +909,7 @@ PJRT_Error* wrapped_execute(PJRT_LoadedExecutable_Execute_Args* args) {
   // until unblocked; any release-without-unblock is region-controlled
   // (gate_timeout_ms / stale monitor heartbeat) and counted.
   if (s.region != nullptr) {
+    ScopedNs timer(st.gate_ns);
     bool forced = false;
     s.region->gate_wait(&forced);
   }
@@ -667,13 +924,21 @@ PJRT_Error* wrapped_execute(PJRT_LoadedExecutable_Execute_Args* args) {
   }
   bool precharged = false;
   if (enforce) {
+    ScopedNs timer(st.admit_ns);
     waited = limiter->admit(now_ns());
     precharged = limiter->enforcing();
   }
 
   uint64_t submit_ns = now_ns();
-  PJRT_Error* err = s.real->PJRT_LoadedExecutable_Execute(args);
-  if (s.region) s.region->record_kernel(dev_idx, waited);
+  PJRT_Error* err;
+  {
+    ScopedNs timer(st.enqueue_ns);
+    err = s.real->PJRT_LoadedExecutable_Execute(args);
+  }
+  if (s.region) {
+    ScopedNs timer(st.region_ns);
+    s.region->record_kernel(dev_idx, waited);
+  }
   if (err != nullptr) return err;
 
   // Busy-time feedback: ride the caller's device_complete_events when
@@ -682,6 +947,7 @@ PJRT_Error* wrapped_execute(PJRT_LoadedExecutable_Execute_Args* args) {
   if (args->device_complete_events != nullptr && args->num_devices >= 1 &&
       args->device_complete_events[0] != nullptr &&
       s.real->PJRT_Event_OnReady != nullptr) {
+    ScopedNs timer(st.onready_ns);
     auto* ctx = new ExecDoneCtx{dev_idx, submit_ns, precharged};
     PJRT_Event_OnReady_Args on;
     std::memset(&on, 0, sizeof(on));
@@ -704,19 +970,49 @@ PJRT_Error* wrapped_execute(PJRT_LoadedExecutable_Execute_Args* args) {
   }
 
   // Account execute outputs so the cap covers results, not just host uploads.
+  // Steady state costs ZERO PJRT round-trips: output shapes are static per
+  // executable, so sizes observed on the first execute are replayed from
+  // ExecMeta, and the whole row lands as one batched region write. (The cold
+  // query on a fresh output can block until the buffer is defined — over a
+  // tunneled plugin that serializes the async dispatch, which was the bulk of
+  // the r2 +19.5% TTFT overhead.)
   if (args->output_lists != nullptr) {
+    ScopedNs timer(st.acct_ns);
     size_t num_outputs = executable_num_outputs(args->executable);
+    std::vector<uint64_t> sizes = cached_output_sizes(args->executable);
+    bool have_cache = sizes.size() == num_outputs && num_outputs > 0;
+    if (have_cache) {
+      st.size_cache_hits.fetch_add(1, std::memory_order_relaxed);
+    } else if (num_outputs > 0) {
+      st.size_cache_misses.fetch_add(1, std::memory_order_relaxed);
+    }
+    bool stored = false;
     for (size_t d = 0; d < args->num_devices; d++) {
       PJRT_Buffer** outs = args->output_lists[d];
       if (outs == nullptr) continue;
       // Multi-device launches (execute_device == null) place row d's outputs
       // on addressable device d; a pinned launch puts them on dev_idx.
       size_t out_dev = args->execute_device ? dev_idx : d;
-      for (size_t o = 0; o < num_outputs; o++) {
-        PJRT_Buffer* buf = outs[o];
-        if (buf == nullptr) continue;
-        account_alloc(buf, out_dev, buffer_device_size(buf));
+      if (!have_cache) {
+        // Cold path: query each output once; SPMD rows share shard shapes,
+        // so row 0's sizes are cached for every later execute. A row with a
+        // null (elided) output is NOT cached — a 0 stored for that index
+        // would be replayed forever even when later executes populate it.
+        sizes.assign(num_outputs, 0);
+        bool complete = num_outputs > 0;
+        for (size_t o = 0; o < num_outputs; o++) {
+          if (outs[o] != nullptr) {
+            sizes[o] = buffer_device_size(outs[o]);
+          } else {
+            complete = false;
+          }
+        }
+        if (!stored && complete && !size_cache_disabled()) {
+          store_output_sizes(args->executable, sizes);
+          stored = true;
+        }
       }
+      account_output_row(outs, sizes.data(), num_outputs, out_dev);
     }
   }
   return nullptr;
@@ -749,6 +1045,7 @@ const PJRT_Api* wrap_api(const PJRT_Api* real) {
   replace_field(&s.wrapped.PJRT_Error_Message, real, wrapped_error_message);
   replace_field(&s.wrapped.PJRT_Error_GetCode, real, wrapped_error_getcode);
   replace_field(&s.wrapped.PJRT_Client_Create, real, wrapped_client_create);
+  replace_field(&s.wrapped.PJRT_Client_Destroy, real, wrapped_client_destroy);
   replace_field(&s.wrapped.PJRT_Client_BufferFromHostBuffer, real,
                 wrapped_buffer_from_host);
   // Read presence from s.wrapped (memcpy'd to struct_size, zeroed beyond),
@@ -816,6 +1113,61 @@ uint64_t vtpu_device_limit_bytes(size_t idx) {
 }
 const PJRT_Api* vtpu_wrap_api_for_test(const PJRT_Api* real) {
   return vtpu::wrap_api(real);
+}
+
+// Hot-path cost attribution (BASELINE.md "libvtpu overhead"): cumulative
+// per-wrapper nanoseconds + PJRT round-trip counts since start (or last
+// reset), as one JSON object. Returns bytes written (excluding NUL).
+size_t vtpu_stats_json(char* buf, size_t cap) {
+  auto& st = vtpu::stats();
+  int n = std::snprintf(
+      buf, cap,
+      "{\"executes\": %llu, \"gate_ns\": %llu, \"admit_ns\": %llu, "
+      "\"enqueue_ns\": %llu, \"onready_ns\": %llu, \"acct_ns\": %llu, "
+      "\"size_rpcs\": %llu, \"size_rpc_ns\": %llu, \"numout_rpc_ns\": %llu, "
+      "\"memkind_rpcs\": %llu, \"memkind_rpc_ns\": %llu, "
+      "\"uploads\": %llu, \"upload_ns\": %llu, \"upload_real_ns\": %llu, "
+      "\"region_ns\": %llu, \"size_cache_hits\": %llu, "
+      "\"size_cache_misses\": %llu}",
+      (unsigned long long)st.executes.load(),
+      (unsigned long long)st.gate_ns.load(),
+      (unsigned long long)st.admit_ns.load(),
+      (unsigned long long)st.enqueue_ns.load(),
+      (unsigned long long)st.onready_ns.load(),
+      (unsigned long long)st.acct_ns.load(),
+      (unsigned long long)st.size_rpcs.load(),
+      (unsigned long long)st.size_rpc_ns.load(),
+      (unsigned long long)st.numout_rpc_ns.load(),
+      (unsigned long long)st.memkind_rpcs.load(),
+      (unsigned long long)st.memkind_rpc_ns.load(),
+      (unsigned long long)st.uploads.load(),
+      (unsigned long long)st.upload_ns.load(),
+      (unsigned long long)st.upload_real_ns.load(),
+      (unsigned long long)st.region_ns.load(),
+      (unsigned long long)st.size_cache_hits.load(),
+      (unsigned long long)st.size_cache_misses.load());
+  return n > 0 && (size_t)n < cap ? (size_t)n : 0;
+}
+
+void vtpu_stats_reset() {
+  auto& st = vtpu::stats();
+  st.executes = 0;
+  st.gate_ns = 0;
+  st.admit_ns = 0;
+  st.enqueue_ns = 0;
+  st.onready_ns = 0;
+  st.acct_ns = 0;
+  st.size_rpcs = 0;
+  st.size_rpc_ns = 0;
+  st.numout_rpc_ns = 0;
+  st.memkind_rpcs = 0;
+  st.memkind_rpc_ns = 0;
+  st.uploads = 0;
+  st.upload_ns = 0;
+  st.upload_real_ns = 0;
+  st.region_ns = 0;
+  st.size_cache_hits = 0;
+  st.size_cache_misses = 0;
 }
 
 // Delivery A: dlsym interposition. Any GetPjrtApi resolution in the process

@@ -208,5 +208,18 @@ int main(int argc, char** argv) {
       "\"copies\": %d, \"copy_error\": \"%s\"}\n",
       allocated, freed, realloc_ok, first_error.c_str(), execs_ok,
       exec_elapsed, copies_ok, copy_error.c_str());
+
+  // Hot-path attribution counters, when libvtpu is in the process (either
+  // delivery: RTLD_DEFAULT also sees a preloaded copy).
+  typedef size_t (*StatsFn)(char*, size_t);
+  // Delivery B: the export is in the dlopen'd (RTLD_LOCAL) plugin handle;
+  // delivery A: the preloaded copy is visible via RTLD_DEFAULT.
+  auto stats_fn = (StatsFn)dlsym(handle, "vtpu_stats_json");
+  if (stats_fn == nullptr)
+    stats_fn = (StatsFn)dlsym(RTLD_DEFAULT, "vtpu_stats_json");
+  if (stats_fn != nullptr) {
+    char sbuf[1024];
+    if (stats_fn(sbuf, sizeof(sbuf)) > 0) printf("STATS %s\n", sbuf);
+  }
   return 0;
 }

@@ -88,4 +88,34 @@ assert kernel_count == 5, kernel_count
 print(f"   region ok: dev0={uuid} limit={limit>>20}MiB peak={peak>>20}MiB kernels={kernel_count}")
 EOF
 
+echo "== 7. hot path: metadata caches kill per-execute PJRT round-trips =="
+env VTPU_REAL_LIBTPU=$PWD/$B/fake_pjrt.so TPU_DEVICE_MEMORY_LIMIT_0=2g \
+    $B/pjrt_smoke $B/libvtpu.so 16 8 20 > "$TMP/stats.out"
+python3 - "$TMP/stats.out" <<'EOF'
+import json, sys
+lines = open(sys.argv[1]).read().splitlines()
+stats = json.loads([l for l in lines if l.startswith("STATS ")][-1][6:])
+result = json.loads([l for l in lines if l.startswith("RESULT ")][-1][7:])
+# 8 same-shape uploads + 20 executes of one executable: sizes are queried on
+# the first sighting only (1 upload shape + 1 output), never per call.
+# Copy-to-device legitimately sizes its SOURCE once per copy.
+assert stats["executes"] == 20, stats
+assert stats["size_rpcs"] <= 4 + result["copies"], f"per-call size queries leak: {stats}"
+assert stats["size_cache_hits"] >= 8 + 19 - 2, f"cache not engaged: {stats}"
+assert stats["memkind_rpcs"] <= 2, f"memory-kind not cached: {stats}"
+print(f"   stats ok: size_rpcs={stats['size_rpcs']} "
+      f"hits={stats['size_cache_hits']} executes={stats['executes']}")
+EOF
+# A/B escape hatch: disabling the cache restores per-call sizing (attribution)
+env VTPU_REAL_LIBTPU=$PWD/$B/fake_pjrt.so TPU_DEVICE_MEMORY_LIMIT_0=2g \
+    VTPU_DISABLE_SIZE_CACHE=1 \
+    $B/pjrt_smoke $B/libvtpu.so 16 8 20 > "$TMP/stats_nc.out"
+python3 - "$TMP/stats_nc.out" <<'EOF'
+import json, sys
+lines = open(sys.argv[1]).read().splitlines()
+stats = json.loads([l for l in lines if l.startswith("STATS ")][-1][6:])
+assert stats["size_rpcs"] >= 8 + 20, f"A/B flag ignored: {stats}"
+print(f"   no-cache ok: size_rpcs={stats['size_rpcs']}")
+EOF
+
 echo "ALL LIBVTPU TESTS PASSED"
