@@ -66,6 +66,10 @@ class Scheduler:
         self.quota_manager.refresh_managed_resources()
         self._lock = threading.RLock()
         self._filter_lock = threading.Lock()
+        # Per-pod serialization of decide+patch (see filter()): keyed by pod
+        # uid, dropped when the informer sees the pod deleted.
+        self._pod_filter_locks: dict[str, threading.Lock] = {}
+        self._pod_filter_locks_guard = threading.Lock()
         self._synced = threading.Event()
         self._stop = threading.Event()
         self._leader_check = leader_check or (lambda: True)
@@ -141,6 +145,8 @@ class Scheduler:
         info = self.pod_manager.take_and_delete_pod(pod["metadata"]["uid"])
         if info is not None:
             self.quota_manager.rm_usage(pod, info.devices)
+        with self._pod_filter_locks_guard:
+            self._pod_filter_locks.pop(pod["metadata"]["uid"], None)
 
     def on_del_node(self, node: dict) -> None:
         """Node gone: drop its devices and any stale lock bookkeeping
@@ -276,12 +282,58 @@ class Scheduler:
                 "FailedNodes": {},
                 "Error": "pod requests no schedulable device",
             }
-        # The snapshot -> fit -> record section must be atomic: two concurrent
-        # Filters would otherwise both fit into the same free slot and
-        # overcommit a chip. kube-scheduler's scheduling cycle is sequential,
-        # but simulation calls and multi-scheduler setups are not.
-        with self._filter_lock:
-            return self._filter_locked(args, pod, requests)
+        # The snapshot -> fit -> reserve section must be atomic: two
+        # concurrent Filters would otherwise both fit into the same free slot
+        # and overcommit a chip. kube-scheduler's scheduling cycle is
+        # sequential, but simulation calls and multi-scheduler setups are
+        # not. The annotation PATCH however is network I/O (5-20 ms per call
+        # against a real apiserver) and must NOT serialize every other
+        # Filter behind it (reference fans scoring out and never blocks on
+        # the API inside it, score.go:126-199): the reservation recorded in
+        # PodManager/QuotaManager under the lock already excludes those
+        # devices from concurrent snapshots, so the patch runs after the
+        # lock is dropped and the reservation is rolled back if it fails.
+        # Decide+patch IS serialized PER POD (annotations are the database:
+        # a stale patch landing after a superseding re-Filter's patch would
+        # leave annotations pointing at a replaced reservation) — but two
+        # DIFFERENT pods never wait on each other's I/O.
+        with self._pod_filter_lock(pod["metadata"].get("uid", "")):
+            with self._filter_lock:
+                response, pending = self._filter_locked(args, pod, requests)
+            if pending is None:
+                return response
+            winner, patch, failed = pending
+            try:
+                self.client.patch_pod_annotations(
+                    pod["metadata"].get("namespace", "default"),
+                    pod["metadata"]["name"],
+                    patch,
+                )
+            except ApiError as e:
+                with self._filter_lock:
+                    # Same-pod filters are serialized above, so the live
+                    # reservation is ours; the guard is defense in depth
+                    # (e.g. an informer DELETE raced in) — roll back exactly
+                    # what is reserved, not what we think we reserved.
+                    uid = pod["metadata"].get("uid", "")
+                    info = self.pod_manager.get_pod(uid)
+                    if info is not None and info.node_id == winner.node_name:
+                        self.pod_manager.del_pod(pod)
+                        self.quota_manager.rm_usage(pod, info.devices)
+                self.events.filtering_failed(pod, {winner.node_name: str(e)})
+                return {
+                    "NodeNames": [], "FailedNodes": failed,
+                    "Error": f"patch failed: {e}",
+                }
+        self.events.filtering_succeed(pod, winner.node_name)
+        return response
+
+    def _pod_filter_lock(self, uid: str) -> threading.Lock:
+        with self._pod_filter_locks_guard:
+            lk = self._pod_filter_locks.get(uid)
+            if lk is None:
+                lk = self._pod_filter_locks[uid] = threading.Lock()
+            return lk
 
     def _constrain_to_gang_slice(
         self,
@@ -460,10 +512,16 @@ class Scheduler:
                 return [exact, rest], failed, rank
         return [kept], failed, rank
 
-    def _filter_locked(self, args: dict, pod: dict, requests) -> dict:
+    def _filter_locked(
+        self, args: dict, pod: dict, requests
+    ) -> tuple[dict, Optional[tuple]]:
+        """Snapshot, score, and RESERVE under the filter lock. Returns
+        (extender response, pending patch): when pending is not None the
+        caller must write the decision annotations outside the lock and roll
+        the reservation back on failure.
 
-        # Volcano-style simulation: full Node objects instead of names
-        # (reference filterSimulation:990-1033): score only, no annotations.
+        Volcano-style simulation: full Node objects instead of names
+        (reference filterSimulation:990-1033): score only, no annotations."""
         nodes = args.get("Nodes") or {}
         simulation = bool(nodes.get("Items"))
         if simulation:
@@ -497,10 +555,12 @@ class Scheduler:
                 break
         if winner is None:
             self.events.filtering_failed(pod, failed)
-            return {"NodeNames": [], "FailedNodes": failed, "Error": ""}
+            return {"NodeNames": [], "FailedNodes": failed, "Error": ""}, None
 
         if simulation:
-            return {"NodeNames": [winner.node_name], "FailedNodes": failed, "Error": ""}
+            return {
+                "NodeNames": [winner.node_name], "FailedNodes": failed, "Error": ""
+            }, None
 
         patch: dict[str, str] = {
             t.ASSIGNED_NODE: winner.node_name,
@@ -523,19 +583,9 @@ class Scheduler:
             self.quota_manager.rm_usage(pod, prev.devices)
         self.pod_manager.add_pod(pod, winner.node_name, winner.devices)
         self.quota_manager.add_usage(pod, winner.devices)
-        try:
-            self.client.patch_pod_annotations(
-                pod["metadata"].get("namespace", "default"),
-                pod["metadata"]["name"],
-                patch,
-            )
-        except ApiError as e:
-            self.pod_manager.del_pod(pod)
-            self.quota_manager.rm_usage(pod, winner.devices)
-            self.events.filtering_failed(pod, {winner.node_name: str(e)})
-            return {"NodeNames": [], "FailedNodes": failed, "Error": f"patch failed: {e}"}
-        self.events.filtering_succeed(pod, winner.node_name)
-        return {"NodeNames": [winner.node_name], "FailedNodes": failed, "Error": ""}
+        return {
+            "NodeNames": [winner.node_name], "FailedNodes": failed, "Error": ""
+        }, (winner, patch, failed)
 
     # ------------------------------------------------------------------ bind
 

@@ -122,6 +122,15 @@ class FakeKubeClient(KubeClient):
         self.events: list[dict] = []
         self.bindings: list[tuple[str, str, str]] = []  # (ns, pod, node)
         self._subs: list[Callable[[str, str, dict], None]] = []
+        # Emulated apiserver network RTT for WRITE calls (seconds). Slept
+        # OUTSIDE the store lock, like real network I/O: concurrent callers
+        # overlap their RTTs. Lets benchmarks prove hot paths don't serialize
+        # on API writes (sched_bench --patch-rtt-ms).
+        self.write_rtt_s = 0.0
+
+    def _write_rtt(self) -> None:
+        if self.write_rtt_s > 0:
+            time.sleep(self.write_rtt_s)
 
     # ------------------------------------------------------------- internals
 
@@ -263,6 +272,7 @@ class FakeKubeClient(KubeClient):
             return out
 
     def patch_pod_annotations(self, namespace: str, name: str, annos: dict[str, Optional[str]]) -> dict:
+        self._write_rtt()
         with self._lock:
             key = (namespace, name)
             if key not in self.pods:
@@ -274,6 +284,7 @@ class FakeKubeClient(KubeClient):
             return copy.deepcopy(pod)
 
     def bind_pod(self, namespace: str, name: str, node: str) -> None:
+        self._write_rtt()
         with self._lock:
             key = (namespace, name)
             if key not in self.pods:
