@@ -1,0 +1,77 @@
+"""Probe the decode bounded-read-window inversion (VERDICT r2 weak #5).
+
+Hypothesis: inside fori_loop the bounded KV read is
+dynamic_index_in_dim(ks, l)[:, :bucket] with a loop-carried layer index, so
+XLA materializes a slice copy before attention — at batch 32 that copy costs
+more than streaming the full cache. With the layer loop unrolled the read is
+a static view that fuses into attention.
+
+Usage: python hack/decode_probe.py  (real chip; ~2 min)
+Prints ms/step for {fori, unroll} x {bucket 256, 2048} at batch 8 and 32.
+"""
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from vtpu.models import ModelConfig, init_params, prefill, decode_step  # noqa: E402
+
+STEPS = 64
+
+
+def timed(fn, *args, iters=5):
+    """Median wall seconds, synced via a D2H fetch (block_until_ready does
+    not wait on this tunnel platform — same harness as mfu_bench.timed)."""
+    np.asarray(fn(*args))  # compile + warm
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        np.asarray(fn(*args))
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times))
+
+
+def main():
+    cfg = ModelConfig(
+        vocab=8192, d_model=1024, n_heads=8, n_layers=12, d_ff=4096,
+        max_seq=2048, head_dim=128, dtype=jnp.bfloat16, use_pallas=True,
+    )
+    params = jax.jit(lambda k: init_params(k, cfg))(jax.random.key(0))
+    jax.block_until_ready(params)
+    results = []
+    for b in (8, 32):
+        tokens = jnp.asarray(
+            np.random.RandomState(0).randint(0, cfg.vocab, (b, 128)), jnp.int32)
+        _, cache = jax.jit(lambda p, t: prefill(p, cfg, t))(params, tokens)
+        jax.block_until_ready(cache)
+        for unroll in (False, True):
+            for bucket in (256, 2048):
+                @jax.jit
+                def chained(params, cache, tok):
+                    def body(carry, _):
+                        cache, tok = carry
+                        logits, cache = decode_step(
+                            params, cfg, cache, tok,
+                            kv_bucket=bucket, unroll=unroll)
+                        return (cache, jnp.argmax(logits, -1).astype(jnp.int32)), None
+                    (cache, tok), _ = jax.lax.scan(
+                        body, (cache, tok), None, length=STEPS)
+                    return tok
+
+                sec = timed(chained, params, cache, tokens[:, -1])
+                r = {"batch": b, "unroll": unroll, "kv_bucket": bucket,
+                     "ms_per_step": round(sec / STEPS * 1e3, 3),
+                     "tokens_per_sec": round(b * STEPS / sec)}
+                results.append(r)
+                print(r, flush=True)
+    print("RESULT " + json.dumps(results))
+
+
+if __name__ == "__main__":
+    main()

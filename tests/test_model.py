@@ -55,3 +55,21 @@ def test_prefill_pallas_path_matches_xla():
     logits_xla, _ = prefill(params, cfg, tokens)
     logits_pl, _ = prefill(params, dataclasses.replace(cfg, use_pallas=True), tokens)
     np.testing.assert_allclose(np.asarray(logits_pl), np.asarray(logits_xla), atol=2e-3)
+
+
+def test_decode_unroll_matches_fori(params):
+    """The unrolled decode layer loop (static layer index -> the bounded KV
+    read fuses into attention instead of materializing a slice copy) must be
+    numerically identical to the fori_loop body, bucketed or not."""
+    tokens = jax.random.randint(jax.random.key(3), (2, 8), 0, TINY.vocab)
+    _, cache = prefill(params, TINY, tokens)
+    tok = jnp.asarray([5, 9], jnp.int32)
+    for bucket in (0, 16):
+        logits_f, cache_f = decode_step(params, TINY, dict(cache), tok,
+                                        kv_bucket=bucket, unroll=False)
+        logits_u, cache_u = decode_step(params, TINY, dict(cache), tok,
+                                        kv_bucket=bucket, unroll=True)
+        np.testing.assert_allclose(np.asarray(logits_f), np.asarray(logits_u),
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(cache_f["k"]), np.asarray(cache_u["k"]),
+                                   rtol=1e-6, atol=1e-6)

@@ -102,3 +102,31 @@ def test_moe_train_step_pjit_ep_sharded():
         params, opt_state, loss = step(params, opt_state, tokens)
     assert jnp.isfinite(loss)
     assert float(loss) < float(loss0)
+
+
+def test_moe_prefill_right_padding_is_harmless():
+    """ADVICE r2 (medium): under the training capacity formula a pad token's
+    FIRST choice could exhaust an expert before a real token's SECOND choice
+    claimed its slot, so a padded-bucket prefill diverged from the unpadded
+    forward. Serving prefill now routes with capacity >= token count (like
+    decode): real-token logits must be bit-comparable whatever the padding."""
+    from vtpu.models.moe import moe_prefill
+
+    # tight capacity factor so the training formula WOULD drop under load
+    cfg = MoEConfig(
+        vocab=128, d_model=32, n_heads=2, n_layers=2, d_ff=64,
+        n_experts=4, top_k=2, capacity_factor=0.5,
+        max_seq=64, head_dim=16, dtype=jnp.float32,
+    )
+    params = init_moe_params(jax.random.key(0), cfg)
+    true = jnp.asarray(
+        np.random.RandomState(0).randint(0, cfg.vocab, (1, 12)), jnp.int32)
+    logits_true, cache_true = moe_prefill(params, cfg, true)
+    padded = jnp.concatenate(
+        [true, jnp.zeros((1, 20), jnp.int32)], axis=1)  # right-pad to 32
+    logits_pad, cache_pad = moe_prefill(params, cfg, padded)
+    np.testing.assert_allclose(
+        np.asarray(logits_pad[:, :12]), np.asarray(logits_true), rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(
+        np.asarray(cache_pad["k"][:, :, :12]), np.asarray(cache_true["k"][:, :, :12]),
+        rtol=2e-5, atol=2e-5)

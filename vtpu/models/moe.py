@@ -223,8 +223,19 @@ def moe_prefill(
     positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
     x = params["embed"][tokens].astype(cfg.dtype)
 
+    def serving_ffn(lp, normed, cfg_):
+        # capacity = the full token count, like moe_decode_ffn: the serving
+        # engine prefills RIGHT-PADDED [1, bucket] prompts, and under the
+        # training capacity formula a pad token's first choice could exhaust
+        # an expert before a real token's second choice claims its slot —
+        # padding would change a real token's output. With capacity >= T no
+        # token (real or pad) can ever be dropped, and since expert outputs
+        # are slot-position-invariant, right padding becomes exactly
+        # harmless (prefill_into_slot's contract).
+        return moe_ffn(lp, normed, cfg_, capacity=normed.shape[0] * normed.shape[1])
+
     def layer(x, lp):
-        out, _aux, kv = _moe_layer(cfg, lp, x, cos, sin, positions, moe_ffn)
+        out, _aux, kv = _moe_layer(cfg, lp, x, cos, sin, positions, serving_ffn)
         return out, kv
 
     x, (ks, vs) = jax.lax.scan(layer, x, params["layers"])

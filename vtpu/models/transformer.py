@@ -134,7 +134,7 @@ def prefill(
 
 def decode_step(
     params: Params, cfg: ModelConfig, cache: dict[str, jax.Array], token: jax.Array,
-    kv_bucket: int = 0,
+    kv_bucket: int = 0, unroll: bool = False,
 ) -> tuple[jax.Array, dict[str, jax.Array]]:
     """One autoregressive step. token: [B] int32. Static shapes throughout.
 
@@ -142,6 +142,7 @@ def decode_step(
     prefix of the cache — decode is HBM-bandwidth-bound, so callers that know
     their sequences are short pass the smallest bucket covering them (the
     serving engine does this per tick). Writes still land in the full cache.
+    unroll: see decode_layer_loop (static layer index fuses the bounded read).
     """
     pos0 = cache["len"][0]  # uniform batch position (benchmark decodes in lockstep)
 
@@ -151,7 +152,7 @@ def decode_step(
         return ks, vs
 
     logits, new_ks, new_vs = decode_layer_loop(
-        params, cfg, cache, token, kv_bucket, write_kv
+        params, cfg, cache, token, kv_bucket, write_kv, unroll=unroll
     )
     new_cache = {"k": new_ks, "v": new_vs, "len": cache["len"] + 1}
     return logits, new_cache
@@ -165,6 +166,7 @@ def decode_layer_loop(
     kv_bucket: int,
     write_kv,
     ffn_fn=None,
+    unroll: bool = False,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Shared decode-step body: a fori_loop carrying the STACKED cache (not a
     scan stacking fresh per-layer outputs), so the cache write — supplied by
@@ -174,7 +176,11 @@ def decode_layer_loop(
     dominated the step. The read view is bounded to ``kv_bucket`` (static;
     0 = max_seq). ``ffn_fn(lp, x)`` swaps the post-attention block (dense
     MLP here; routed experts for the MoE family — both share this attention
-    trunk). Returns (logits, new_ks, new_vs)."""
+    trunk). ``unroll`` trades compile time for a STATIC layer index: inside
+    fori_loop the bounded read is dynamic_index_in_dim(ks, l)[:, :bucket]
+    with a loop-carried l, which XLA materializes as a slice copy before
+    attention; unrolled, ks[l][:, :bucket] is a static view that fuses into
+    the attention reads. Returns (logits, new_ks, new_vs)."""
     b = token.shape[0]
     bucket = kv_bucket or cfg.max_seq
     ffn = ffn_fn or _mlp_block
@@ -183,21 +189,33 @@ def decode_layer_loop(
     x = params["embed"][token[:, None]].astype(cfg.dtype)
     kv_len = cache["len"] + 1
 
-    def layer(l, carry):
+    def layer(l, carry, lp=None):
         x, ks, vs = carry
-        lp = jax.tree_util.tree_map(lambda a: a[l], params["layers"])
+        if lp is None:
+            lp = jax.tree_util.tree_map(lambda a: a[l], params["layers"])
         q, k, v = _qkv(cfg, lp, x, cos, sin, positions)
         ks, vs = write_kv(l, ks, vs, k, v)
-        k_view = jax.lax.dynamic_index_in_dim(ks, l, 0, keepdims=False)[:, :bucket]
-        v_view = jax.lax.dynamic_index_in_dim(vs, l, 0, keepdims=False)[:, :bucket]
+        if unroll:
+            k_view = ks[l, :, :bucket]
+            v_view = vs[l, :, :bucket]
+        else:
+            k_view = jax.lax.dynamic_index_in_dim(ks, l, 0, keepdims=False)[:, :bucket]
+            v_view = jax.lax.dynamic_index_in_dim(vs, l, 0, keepdims=False)[:, :bucket]
         attn = causal_attention(q, k_view, v_view, kv_len=kv_len)
         x = x + attn.reshape(b, 1, cfg.qkv_dim) @ lp["wo"]
         x = x + ffn(lp, x)
         return x, ks, vs
 
-    x, new_ks, new_vs = jax.lax.fori_loop(
-        0, cfg.n_layers, layer, (x, cache["k"], cache["v"])
-    )
+    if unroll:
+        carry = (x, cache["k"], cache["v"])
+        for l in range(cfg.n_layers):
+            lp = jax.tree_util.tree_map(lambda a: a[l], params["layers"])
+            carry = layer(l, carry, lp=lp)
+        x, new_ks, new_vs = carry
+    else:
+        x, new_ks, new_vs = jax.lax.fori_loop(
+            0, cfg.n_layers, layer, (x, cache["k"], cache["v"])
+        )
     x = rms_norm(x, params["final_norm"])
     logits = (x[:, 0] @ params["embed"].T).astype(jnp.float32)
     return logits, new_ks, new_vs

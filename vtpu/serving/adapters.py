@@ -74,12 +74,13 @@ class TransformerSlotModel:
 
         return prefill_into_slot(params, self.cfg, state, padded, slot, true_len)
 
-    def decode_step(self, params, state, tokens, active, kv_bucket):
+    def decode_step(self, params, state, tokens, active, kv_bucket,
+                    unroll=False):
         from vtpu.serving.engine import batched_decode_step
 
         return batched_decode_step(
             cfg=self.cfg, params=params, cache=state, tokens=tokens,
-            active=active, kv_bucket=kv_bucket,
+            active=active, kv_bucket=kv_bucket, unroll=unroll,
         )
 
 
@@ -110,14 +111,15 @@ class MoeSlotModel:
             prefill_fn=moe_prefill,
         )
 
-    def decode_step(self, params, state, tokens, active, kv_bucket):
+    def decode_step(self, params, state, tokens, active, kv_bucket,
+                    unroll=False):
         from vtpu.models.moe import moe_decode_ffn
         from vtpu.serving.engine import batched_decode_step
 
         return batched_decode_step(
             cfg=self.cfg, params=params, cache=state, tokens=tokens,
             active=active, kv_bucket=kv_bucket,
-            ffn_fn=moe_decode_ffn(self.cfg),
+            ffn_fn=moe_decode_ffn(self.cfg), unroll=unroll,
         )
 
 
@@ -148,10 +150,11 @@ class SsmSlotModel:
         }
         return logits[0, true_len - 1], new_state
 
-    def decode_step(self, params, state, tokens, active, kv_bucket):
+    def decode_step(self, params, state, tokens, active, kv_bucket,
+                    unroll=False):
         from vtpu.models.ssm import ssm_decode_step
 
-        del kv_bucket  # O(1) state: nothing to window
+        del kv_bucket, unroll  # O(1) state: nothing to window or unroll
         logits, new = ssm_decode_step(params, self.cfg, state, tokens)
         keep = active[None, :, None, None]
         return logits, {
