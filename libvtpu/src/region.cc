@@ -69,8 +69,25 @@ Region* Region::open(const std::string& path, int priority) {
   self->region_ = region;
   // Initialization and slot claiming happen under the file lock so two
   // processes starting concurrently can't both memset or share a slot.
-  if (region->magic != VTPU_REGION_MAGIC ||
+  if (region->magic == VTPU_REGION_MAGIC &&
       region->version != VTPU_REGION_VERSION) {
+    // A DIFFERENT-layout region (rolling upgrade: an old-libvtpu process may
+    // still have it mapped). Re-initializing in place would wipe live slots
+    // under that writer and leave two processes disagreeing on offsets; the
+    // old layout can't even be parsed safely to check for a live pid. Run
+    // ungated instead, like the missing-region path — enforcement still
+    // holds, only the monitor's shared view is lost for this process.
+    VTPU_WARN("shared region %s has layout version %u (want %u); refusing to "
+              "re-initialize a possibly-live region — running without it "
+              "(delete the file to recover)",
+              path.c_str(), region->version, (unsigned)VTPU_REGION_VERSION);
+    munmap(mem, sizeof(vtpu_shared_region));
+    flock(fd, LOCK_UN);
+    close(fd);
+    delete self;
+    return nullptr;
+  }
+  if (region->magic != VTPU_REGION_MAGIC) {
     std::memset(region, 0, sizeof(*region));
     region->magic = VTPU_REGION_MAGIC;
     region->version = VTPU_REGION_VERSION;

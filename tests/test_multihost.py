@@ -255,9 +255,9 @@ def test_gang_rank_repairs_unranked_members():
 
 
 def test_gang_rank_repair_respects_completion_index():
-    """A legacy member with a Job completion-index label runs with THAT id
-    (Allocate ranks by it above the physical rank), so repair must stamp the
-    label value, not the node's physical rank."""
+    """A legacy member with a Job completion-index label AND the hostnames
+    annotation runs with the LABEL id (Allocate's annotation branch ranks by
+    it above the physical rank), so repair must stamp the label value."""
     client = fake_cluster({f"h{i}": v5e_devices(4, prefix=f"h{i}") for i in range(4)})
     for i in range(4):
         client.patch_node_annotations(
@@ -267,7 +267,8 @@ def test_gang_rank_repair_respects_completion_index():
     sched.start(register_interval=3600)
     try:
         gang = {t.SLICE_WORKERS_ANNO: "4", **GANG}
-        legacy = tpu_pod("w0", tpu=4, annotations=dict(gang))
+        legacy = tpu_pod("w0", tpu=4, annotations={
+            **gang, t.WORKER_HOSTNAMES_ANNO: "w0.svc,w1.svc,w2.svc,w3.svc"})
         legacy["metadata"]["labels"] = {
             "batch.kubernetes.io/job-completion-index": "3"}
         legacy = client.put_pod(legacy)
@@ -279,6 +280,34 @@ def test_gang_rank_repair_respects_completion_index():
         a1 = client.get_pod("default", "w1")["metadata"]["annotations"]
         assert a0[t.GANG_RANK_ANNO] == "3"  # the id the container holds
         assert a1[t.GANG_RANK_ANNO] == "0"
+    finally:
+        sched.stop()
+
+
+def test_gang_rank_repair_exact_slice_uses_physical_rank():
+    """ADVICE r2: on an EXACT slice WITHOUT the hostnames annotation,
+    Allocate wires the env from the host-env list in PHYSICAL order — the
+    live container holds the physical rank regardless of any completion-index
+    label, so repair must mirror that branch and stamp the physical rank."""
+    client = fake_cluster({f"h{i}": v5e_devices(4, prefix=f"h{i}") for i in range(4)})
+    for i in range(4):
+        client.patch_node_annotations(
+            f"h{i}", {t.NODE_SLICE_ANNO: _slice_anno("fab", i, 4)})
+    sched = Scheduler(client)
+    register_tpu_backend(quota=sched.quota_manager)
+    sched.start(register_interval=3600)
+    try:
+        gang = {t.SLICE_WORKERS_ANNO: "4", **GANG}
+        legacy = tpu_pod("w0", tpu=4, annotations=dict(gang))  # no hostnames
+        legacy["metadata"]["labels"] = {
+            "batch.kubernetes.io/job-completion-index": "3"}
+        legacy = client.put_pod(legacy)
+        sched.pod_manager.add_pod(legacy, "h2", {})  # physical rank 2, label 3
+        pod = client.put_pod(tpu_pod("w1", tpu=4, annotations=dict(gang)))
+        r = sched.filter({"Pod": pod, "NodeNames": [f"h{i}" for i in range(4)]})
+        assert r["NodeNames"], r
+        a0 = client.get_pod("default", "w0")["metadata"]["annotations"]
+        assert a0[t.GANG_RANK_ANNO] == "2"  # the env the container ACTUALLY has
     finally:
         sched.stop()
 

@@ -26,6 +26,10 @@ class PodInfo:
     slice_workers: int = 0  # >1: this pod is a multi-host slice worker
     gang_rank: int = -1  # scheduler-assigned gang-own worker rank (-1: none)
     completion_index: int = -1  # job-controller rank label (-1: none)
+    # Whether the pod carried the worker-hostnames annotation: decides which
+    # rank source Allocate's env wiring actually used (plugin/server.py
+    # _worker_envs), so the scheduler's legacy-rank repair can mirror it.
+    has_worker_hostnames: bool = False
 
     @property
     def key(self) -> str:
@@ -38,6 +42,7 @@ class PodManager:
         self._pods: dict[str, PodInfo] = {}
 
     def add_pod(self, pod: dict, node_id: str, devices: PodDevices) -> None:
+        from vtpu.util import types as t
         from vtpu.util.helpers import (
             completion_index,
             gang_rank,
@@ -61,6 +66,11 @@ class PodManager:
                 slice_workers=slice_workers(pod),
                 gang_rank=gang_rank(pod),
                 completion_index=completion_index(pod),
+                has_worker_hostnames=bool(
+                    (pod["metadata"].get("annotations") or {}).get(
+                        t.WORKER_HOSTNAMES_ANNO, ""
+                    )
+                ),
             )
 
     def del_pod(self, pod: dict) -> None:

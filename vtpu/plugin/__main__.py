@@ -98,8 +98,12 @@ def main() -> None:
 
     from vtpu.plugin.rm import write_host_inventory
 
-    # host chip inventory for the monitor's host-level metric families
+    # host chip inventory for the monitor's host-level metric families;
+    # re-published on every health flip (ADVICE r2: HealthWatcher transitions
+    # otherwise left the monitor's healthy/mode view stale until the next
+    # repartition or plugin restart)
     write_host_inventory(rm, args.hook_path)
+    rm.on_health_change(lambda: write_host_inventory(rm, args.hook_path))
 
     config = PluginConfig(
         resource_name=args.resource_name,

@@ -82,6 +82,14 @@ class Registrar:
         # leaving a deregistered node looking alive
         if self._thread is not None:
             self._thread.join(timeout=10)
+            if self._thread.is_alive():
+                # The race this join exists to close is still open: a stuck
+                # register_once() may re-patch AFTER the withdrawal below and
+                # leave a deregistered node looking alive. Say so.
+                log.warning(
+                    "register thread still alive after 10s; the deregister "
+                    "handshake below may be overwritten by its in-flight patch"
+                )
         try:
             self.client.patch_node_annotations(
                 self.node_name,

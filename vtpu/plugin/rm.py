@@ -238,7 +238,13 @@ class TpuResourceManager:
         """Push a ListAndWatch refresh to every subscriber (also used by
         dynamic repartitioning to publish new geometry)."""
         for fn in list(self._health_listeners):
-            fn()
+            try:
+                fn()
+            except Exception:
+                # one broken subscriber (e.g. a full disk failing the host-
+                # inventory republish) must not skip the plugin's own
+                # ListAndWatch push nor kill the health/repartition thread
+                log.exception("health-change listener failed")
 
 
 def write_host_inventory(rm: "TpuResourceManager", hook_path: str) -> str:
@@ -269,7 +275,9 @@ def write_host_inventory(rm: "TpuResourceManager", hook_path: str) -> str:
         }
         for c in rm.chips
     ]
-    tmp = f"{path}.tmp"
+    # unique tmp per writer: startup, repartition and health-listener calls
+    # can race, and two writers sharing one tmp name would tear or raise
+    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
     with open(tmp, "w") as f:
         json.dump(payload, f)
     os.replace(tmp, path)  # atomic: the monitor never sees a torn file

@@ -383,6 +383,19 @@ class TpuDevicePlugin:
         elif sl.num_workers == workers:
             worker_id = str(sl.worker_id)
             hostnames = os.environ.get(envs.ENV_WORKER_HOSTNAMES, "")
+            if gang_own and gang_own != worker_id:
+                # Deliberate override: the host-env hostnames list is in
+                # PHYSICAL slice order, so only the physical rank indexes it
+                # correctly — a completion-index label cannot be honored on
+                # this branch (the scheduler's rank repair mirrors this).
+                log.info(
+                    "pod %s/%s: exact-slice worker wiring uses physical rank "
+                    "%s over gang/completion rank %s (hostnames list is in "
+                    "physical order)",
+                    pod.get("metadata", {}).get("namespace", "default"),
+                    pod.get("metadata", {}).get("name", ""),
+                    worker_id, gang_own,
+                )
         else:
             worker_id = gang_own or str(sl.worker_id)
             log.warning(

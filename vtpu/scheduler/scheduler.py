@@ -17,6 +17,7 @@ over:
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import random
 import threading
@@ -66,9 +67,11 @@ class Scheduler:
         self.quota_manager.refresh_managed_resources()
         self._lock = threading.RLock()
         self._filter_lock = threading.Lock()
-        # Per-pod serialization of decide+patch (see filter()): keyed by pod
-        # uid, dropped when the informer sees the pod deleted.
-        self._pod_filter_locks: dict[str, threading.Lock] = {}
+        # Per-pod serialization of decide+patch (see filter()): uid ->
+        # [lock, refcount]; an entry removes itself when the last holder
+        # leaves, so the map cannot leak and a racing re-filter can never
+        # mint a second lock for a uid that still has one in use.
+        self._pod_filter_locks: dict[str, list] = {}
         self._pod_filter_locks_guard = threading.Lock()
         self._synced = threading.Event()
         self._stop = threading.Event()
@@ -145,8 +148,6 @@ class Scheduler:
         info = self.pod_manager.take_and_delete_pod(pod["metadata"]["uid"])
         if info is not None:
             self.quota_manager.rm_usage(pod, info.devices)
-        with self._pod_filter_locks_guard:
-            self._pod_filter_locks.pop(pod["metadata"]["uid"], None)
 
     def on_del_node(self, node: dict) -> None:
         """Node gone: drop its devices and any stale lock bookkeeping
@@ -296,11 +297,18 @@ class Scheduler:
         # Decide+patch IS serialized PER POD (annotations are the database:
         # a stale patch landing after a superseding re-Filter's patch would
         # leave annotations pointing at a replaced reservation) — but two
-        # DIFFERENT pods never wait on each other's I/O.
+        # DIFFERENT pods never wait on each other's I/O. Known exception to
+        # the no-I/O-under-the-lock rule: the gang legacy-member rank REPAIR
+        # (_constrain_to_gang_slice) patches under the lock, because the
+        # repaired ranks feed the decision itself; it fires at most once per
+        # legacy member ever, not per Filter.
         with self._pod_filter_lock(pod["metadata"].get("uid", "")):
             with self._filter_lock:
                 response, pending = self._filter_locked(args, pod, requests)
             if pending is None:
+                if not response["NodeNames"] and not response.get("Error"):
+                    # no-winner outcome: record the event outside the lock
+                    self.events.filtering_failed(pod, response["FailedNodes"])
                 return response
             winner, patch, failed = pending
             try:
@@ -328,12 +336,22 @@ class Scheduler:
         self.events.filtering_succeed(pod, winner.node_name)
         return response
 
-    def _pod_filter_lock(self, uid: str) -> threading.Lock:
+    @contextlib.contextmanager
+    def _pod_filter_lock(self, uid: str):
         with self._pod_filter_locks_guard:
-            lk = self._pod_filter_locks.get(uid)
-            if lk is None:
-                lk = self._pod_filter_locks[uid] = threading.Lock()
-            return lk
+            entry = self._pod_filter_locks.get(uid)
+            if entry is None:
+                entry = self._pod_filter_locks[uid] = [threading.Lock(), 0]
+            entry[1] += 1
+        entry[0].acquire()
+        try:
+            yield
+        finally:
+            entry[0].release()
+            with self._pod_filter_locks_guard:
+                entry[1] -= 1
+                if entry[1] == 0:
+                    self._pod_filter_locks.pop(uid, None)
 
     def _constrain_to_gang_slice(
         self,
@@ -431,12 +449,20 @@ class Scheduler:
                 for n in candidates
             }, -1
         for member in unranked:
-            # the id the live container actually holds: completion-index
-            # label first (Allocate ranks by it above everything), else the
-            # physical slice rank its env fallback used
-            repair = member.completion_index
+            # the id the live container actually holds — mirror Allocate's
+            # branch logic exactly (plugin/server.py _worker_envs): with the
+            # hostnames annotation (or on a larger slice) the env used the
+            # completion-index label, else physical rank; on an EXACT slice
+            # without the annotation the env is the node's PHYSICAL rank
+            # regardless of any completion-index label
+            member_slice = node_infos[member.node_id].slice
+            exact = member_slice.num_workers == member.slice_workers
+            if member.has_worker_hostnames or not exact:
+                repair = member.completion_index
+            else:
+                repair = -1  # Allocate's exact-slice branch ignored the label
             if repair < 0:
-                repair = node_infos[member.node_id].slice.worker_id
+                repair = member_slice.worker_id
             if repair >= workers or repair in used_ranks:
                 log.warning(
                     "gang %s/%s: legacy member %s holds physical worker id "
@@ -554,7 +580,8 @@ class Scheduler:
             if winner is not None:
                 break
         if winner is None:
-            self.events.filtering_failed(pod, failed)
+            # the failure event (an apiserver write) is posted by filter()
+            # AFTER the lock is released
             return {"NodeNames": [], "FailedNodes": failed, "Error": ""}, None
 
         if simulation:
