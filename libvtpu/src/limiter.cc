@@ -39,11 +39,16 @@ uint64_t DutyCycleLimiter::admit(uint64_t now_ns) {
   std::unique_lock<std::mutex> lock(mu_);
   for (;;) {
     refill(mono_now_ns());
-    if (tokens_ns_ >= (int64_t)est_ns_) {
+    // The requirement must stay satisfiable: tokens are burst-capped at one
+    // window's budget, so an estimate above the cap (e.g. queue latency on
+    // a deep pipeline leaking into the EMA) would otherwise spin forever.
+    int64_t burst_cap = (int64_t)(window_ns_ * limit_percent_ / 100);
+    int64_t need = (int64_t)est_ns_ < burst_cap ? (int64_t)est_ns_ : burst_cap;
+    if (tokens_ns_ >= need) {
       tokens_ns_ -= (int64_t)est_ns_;  // pre-charge; settle() corrects later
       return waited;
     }
-    uint64_t deficit = (uint64_t)((int64_t)est_ns_ - tokens_ns_);
+    uint64_t deficit = (uint64_t)(need - tokens_ns_);
     uint64_t delay = std::max<uint64_t>(
         deficit * 100 / std::max(1, limit_percent_), 200'000ull);
     delay = std::min(delay, window_ns_);
@@ -63,12 +68,85 @@ void DutyCycleLimiter::settle(uint64_t busy_ns, uint64_t now_ns, bool precharged
     tokens_ns_ -= (int64_t)busy_ns;
   }
   est_ns_ = (est_ns_ * 7 + busy_ns) / 8;  // EMA, 1/8 weight
-  // util reporting window
+  accum_busy(busy_ns, now_ns);
+}
+
+void DutyCycleLimiter::accum_busy(uint64_t busy_ns, uint64_t now_ns) {
+  // util reporting window (caller holds mu_)
   if (busy_epoch_ns_ == 0 || now_ns - busy_epoch_ns_ > 10 * window_ns_) {
     busy_epoch_ns_ = now_ns;
     busy_accum_ns_ = 0;
   }
   busy_accum_ns_ += busy_ns;
+}
+
+uint64_t DutyCycleLimiter::uncovered_and_insert(uint64_t s, uint64_t e) {
+  if (e <= s) return 0;
+  // subtract existing coverage
+  uint64_t covered = 0;
+  for (int i = 0; i < n_ivs_; i++) {
+    uint64_t os = ivs_[i].s > s ? ivs_[i].s : s;
+    uint64_t oe = ivs_[i].e < e ? ivs_[i].e : e;
+    if (oe > os) covered += oe - os;
+  }
+  uint64_t len = e - s;
+  uint64_t uncovered = covered < len ? len - covered : 0;
+  // insert + merge with any overlapping/adjacent entries
+  for (int i = 0; i < n_ivs_;) {
+    if (ivs_[i].e >= s && ivs_[i].s <= e) {
+      if (ivs_[i].s < s) s = ivs_[i].s;
+      if (ivs_[i].e > e) e = ivs_[i].e;
+      ivs_[i] = ivs_[--n_ivs_];
+    } else {
+      i++;
+    }
+  }
+  // prune beyond the coverage horizon (late arrivals older than this are
+  // charged in full — conservative in the limit's favor), and make room
+  uint64_t horizon = e > 10 * window_ns_ ? e - 10 * window_ns_ : 0;
+  for (int i = 0; i < n_ivs_;) {
+    if (ivs_[i].e < horizon) {
+      ivs_[i] = ivs_[--n_ivs_];
+    } else {
+      i++;
+    }
+  }
+  if (n_ivs_ == kMaxIvs) {  // evict the oldest to keep the set bounded
+    int oldest = 0;
+    for (int i = 1; i < n_ivs_; i++) {
+      if (ivs_[i].e < ivs_[oldest].e) oldest = i;
+    }
+    ivs_[oldest] = ivs_[--n_ivs_];
+  }
+  ivs_[n_ivs_++] = {s, e};
+  return uncovered;
+}
+
+void DutyCycleLimiter::settle_interval(uint64_t start_ns, uint64_t end_ns,
+                                       bool precharged) {
+  std::lock_guard<std::mutex> lock(mu_);
+  uint64_t charged = uncovered_and_insert(start_ns, end_ns);
+  if (precharged && limit_percent_ > 0 && limit_percent_ < 100) {
+    refill(mono_now_ns());
+    tokens_ns_ += (int64_t)est_ns_;  // refund the pre-charge
+    tokens_ns_ -= (int64_t)charged;
+  }
+  // The EMA tracks the union-charged (device-attributed) cost, NOT the raw
+  // submit->ready latency: on a deep pipeline raw includes the whole queue
+  // wait and would ratchet the estimate far past the admit burst budget.
+  est_ns_ = (est_ns_ * 7 + charged) / 8;
+  accum_busy(charged, end_ns);
+}
+
+void DutyCycleLimiter::charge_interval(uint64_t start_ns, uint64_t end_ns) {
+  std::lock_guard<std::mutex> lock(mu_);
+  uint64_t charged = uncovered_and_insert(start_ns, end_ns);
+  if (charged == 0) return;
+  if (limit_percent_ > 0 && limit_percent_ < 100) {
+    refill(mono_now_ns());
+    tokens_ns_ -= (int64_t)charged;
+  }
+  accum_busy(charged, end_ns);
 }
 
 int DutyCycleLimiter::current_util_percent(uint64_t now_ns) {

@@ -31,6 +31,22 @@ class DutyCycleLimiter {
   // and util window (no token debt for unenforced submissions).
   void settle(uint64_t busy_ns, uint64_t now_ns, bool precharged);
 
+  // Settle a completed execution from its MONOTONIC [submit, ready] interval,
+  // with UNION accounting against every other charged interval: time already
+  // charged (e.g. by charge_interval from a blocking D2H) is never charged
+  // twice. The EMA estimate tracks the union-charged (device-attributed)
+  // cost — NOT the raw submit->ready latency, which on a deep pipeline
+  // includes the whole queue wait and would ratchet past the admit budget.
+  void settle_interval(uint64_t start_ns, uint64_t end_ns, bool precharged);
+
+  // Charge a wall-clock interval the process spent blocked ON the runtime
+  // (D2H reads, event waits). This is the busy signal of last resort:
+  // proxied/tunneled runtimes fulfill completion events at ENQUEUE (observed:
+  // 70 settlements totalling 22 ms for ~8 s of real compute), so submission-
+  // side intervals are the only truthful clock there. Union accounting makes
+  // it a no-op wherever faithful completion events already charged the time.
+  void charge_interval(uint64_t start_ns, uint64_t end_ns);
+
   bool enforcing() const { return limit_percent_ > 0 && limit_percent_ < 100; }
 
   int current_util_percent(uint64_t now_ns);
@@ -39,6 +55,23 @@ class DutyCycleLimiter {
 
  private:
   void refill(uint64_t now_ns);
+  void accum_busy(uint64_t busy_ns, uint64_t now_ns);
+
+  // Union accounting over RECENT charged intervals (sorted, disjoint,
+  // merged): charges report only their uncovered portion. A set rather than
+  // a single high-water mark because completion callbacks arrive on
+  // detached threads with no end-time ordering guarantee — a late-delivered
+  // early interval must still pay for its uncovered time. Entries older
+  // than the coverage horizon are pruned.
+  struct ChargedIv {
+    uint64_t s, e;
+  };
+  static constexpr int kMaxIvs = 8;
+  ChargedIv ivs_[kMaxIvs];
+  int n_ivs_ = 0;
+  // Returns the uncovered length of [s, e) and inserts it into the set
+  // (caller holds mu_).
+  uint64_t uncovered_and_insert(uint64_t s, uint64_t e);
 
   int limit_percent_;
   uint64_t window_ns_;

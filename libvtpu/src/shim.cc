@@ -82,6 +82,12 @@ struct Stats {
   std::atomic<uint64_t> region_ns{0};      // shared-region writes
   std::atomic<uint64_t> size_cache_hits{0};
   std::atomic<uint64_t> size_cache_misses{0};
+  std::atomic<uint64_t> settles{0};          // completion-event settlements
+  std::atomic<uint64_t> settled_busy_ns{0};  // busy time those observed
+  std::atomic<uint64_t> tohost_calls{0};     // D2H reads (the sync point on
+  std::atomic<uint64_t> tohost_ns{0};        //   runtimes with eager events)
+  std::atomic<uint64_t> await_calls{0};
+  std::atomic<uint64_t> await_ns{0};
 };
 
 Stats& stats() {
@@ -437,6 +443,18 @@ void destroy_real_error(PJRT_Error* err) {
   d.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
   d.error = err;
   S().real->PJRT_Error_Destroy(&d);
+}
+
+void destroy_event(PJRT_Event* ev) {
+  auto& s = S();
+  if (ev == nullptr || s.real->PJRT_Event_Destroy == nullptr) return;
+  PJRT_Event_Destroy_Args d;
+  std::memset(&d, 0, sizeof(d));
+  d.struct_size = PJRT_Event_Destroy_Args_STRUCT_SIZE;
+  d.event = ev;
+  if (PJRT_Error* derr = s.real->PJRT_Event_Destroy(&d)) {
+    destroy_real_error(derr);
+  }
 }
 
 PJRT_Error_Code real_error_code(PJRT_Error* err) {
@@ -805,6 +823,96 @@ PJRT_Error* wrapped_copy_to_memory(PJRT_Buffer_CopyToMemory_Args* args) {
   return settle_or_reject(&args->dst_buffer, est, 0, /*trust_est=*/true);
 }
 
+// Charge a wall interval the process spent blocked on the runtime to the
+// device's duty-cycle limiter (union accounting inside the limiter prevents
+// double charges where faithful completion events already paid).
+void charge_sync_wall(size_t dev_idx, uint64_t start_ns, uint64_t end_ns) {
+  auto& s = S();
+  if (!s.limits.core_enforced() && s.region == nullptr) return;
+  DutyCycleLimiter* limiter;
+  {
+    std::lock_guard<std::mutex> lock(s.mu);
+    limiter = s.dev(dev_idx).limiter;
+  }
+  limiter->charge_interval(start_ns, end_ns);
+  if (s.region) {
+    s.region->set_core_util(dev_idx, limiter->current_util_percent(tick_ns()));
+  }
+}
+
+PJRT_Error* wrapped_event_await(PJRT_Event_Await_Args* args) {
+  auto& st = stats();
+  st.await_calls.fetch_add(1, std::memory_order_relaxed);
+  uint64_t t0 = tick_ns();
+  PJRT_Error* err = S().real->PJRT_Event_Await(args);
+  uint64_t t1 = tick_ns();
+  st.await_ns.fetch_add(t1 - t0, std::memory_order_relaxed);
+  // An event alone does not identify its device; charge chip 0 — exact for
+  // the single-chip containers vTPU shares (a multi-chip assignment gets
+  // its attribution from the per-buffer D2H path instead).
+  charge_sync_wall(0, t0, t1);
+  return err;
+}
+
+struct D2hCtx {
+  size_t dev_idx;
+  uint64_t start_ns;
+};
+
+void d2h_done_cb(PJRT_Error* error, void* user_arg) {
+  auto* ctx = static_cast<D2hCtx*>(user_arg);
+  uint64_t now = tick_ns();
+  stats().tohost_ns.fetch_add(now - ctx->start_ns, std::memory_order_relaxed);
+  charge_sync_wall(ctx->dev_idx, ctx->start_ns, now);
+  if (error != nullptr) {
+    PJRT_Error_Destroy_Args d{PJRT_Error_Destroy_Args_STRUCT_SIZE, nullptr, error};
+    S().real->PJRT_Error_Destroy(&d);
+  }
+  delete ctx;
+}
+
+PJRT_Error* wrapped_to_host(PJRT_Buffer_ToHostBuffer_Args* args) {
+  auto& s = S();
+  auto& st = stats();
+  st.tohost_calls.fetch_add(1, std::memory_order_relaxed);
+  size_t dev_idx = 0;
+  {
+    std::lock_guard<std::mutex> lock(s.mu);
+    auto it = s.buffers.find(args->src);
+    if (it != s.buffers.end()) dev_idx = it->second.first;
+  }
+  uint64_t t0 = tick_ns();
+  PJRT_Error* err = s.real->PJRT_Buffer_ToHostBuffer(args);
+  uint64_t t1 = tick_ns();
+  if (err != nullptr) return err;
+  // The D2H completion EVENT is the one signal even eager-event runtimes
+  // must keep honest — the caller's bytes have to actually arrive. Observe
+  // it (without consuming: OnReady supports multiple listeners) and charge
+  // [call, ready]; if there is no event, the call itself was synchronous.
+  bool hooked = false;
+  if (args->event != nullptr && s.real->PJRT_Event_OnReady != nullptr) {
+    auto* ctx = new D2hCtx{dev_idx, t0};
+    PJRT_Event_OnReady_Args on;
+    std::memset(&on, 0, sizeof(on));
+    on.struct_size = PJRT_Event_OnReady_Args_STRUCT_SIZE;
+    on.event = args->event;
+    on.callback = d2h_done_cb;
+    on.user_arg = ctx;
+    if (PJRT_Error* oerr = s.real->PJRT_Event_OnReady(&on)) {
+      delete ctx;
+      PJRT_Error_Destroy_Args d{PJRT_Error_Destroy_Args_STRUCT_SIZE, nullptr, oerr};
+      s.real->PJRT_Error_Destroy(&d);
+    } else {
+      hooked = true;
+    }
+  }
+  if (!hooked) {
+    st.tohost_ns.fetch_add(t1 - t0, std::memory_order_relaxed);
+    charge_sync_wall(dev_idx, t0, t1);
+  }
+  return err;
+}
+
 PJRT_Error* wrapped_buffer_destroy(PJRT_Buffer_Destroy_Args* args) {
   auto& s = S();
   size_t dev_idx = 0;
@@ -874,16 +982,20 @@ struct ExecDoneCtx {
   size_t dev_idx;
   uint64_t submit_ns;
   bool precharged;
+  PJRT_Event* own_event;  // non-null when the SHIM requested the event
 };
 
 void exec_done_cb(PJRT_Error* error, void* user_arg) {
   auto* ctx = static_cast<ExecDoneCtx*>(user_arg);
   auto& s = S();
-  uint64_t now = now_ns();
+  uint64_t now = tick_ns();
   uint64_t busy = now > ctx->submit_ns ? now - ctx->submit_ns : 0;
+  stats().settles.fetch_add(1, std::memory_order_relaxed);
+  stats().settled_busy_ns.fetch_add(busy, std::memory_order_relaxed);
   {
     std::lock_guard<std::mutex> lock(s.mu);
-    s.dev(ctx->dev_idx).limiter->settle(busy, now, ctx->precharged);
+    s.dev(ctx->dev_idx).limiter->settle_interval(ctx->submit_ns, now,
+                                                 ctx->precharged);
   }
   if (s.region) {
     std::lock_guard<std::mutex> lock(s.mu);
@@ -894,6 +1006,7 @@ void exec_done_cb(PJRT_Error* error, void* user_arg) {
     PJRT_Error_Destroy_Args d{PJRT_Error_Destroy_Args_STRUCT_SIZE, nullptr, error};
     s.real->PJRT_Error_Destroy(&d);
   }
+  destroy_event(ctx->own_event);
   delete ctx;
 }
 
@@ -929,7 +1042,24 @@ PJRT_Error* wrapped_execute(PJRT_LoadedExecutable_Execute_Args* args) {
     precharged = limiter->enforcing();
   }
 
-  uint64_t submit_ns = now_ns();
+  // Busy-time feedback needs a completion event. JAX does NOT request
+  // device_complete_events, and without one the limiter would charge its
+  // initial EMA estimate forever — the core knob would be decorative on
+  // every real workload. So when the caller passed nullptr and feedback
+  // matters (a core limit is enforced, or a region reports utilization),
+  // the shim requests its OWN events and destroys them in the callback.
+  std::vector<PJRT_Event*> own_events;
+  bool synthesized = false;
+  bool want_feedback = enforce || s.region != nullptr;
+  if (want_feedback && args->device_complete_events == nullptr &&
+      args->num_devices >= 1 && s.real->PJRT_Event_OnReady != nullptr &&
+      s.real->PJRT_Event_Destroy != nullptr) {
+    own_events.assign(args->num_devices, nullptr);
+    args->device_complete_events = own_events.data();
+    synthesized = true;
+  }
+
+  uint64_t submit_ns = tick_ns();  // monotonic: interval math in the limiter
   PJRT_Error* err;
   {
     ScopedNs timer(st.enqueue_ns);
@@ -939,20 +1069,28 @@ PJRT_Error* wrapped_execute(PJRT_LoadedExecutable_Execute_Args* args) {
     ScopedNs timer(st.region_ns);
     s.region->record_kernel(dev_idx, waited);
   }
-  if (err != nullptr) return err;
+  if (synthesized) {
+    // the caller never asked for events; restore its view of the struct
+    args->device_complete_events = nullptr;
+  }
+  if (err != nullptr) return err;  // on error the events are not populated
 
-  // Busy-time feedback: ride the caller's device_complete_events when
-  // requested; otherwise charge the EMA estimate.
+  // Ride the first row's completion event (caller-provided or our own).
   bool hooked = false;
-  if (args->device_complete_events != nullptr && args->num_devices >= 1 &&
-      args->device_complete_events[0] != nullptr &&
-      s.real->PJRT_Event_OnReady != nullptr) {
+  PJRT_Event* ev = synthesized
+                       ? own_events[0]
+                       : (args->device_complete_events != nullptr &&
+                                  args->num_devices >= 1
+                              ? args->device_complete_events[0]
+                              : nullptr);
+  if (ev != nullptr && s.real->PJRT_Event_OnReady != nullptr) {
     ScopedNs timer(st.onready_ns);
-    auto* ctx = new ExecDoneCtx{dev_idx, submit_ns, precharged};
+    auto* ctx = new ExecDoneCtx{dev_idx, submit_ns, precharged,
+                                synthesized ? ev : nullptr};
     PJRT_Event_OnReady_Args on;
     std::memset(&on, 0, sizeof(on));
     on.struct_size = PJRT_Event_OnReady_Args_STRUCT_SIZE;
-    on.event = args->device_complete_events[0];
+    on.event = ev;
     on.callback = exec_done_cb;
     on.user_arg = ctx;
     PJRT_Error* oerr = s.real->PJRT_Event_OnReady(&on);
@@ -962,6 +1100,13 @@ PJRT_Error* wrapped_execute(PJRT_LoadedExecutable_Execute_Args* args) {
       delete ctx;
       PJRT_Error_Destroy_Args d{PJRT_Error_Destroy_Args_STRUCT_SIZE, nullptr, oerr};
       s.real->PJRT_Error_Destroy(&d);
+    }
+  }
+  // Synthesized events for rows past 0 (or an unhookable row 0) are ours to
+  // destroy; do it now, their timing isn't read.
+  if (synthesized) {
+    for (size_t d = hooked ? 1 : 0; d < own_events.size(); d++) {
+      destroy_event(own_events[d]);
     }
   }
   if (!hooked) {
@@ -1061,6 +1206,12 @@ const PJRT_Api* wrap_api(const PJRT_Api* real) {
     replace_field(&s.wrapped.PJRT_Buffer_CopyToMemory, real, wrapped_copy_to_memory);
   }
   replace_field(&s.wrapped.PJRT_Buffer_Destroy, real, wrapped_buffer_destroy);
+  if (s.wrapped.PJRT_Event_Await != nullptr) {
+    replace_field(&s.wrapped.PJRT_Event_Await, real, wrapped_event_await);
+  }
+  if (s.wrapped.PJRT_Buffer_ToHostBuffer != nullptr) {
+    replace_field(&s.wrapped.PJRT_Buffer_ToHostBuffer, real, wrapped_to_host);
+  }
   replace_field(&s.wrapped.PJRT_LoadedExecutable_Execute, real, wrapped_execute);
   replace_field(&s.wrapped.PJRT_LoadedExecutable_Destroy, real,
                 wrapped_loaded_executable_destroy);
@@ -1128,7 +1279,10 @@ size_t vtpu_stats_json(char* buf, size_t cap) {
       "\"memkind_rpcs\": %llu, \"memkind_rpc_ns\": %llu, "
       "\"uploads\": %llu, \"upload_ns\": %llu, \"upload_real_ns\": %llu, "
       "\"region_ns\": %llu, \"size_cache_hits\": %llu, "
-      "\"size_cache_misses\": %llu}",
+      "\"size_cache_misses\": %llu, \"settles\": %llu, "
+      "\"settled_busy_ns\": %llu, \"tohost_calls\": %llu, "
+      "\"tohost_ns\": %llu, \"await_calls\": %llu, "
+      "\"await_ns\": %llu}",
       (unsigned long long)st.executes.load(),
       (unsigned long long)st.gate_ns.load(),
       (unsigned long long)st.admit_ns.load(),
@@ -1145,7 +1299,13 @@ size_t vtpu_stats_json(char* buf, size_t cap) {
       (unsigned long long)st.upload_real_ns.load(),
       (unsigned long long)st.region_ns.load(),
       (unsigned long long)st.size_cache_hits.load(),
-      (unsigned long long)st.size_cache_misses.load());
+      (unsigned long long)st.size_cache_misses.load(),
+      (unsigned long long)st.settles.load(),
+      (unsigned long long)st.settled_busy_ns.load(),
+      (unsigned long long)st.tohost_calls.load(),
+      (unsigned long long)st.tohost_ns.load(),
+      (unsigned long long)st.await_calls.load(),
+      (unsigned long long)st.await_ns.load());
   return n > 0 && (size_t)n < cap ? (size_t)n : 0;
 }
 
@@ -1168,6 +1328,12 @@ void vtpu_stats_reset() {
   st.region_ns = 0;
   st.size_cache_hits = 0;
   st.size_cache_misses = 0;
+  st.settles = 0;
+  st.settled_busy_ns = 0;
+  st.tohost_calls = 0;
+  st.tohost_ns = 0;
+  st.await_calls = 0;
+  st.await_ns = 0;
 }
 
 // Delivery A: dlsym interposition. Any GetPjrtApi resolution in the process

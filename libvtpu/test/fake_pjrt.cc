@@ -64,6 +64,31 @@ size_t num_outputs() {
   return e ? std::strtoull(e, nullptr, 10) : 1;
 }
 
+// Tunnel-runtime emulation: completion events report ready AT ENQUEUE (the
+// observed behavior of proxied plugins), so event-based busy feedback reads
+// ~zero and only blocking D2H reads expose the device's real pace.
+bool events_at_enqueue() {
+  const char* e = std::getenv("FAKE_PJRT_EVENT_AT_ENQUEUE");
+  return e != nullptr && e[0] == '1';
+}
+
+void sleep_until(uint64_t deadline_ns) {
+  uint64_t now = mono_ns();
+  if (deadline_ns <= now) return;
+  struct timespec ts;
+  uint64_t wait = deadline_ns - now;
+  ts.tv_sec = wait / 1000000000ull;
+  ts.tv_nsec = wait % 1000000000ull;
+  nanosleep(&ts, nullptr);
+}
+
+// Device busy-queue: a real accelerator serializes executions, so each one
+// completes exec_ns after the LATER of (its enqueue, the previous
+// completion) — without this, N concurrent submits would all "finish" in
+// one exec_ns and wall-interval duty accounting would see a 2 ms device
+// for 100 ms of work.
+std::atomic<uint64_t> g_busy_until{0};
+
 [[maybe_unused]] static PJRT_Error* err(PJRT_Error_Code code, std::string msg) {
   return reinterpret_cast<PJRT_Error*>(new FakeError{code, std::move(msg)});
 }
@@ -155,6 +180,25 @@ PJRT_Error* BufferCopyToDevice(PJRT_Buffer_CopyToDevice_Args* args) {
 
 // ------------------------------------------------------------- event fns
 
+PJRT_Error* BufferToHost(PJRT_Buffer_ToHostBuffer_Args* args) {
+  auto* buf = reinterpret_cast<FakeBuffer*>(args->src);
+  if (args->dst == nullptr) {
+    args->dst_size = buf->size;
+    return nullptr;
+  }
+  // Async D2H, like real runtimes: the call returns immediately and the
+  // COMPLETION EVENT fires when the device has drained up to this point —
+  // the one event even eager-event proxies must keep honest (the caller's
+  // bytes have to arrive). The shim charges duty off this event.
+  args->event = reinterpret_cast<PJRT_Event*>(new FakeEvent{g_busy_until.load()});
+  return nullptr;
+}
+
+PJRT_Error* EventAwait(PJRT_Event_Await_Args* args) {
+  sleep_until(reinterpret_cast<FakeEvent*>(args->event)->ready_ns);
+  return nullptr;
+}
+
 PJRT_Error* EventDestroy(PJRT_Event_Destroy_Args* args) {
   delete reinterpret_cast<FakeEvent*>(args->event);
   return nullptr;
@@ -197,11 +241,17 @@ std::atomic<uint64_t> g_exec_count{0};
 
 PJRT_Error* Execute(PJRT_LoadedExecutable_Execute_Args* args) {
   g_exec_count.fetch_add(1);
-  uint64_t done = mono_ns() + exec_ns();
+  uint64_t now = mono_ns();
+  uint64_t start = g_busy_until.load();
+  uint64_t done;
+  do {
+    done = (start > now ? start : now) + exec_ns();
+  } while (!g_busy_until.compare_exchange_weak(start, done));
   if (args->device_complete_events != nullptr) {
+    uint64_t ready = events_at_enqueue() ? now : done;
     for (size_t d = 0; d < args->num_devices; d++) {
       args->device_complete_events[d] =
-          reinterpret_cast<PJRT_Event*>(new FakeEvent{done});
+          reinterpret_cast<PJRT_Event*>(new FakeEvent{ready});
     }
   }
   if (args->output_lists != nullptr) {
@@ -237,7 +287,9 @@ extern "C" const PJRT_Api* GetPjrtApi() {
     g_api.PJRT_Buffer_OnDeviceSizeInBytes = BufferOnDeviceSize;
     g_api.PJRT_Buffer_Device = BufferDevice;
     g_api.PJRT_Buffer_CopyToDevice = BufferCopyToDevice;
+    g_api.PJRT_Buffer_ToHostBuffer = BufferToHost;
     g_api.PJRT_Event_Destroy = EventDestroy;
+    g_api.PJRT_Event_Await = EventAwait;
     g_api.PJRT_Event_OnReady = EventOnReady;
     g_api.PJRT_LoadedExecutable_GetExecutable = LoadedGetExecutable;
     g_api.PJRT_Executable_Destroy = ExecutableDestroy;

@@ -161,6 +161,17 @@ int main(int argc, char** argv) {
   }
 
   // Execute loop (core-throttle probe): measure wall time of n_execs.
+  // PJRT_SMOKE_NO_EVENTS=1 submits WITHOUT device_complete_events — the
+  // JAX-shaped caller — so the shim's synthesized-event feedback is what
+  // keeps the duty-cycle limiter honest.
+  bool no_events = getenv("PJRT_SMOKE_NO_EVENTS") != nullptr &&
+                   getenv("PJRT_SMOKE_NO_EVENTS")[0] == '1';
+  // PJRT_SMOKE_D2H=1: fetch the first output to host each step before
+  // destroying it — the serial serving pattern, and on runtimes whose
+  // completion events lie the ONLY call that tracks the device's pace.
+  bool d2h = getenv("PJRT_SMOKE_D2H") != nullptr &&
+             getenv("PJRT_SMOKE_D2H")[0] == '1';
+  std::vector<char> host_dst(4096);
   size_t n_out = 1;
   std::vector<PJRT_Buffer*> out_row(n_out, nullptr);
   PJRT_Buffer** output_lists[1] = {out_row.data()};
@@ -175,12 +186,38 @@ int main(int argc, char** argv) {
     eargs.num_devices = 1;
     eargs.num_args = 0;
     eargs.output_lists = output_lists;
-    eargs.device_complete_events = events;
+    eargs.device_complete_events = no_events ? nullptr : events;
     if (PJRT_Error* err = api->PJRT_LoadedExecutable_Execute(&eargs)) {
       fprintf(stderr, "execute: %s\n", error_text(api, err).c_str());
       break;
     }
     execs_ok++;
+    if (d2h && out_row[0] != nullptr &&
+        api->PJRT_Buffer_ToHostBuffer != nullptr) {
+      PJRT_Buffer_ToHostBuffer_Args th;
+      memset(&th, 0, sizeof(th));
+      th.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
+      th.src = out_row[0];
+      th.dst = host_dst.data();
+      th.dst_size = host_dst.size();
+      if (PJRT_Error* err = api->PJRT_Buffer_ToHostBuffer(&th)) {
+        error_text(api, err);
+      } else if (th.event != nullptr) {
+        // block until the bytes arrive, the way jax's fetch does
+        if (api->PJRT_Event_Await != nullptr) {
+          PJRT_Event_Await_Args aw;
+          memset(&aw, 0, sizeof(aw));
+          aw.struct_size = PJRT_Event_Await_Args_STRUCT_SIZE;
+          aw.event = th.event;
+          if (PJRT_Error* aerr = api->PJRT_Event_Await(&aw)) error_text(api, aerr);
+        }
+        PJRT_Event_Destroy_Args del;
+        memset(&del, 0, sizeof(del));
+        del.struct_size = PJRT_Event_Destroy_Args_STRUCT_SIZE;
+        del.event = th.event;
+        api->PJRT_Event_Destroy(&del);
+      }
+    }
     for (size_t o = 0; o < n_out; o++) {
       if (out_row[o]) {
         PJRT_Buffer_Destroy_Args del;
