@@ -154,7 +154,23 @@ def monitor_view() -> dict:
     return out
 
 
-def run_pair(limits: tuple[int, int]) -> dict:
+def run_pair(limits: tuple[int, int], retries: int = 1) -> dict:
+    result = _run_pair_once(limits)
+    ratio, expect = result.get("throughput_ratio"), limits[0] / limits[1]
+    # The tunneled platform occasionally wedges ONE tenant mid-window
+    # (observed: 0.017 steps/s beside a healthy 1.98); that is transport
+    # failure, not enforcement. Retry a pathological pair once.
+    if retries > 0 and (ratio is None or not (0.4 * expect <= ratio <= 2.5 * expect)):
+        print(f"pair {limits} pathological (ratio={ratio}); retrying once",
+              file=sys.stderr)
+        time.sleep(20)  # let the tunnel drain
+        retry = _run_pair_once(limits)
+        retry["first_attempt"] = result
+        return retry
+    return result
+
+
+def _run_pair_once(limits: tuple[int, int]) -> dict:
     if HOOK.exists():
         shutil.rmtree(HOOK)
     start_at = time.time() + 150.0  # cover attach + compile of both tenants
@@ -189,8 +205,10 @@ def parent() -> int:
 
     res_75_25 = run_pair((75, 25))
     print(f"75/25: ratio={res_75_25.get('throughput_ratio')}", file=sys.stderr)
+    time.sleep(20)
     res_60_20 = run_pair((60, 20))
     print(f"60/20: ratio={res_60_20.get('throughput_ratio')}", file=sys.stderr)
+    time.sleep(20)
     res_50_50 = run_pair((50, 50))
     print(f"50/50: ratio={res_50_50.get('throughput_ratio')}", file=sys.stderr)
 
