@@ -488,8 +488,10 @@ def main() -> int:
         r = subprocess.run(
             [str(lib / "pjrt_smoke"), str(lib / "libvtpu.so"), "1024", "10", "0"],
             env=run_env, capture_output=True, text=True)
-        out = json.loads([l for l in r.stdout.splitlines()
-                          if l.startswith("RESULT ")][-1][7:])
+        result_lines = [l for l in r.stdout.splitlines() if l.startswith("RESULT ")]
+        check(f"pjrt_smoke produced a result (rc={r.returncode}, "
+              f"stderr tail: {r.stderr[-300:]!r})", bool(result_lines))
+        out = json.loads(result_lines[-1][7:])
         check("the Allocate env contract enforces the 4 GiB cap in-container",
               out["allocated"] == 4 and "HBM limit exceeded" in out["alloc_error"])
         phase("libvtpu enforcement under the allocated env")
@@ -500,10 +502,15 @@ def main() -> int:
         ok = False
         raise
     finally:
-        scheduler.cleanup()
-        plugin.cleanup()
-        kubelet.server.stop(grace=0.2)
-        api.server.shutdown()
+        # every teardown step is independent: one failing must not skip the
+        # rest nor the evidence write below
+        for step in (scheduler.cleanup, plugin.cleanup,
+                     lambda: kubelet.server.stop(grace=0.2),
+                     api.server.shutdown):
+            try:
+                step()
+            except Exception as exc:
+                print(f"teardown step failed: {exc}", file=sys.stderr)
         evidence = {
             "ok": ok,
             "harness": "hack/e2e_stack.py",

@@ -122,6 +122,18 @@ uint64_t DutyCycleLimiter::uncovered_and_insert(uint64_t s, uint64_t e) {
   return uncovered;
 }
 
+// A single CLIENT-OBSERVED wall interval far beyond the pacing window is a
+// transport anomaly (a wedged tunnel was observed billing one D2H 60 s —
+// which at a 20% limit would owe FIVE MINUTES of pacing), not chip busy:
+// clamp those charges to the same 10-window horizon the util view uses.
+// Applied ONLY to the sync-wall path (charge_interval) — completion-event
+// settles are device truth on faithful runtimes and clamping them would
+// hand any tenant a quota bypass via one big fused dispatch.
+static uint64_t clamp_charge(uint64_t charged, uint64_t window_ns) {
+  uint64_t cap = 10 * window_ns;
+  return charged < cap ? charged : cap;
+}
+
 void DutyCycleLimiter::settle_interval(uint64_t start_ns, uint64_t end_ns,
                                        bool precharged) {
   std::lock_guard<std::mutex> lock(mu_);
@@ -140,7 +152,8 @@ void DutyCycleLimiter::settle_interval(uint64_t start_ns, uint64_t end_ns,
 
 void DutyCycleLimiter::charge_interval(uint64_t start_ns, uint64_t end_ns) {
   std::lock_guard<std::mutex> lock(mu_);
-  uint64_t charged = uncovered_and_insert(start_ns, end_ns);
+  uint64_t charged =
+      clamp_charge(uncovered_and_insert(start_ns, end_ns), window_ns_);
   if (charged == 0) return;
   if (limit_percent_ > 0 && limit_percent_ < 100) {
     refill(mono_now_ns());
