@@ -11,6 +11,10 @@ from pathlib import Path
 import pytest
 import yaml
 
+# Heavyweight tier (VERDICT r2 weak #7): compile-bound or sleep-bound; CI
+# runs the slow tier separately so the unit tier stays under two minutes.
+pytestmark = pytest.mark.slow
+
 ROOT = Path(__file__).resolve().parent.parent
 BENCH = ROOT / "benchmarks" / "ttft_benchmark"
 

@@ -12,6 +12,10 @@ from vtpu.parallel import make_mesh, mesh_shape_for, ring_attention, shard_param
 from vtpu.parallel.mesh import make_sp_mesh
 from vtpu.parallel.train import init_train_state, make_train_step, place_batch
 
+# Heavyweight tier (VERDICT r2 weak #7): compile-bound or sleep-bound; CI
+# runs the slow tier separately so the unit tier stays under two minutes.
+pytestmark = pytest.mark.slow
+
 CFG = ModelConfig(
     vocab=128, d_model=64, n_heads=4, n_layers=2, d_ff=128,
     max_seq=32, head_dim=32, dtype=jnp.float32, use_pallas=False,

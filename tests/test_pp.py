@@ -12,6 +12,10 @@ from vtpu.models.transformer import prefill
 from vtpu.parallel.mesh import make_axis_mesh
 from vtpu.parallel.pipeline import microbatch, pipeline_apply, pp_loss, pp_transformer_forward
 
+# Heavyweight tier (VERDICT r2 weak #7): compile-bound or sleep-bound; CI
+# runs the slow tier separately so the unit tier stays under two minutes.
+pytestmark = pytest.mark.slow
+
 needs8 = pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
 
 CFG = ModelConfig(

@@ -5,6 +5,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+import pytest
+
 from vtpu.models.ssm import (
     SSMConfig,
     init_ssm_params,
@@ -13,6 +15,10 @@ from vtpu.models.ssm import (
     ssm_forward,
     ssm_loss,
 )
+
+# Heavyweight tier (VERDICT r2 weak #7): compile-bound or sleep-bound; CI
+# runs the slow tier separately so the unit tier stays under two minutes.
+pytestmark = pytest.mark.slow
 
 CFG = SSMConfig(vocab=64, d_model=32, n_layers=2, d_state=4, d_conv=3,
                 expand=2, dtype=jnp.float32)
