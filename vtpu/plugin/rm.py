@@ -278,7 +278,15 @@ def write_host_inventory(rm: "TpuResourceManager", hook_path: str) -> str:
     # unique tmp per writer: startup, repartition and health-listener calls
     # can race, and two writers sharing one tmp name would tear or raise
     tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
-    with open(tmp, "w") as f:
-        json.dump(payload, f)
-    os.replace(tmp, path)  # atomic: the monitor never sees a torn file
+    try:
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, path)  # atomic: the monitor never sees a torn file
+    except BaseException:
+        # a failed write (ENOSPC, ...) must not orphan uniquely-named tmps
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
     return path
