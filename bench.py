@@ -293,12 +293,11 @@ class Tenant:
             env.setdefault("TPU_WORKER_HOSTNAMES", "localhost")
             env["VTPU_BENCH_REGISTER"] = "1"
             env["VTPU_REAL_LIBTPU"] = REAL_PLUGIN
-            # The device plugin's env contract: HBM/4 per tenant; 25% core
-            # for the 4-way-share tenants, 100 (= unthrottled, the exclusive
-            # contract) for the interception-overhead tenant — now that the
-            # duty-cycle limiter gets real busy feedback, a 25% cap would
-            # THROTTLE a back-to-back exclusive block and the overhead
-            # number would measure enforcement, not interception.
+            # The device plugin's env contract: HBM/4 per tenant;
+            # core_limit per tenant role (SHARE_CORE_LIMIT for the sharing
+            # tenants, 100 for the interception-overhead tenant — a cap
+            # would throttle its back-to-back blocks and the overhead
+            # number would measure enforcement, not interception).
             env["TPU_DEVICE_MEMORY_LIMIT_0"] = "4g"
             env["TPU_CORE_LIMIT"] = str(core_limit)  # see SHARE_CORE_LIMIT
             region = ROOT / "build" / f"bench_{tag}{rank}.cache"
@@ -379,8 +378,9 @@ def main() -> None:
     # median-lucky one. p90 rather than max because single-round transport
     # spikes (tunnel drift, see dispatch_rtt probes) are not chip contention.
     # The A/B overhead estimator fights the same tunnel fluctuation as the
-    # sharing windows (observed -17..+8pp across identical runs with 8-sample
-    # blocks); 16-sample blocks over 7 rounds put the median's sigma at ~2pp.
+    # sharing windows (observed -17..+8pp across identical runs with
+    # 8-sample blocks; per-round sigma ~8pp even at 16): 16-sample blocks
+    # over 11 ORDER-ALTERNATED rounds put the median's sigma at ~2.4pp.
     # The steady-state truth is the attribution block (0 size RPCs,
     # wrap_cost_per_execute_ms) — the A/B delta is its transport-noisy check.
     overhead_rounds, block = (11, 16) if wrap else (2, 3)
@@ -396,7 +396,7 @@ def main() -> None:
 
     native = Tenant(rank=0, wrap=False, tag="native")
     # overhead windows use the exclusive-contract tenant (core=100); the
-    # four sharing tenants run the 4-way-share contract (core=25)
+    # four sharing tenants run the sharing contract (SHARE_CORE_LIMIT)
     stack_x = Tenant(rank=0, wrap=wrap, tag="stackx", core_limit=100)
     stacks = [Tenant(rank=r, wrap=wrap, tag="stack", core_limit=SHARE_CORE_LIMIT)
               for r in range(TENANTS)]
@@ -489,9 +489,10 @@ def main() -> None:
     # per-upload breakdown of where libvtpu's time goes, from the shim's own
     # counters in the stack-exclusive tenant. The derived *_ms fields are the
     # added wrapper cost — real plugin time (enqueue/upload_real) excluded.
-    # Shared-tenant throttle introspection: nonzero admit waits here mean the
-    # 25% core caps actually paced tenants during the sharing windows (on the
-    # tunneled platform that can amplify transport spikes — see DUTY_FACTOR).
+    # Shared-tenant throttle introspection: nonzero admit waits mean core
+    # pacing fired during the sharing windows and polluted the sharing
+    # signal (must be 0 under the SHARE_CORE_LIMIT contract; the field
+    # exists to keep that auditable).
     shared_throttle = None
     if wrap:
         shared_throttle = [

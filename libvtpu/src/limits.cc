@@ -63,6 +63,10 @@ Limits parse_limits_from_env() {
     long long ms = std::atoll(v);
     limits.attach_wait_ms = ms > 0 ? (uint64_t)ms : 0;
   }
+  if (const char* v = std::getenv("VTPU_CHARGE_FLOOR_MS")) {
+    long long ms = std::atoll(v);
+    limits.charge_floor_ns = ms > 0 ? (uint64_t)ms * 1000000ull : 0;
+  }
   return limits;
 }
 

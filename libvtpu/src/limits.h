@@ -24,6 +24,16 @@ struct Limits {
   // holding the chip) retries with backoff up to this many ms instead of
   // failing the tenant. 0 = surface the failure immediately.
   uint64_t attach_wait_ms = 0;
+  // VTPU_CHARGE_FLOOR_MS: operator-declared transport floor subtracted from
+  // every SYNC-WALL duty charge (D2H/await intervals). On proxied/tunneled
+  // runtimes the client-observed wall of every completion-coupled call
+  // carries the dispatch RTT (~100-200 ms here), which is not chip busy —
+  // without a floor, any serving tenant's charged duty saturates its core
+  // cap on transport alone. Explicit (the plugin can probe and set it)
+  // rather than auto-detected: a rolling-min detector would misread
+  // constant-cost real work as floor. 0 (default) = charge full walls,
+  // correct for local runtimes with µs dispatch.
+  uint64_t charge_floor_ns = 0;
 
   bool mem_enforced() const { return !disable_control; }
   bool core_enforced() const {

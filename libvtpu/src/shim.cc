@@ -825,7 +825,10 @@ PJRT_Error* wrapped_copy_to_memory(PJRT_Buffer_CopyToMemory_Args* args) {
 
 // Charge a wall interval the process spent blocked on the runtime to the
 // device's duty-cycle limiter (union accounting inside the limiter prevents
-// double charges where faithful completion events already paid).
+// double charges where faithful completion events already paid). The
+// operator-declared transport floor (VTPU_CHARGE_FLOOR_MS) is deducted:
+// over a proxied plugin every completion-coupled wall carries the dispatch
+// RTT, which is transport, not chip busy.
 void charge_sync_wall(size_t dev_idx, uint64_t start_ns, uint64_t end_ns) {
   auto& s = S();
   if (!s.limits.core_enforced() && s.region == nullptr) return;
@@ -834,7 +837,13 @@ void charge_sync_wall(size_t dev_idx, uint64_t start_ns, uint64_t end_ns) {
     std::lock_guard<std::mutex> lock(s.mu);
     limiter = s.dev(dev_idx).limiter;
   }
-  limiter->charge_interval(start_ns, end_ns);
+  start_ns += s.limits.charge_floor_ns;
+  if (end_ns > start_ns) {
+    limiter->charge_interval(start_ns, end_ns);
+  }
+  // refresh the monitor's view even when the floor exempted this wall: the
+  // util must DECAY to zero on a floored-idle tenant, not freeze at the
+  // last pre-floor reading
   if (s.region) {
     s.region->set_core_util(dev_idx, limiter->current_util_percent(tick_ns()));
   }

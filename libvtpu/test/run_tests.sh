@@ -157,6 +157,22 @@ assert twall >= 0.35, f'D2H-wall charging did not throttle: {twall}s'
 assert tfree < twall / 2, f'unthrottled control not faster: {tfree} vs {twall}'
 print(f'   tunnel-mode throttled={twall}s unthrottled={tfree}s')"
 
+echo "== 7d. operator transport floor: VTPU_CHARGE_FLOOR_MS exempts the RTT =="
+# Same tunnel-shaped run as 7c, but the operator declares a 2ms transport
+# floor — exactly the per-step wall here — so the sync-wall charges vanish
+# and the limiter must NOT throttle (on a real proxied runtime the floor is
+# the probed dispatch RTT and only true chip time above it is charged).
+env VTPU_REAL_LIBTPU=$PWD/$B/fake_pjrt.so TPU_CORE_LIMIT=20 \
+    FAKE_PJRT_EXEC_NS=2000000 FAKE_PJRT_EVENT_AT_ENQUEUE=1 \
+    PJRT_SMOKE_NO_EVENTS=1 PJRT_SMOKE_D2H=1 VTPU_CHARGE_FLOOR_MS=3 \
+    $B/pjrt_smoke $B/libvtpu.so 1 1 50 > "$TMP/floor.out"
+FWALL=$(result_field "$TMP/floor.out" exec_seconds)
+python3 -c "
+fwall, tfree = float('$FWALL'), float('$TFREE')
+# must run at the unthrottled baseline's pace, not the throttled one's
+assert fwall < max(0.25, tfree * 2), f'floor not deducted: {fwall}s (free {tfree}s)'
+print(f'   floored wall: {fwall}s (unthrottled {tfree}s, throttled $TWALL s)')"
+
 echo "== 8. core-limit proportionality: 75% vs 25% admitted duty ~ 3:1 =="
 # serial completion-coupled loop (execute -> D2H await), the serving pattern:
 # deterministic on a loaded 1-core box, where 500 free-running async submits
