@@ -158,8 +158,8 @@ assert tfree < twall / 2, f'unthrottled control not faster: {tfree} vs {twall}'
 print(f'   tunnel-mode throttled={twall}s unthrottled={tfree}s')"
 
 echo "== 7d. operator transport floor: VTPU_CHARGE_FLOOR_MS exempts the RTT =="
-# Same tunnel-shaped run as 7c, but the operator declares a 2ms transport
-# floor — exactly the per-step wall here — so the sync-wall charges vanish
+# Same tunnel-shaped run as 7c, but the operator declares a 3ms transport
+# floor — above the ~2ms per-step wall — so every sync-wall charge vanishes
 # and the limiter must NOT throttle (on a real proxied runtime the floor is
 # the probed dispatch RTT and only true chip time above it is charged).
 env VTPU_REAL_LIBTPU=$PWD/$B/fake_pjrt.so TPU_CORE_LIMIT=20 \

@@ -141,6 +141,8 @@ def test_allocate_full_slice(served_plugin):
     # fractional share on a non-exclusive chip: attach queueing armed
     # (docs/multitenancy.md exclusive-attach fallback)
     assert env[envs.ENV_ATTACH_WAIT] == "120000"
+    # no floor configured -> the knob is absent (local-runtime default)
+    assert envs.ENV_CHARGE_FLOOR not in env
     mounts = {m.container_path: m.host_path for m in ctr.mounts}
     assert mounts["/etc/ld.so.preload"].endswith("ld.so.preload")
     assert "/usr/local/vtpu/libvtpu.so" in mounts
@@ -304,3 +306,33 @@ def test_allocate_multi_container_consumes_in_order(served_plugin):
     assert envs0[envs.ENV_DEVICE_MEMORY_LIMIT.format(index=0)] == "2048m"
     assert envs1[envs.ENV_DEVICE_MEMORY_LIMIT.format(index=0)] == "1024m"
     sched.stop()
+
+
+def test_allocate_charge_floor_passthrough(mock_chips, tmp_path):
+    """chargeFloorMs (chart) -> --charge-floor-ms (plugin) -> the Allocate env
+    contract, so libvtpu deducts the declared transport floor from duty
+    charges on proxied runtimes (docs/protocol.md)."""
+    client = fake_cluster({"host1": v5e_devices(8, prefix="host1-tpu")})
+    rm = TpuResourceManager(mock_chips, split_count=4)
+    config = PluginConfig(node_name="host1", hook_path=str(tmp_path / "hook"),
+                          charge_floor_ms=150)
+    plugin = TpuDevicePlugin(rm, client, config)
+    server = PluginServer(plugin, str(tmp_path / "vtpu.sock"))
+    server.start()
+    sched = Scheduler(client)
+    register_tpu_backend(quota=sched.quota_manager)
+    sched.start(register_interval=3600)
+    try:
+        pod = client.put_pod(tpu_pod("floored", tpumem=2048))
+        assert sched.filter({"Pod": pod, "NodeNames": ["host1"]})["NodeNames"]
+        assert sched.bind({"PodName": "floored", "PodNamespace": "default",
+                           "Node": "host1"})["Error"] == ""
+        with grpc.insecure_channel(f"unix://{server.socket_path}") as ch:
+            resp = DevicePluginStub(ch).Allocate(pb.AllocateRequest(
+                container_requests=[pb.ContainerAllocateRequest(
+                    devicesIDs=["host1-tpu-0::0"])]))
+        env = dict(resp.container_responses[0].envs)
+        assert env[envs.ENV_CHARGE_FLOOR] == "150"
+    finally:
+        sched.stop()
+        server.stop(grace=0.1)

@@ -43,6 +43,11 @@ def main() -> None:
     parser.add_argument("--cdi", action="store_true",
                         help="write a CDI spec and name qualified devices in Allocate")
     parser.add_argument("--cdi-dir", default="/var/run/cdi")
+    parser.add_argument("--charge-floor-ms", type=int,
+                        default=int(os.environ.get("VTPU_CHARGE_FLOOR_MS", "0")),
+                        help="transport floor (ms) libvtpu deducts from duty "
+                             "charges; set to the per-dispatch RTT on proxied "
+                             "runtimes (docs/protocol.md)")
     parser.add_argument("-v", "--verbose", action="count", default=0)
     args = parser.parse_args()
 
@@ -112,6 +117,7 @@ def main() -> None:
         cdi_enabled=args.cdi,
         cdi_dir=args.cdi_dir,
         qos_enabled=args.qos,
+        charge_floor_ms=args.charge_floor_ms,
         slice_info=slice_info,
     )
     if args.cdi:
