@@ -17,12 +17,14 @@ minutes (measured 80->220 ms p50 across one session), phases are NOT run
 sequentially: all tenants boot and warm once, then measurement windows
 alternate in time —
 
-  overhead windows:  native-exclusive block <-> stack-exclusive block, so
-                     the with/without-libvtpu delta is drift-cancelled;
-  sharing windows:   native-exclusive block <-> all-4-stacked-tenants block
-                     on open-loop arrival clocks (~1/8 duty each), so the
-                     shared p50 compares against a CONTEMPORANEOUS
-                     exclusive baseline.
+  overhead windows:  native-exclusive block <-> stack-exclusive block
+                     (order alternated per round), so the with/without-
+                     libvtpu delta is drift-cancelled;
+  sharing windows:   the SAME four stacked tenants solo (one at a time) <->
+                     all four at once on open-loop arrival clocks (~1/8 duty
+                     each): per-session latency character (+-10% between
+                     tunnel sessions) cancels because every tenant is its
+                     own exclusive control.
 
 Prints exactly ONE JSON line on stdout:
   {"metric": ..., "value": <p90 of per-round shared-vs-native degradations %
@@ -441,7 +443,24 @@ def main() -> None:
         # whichever block runs second, each shared block is compared to the
         # mean of the exclusive blocks on BOTH sides of it (B0 S0 B1 S1 ...
         # Bn); the headline aggregates the per-round paired degradations.
+        #
+        # The exclusive baseline comes from the SAME four stack tenants
+        # running SOLO (one at a time), not from the native tenant: every
+        # process gets its own tunnel session with its own latency character
+        # (±10% between sessions — an 11-round alternated A/B measured one
+        # session consistently 9% faster), so only a same-session baseline
+        # isolates SHARING from session pairing luck. The native tenant
+        # remains the overhead phase's unwrapped control only.
         interval_ms = DUTY_FACTOR * statistics.fmean(nat_totals) * 1000.0
+        solo_block = max(4, share_base_block // TENANTS)
+
+        def stacks_solo_block() -> list[float]:
+            # each tenant alone on the chip, back to back: the per-session
+            # exclusive baseline for exactly the sessions that then share
+            out: list[float] = []
+            for s in stacks:
+                out += s.run_block(solo_block)["ttfts"]
+            return out
         # One UNMEASURED warm-up shared window: the first concurrent window
         # pays one-off costs no later round sees (four processes' first
         # simultaneous dispatches re-priming the transport; observed as a
@@ -453,9 +472,9 @@ def main() -> None:
             s.read_block()
         base_ttfts: list[float] = []
         shared_ttfts: list[float] = []
-        base_medians: list[float] = [
-            statistics.median(native.run_block(share_base_block)["ttfts"])
-        ]
+        first_base = stacks_solo_block()
+        base_ttfts += first_base
+        base_medians: list[float] = [statistics.median(first_base)]
         shared_medians: list[float] = []
         for _ in range(sharing_rounds):
             shared_r: list[float] = []
@@ -465,7 +484,7 @@ def main() -> None:
                 shared_r += s.read_block()["ttfts"]
             shared_ttfts += shared_r
             shared_medians.append(statistics.median(shared_r))
-            base_r = native.run_block(share_base_block)["ttfts"]
+            base_r = stacks_solo_block()
             base_ttfts += base_r
             base_medians.append(statistics.median(base_r))
         round_degradations = [
