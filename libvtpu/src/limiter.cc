@@ -33,7 +33,8 @@ void DutyCycleLimiter::refill(uint64_t now_ns) {
   tokens_ns_ = std::min(tokens_ns_, burst_cap);
 }
 
-uint64_t DutyCycleLimiter::admit(uint64_t now_ns) {
+uint64_t DutyCycleLimiter::admit(uint64_t now_ns, uint64_t* precharge_ns) {
+  if (precharge_ns) *precharge_ns = 0;
   if (limit_percent_ <= 0 || limit_percent_ >= 100) return 0;
   uint64_t waited = 0;
   std::unique_lock<std::mutex> lock(mu_);
@@ -44,8 +45,18 @@ uint64_t DutyCycleLimiter::admit(uint64_t now_ns) {
     // a deep pipeline leaking into the EMA) would otherwise spin forever.
     int64_t burst_cap = (int64_t)(window_ns_ * limit_percent_ / 100);
     int64_t need = (int64_t)est_ns_ < burst_cap ? (int64_t)est_ns_ : burst_cap;
+    // Floor at 1 ns: a zero pre-charge reads as "unenforced" to settle(),
+    // which would let an enforced execution whose EMA decayed to 0 skip its
+    // busy-time debit entirely.
+    if (need < 1) need = 1;
     if (tokens_ns_ >= need) {
-      tokens_ns_ -= (int64_t)est_ns_;  // pre-charge; settle() corrects later
+      // Pre-charge only the capped requirement, not the raw EMA: after a
+      // clamped transport-anomaly charge inflates the estimate, the full
+      // est_ns_ could sink tokens many windows negative and stall every
+      // subsequent admit until its settle refund lands. settle() refunds
+      // this exact amount and charges the observed cost instead.
+      tokens_ns_ -= need;
+      if (precharge_ns) *precharge_ns = (uint64_t)need;
       return waited;
     }
     uint64_t deficit = (uint64_t)(need - tokens_ns_);
@@ -59,12 +70,13 @@ uint64_t DutyCycleLimiter::admit(uint64_t now_ns) {
   }
 }
 
-void DutyCycleLimiter::settle(uint64_t busy_ns, uint64_t now_ns, bool precharged) {
+void DutyCycleLimiter::settle(uint64_t busy_ns, uint64_t now_ns,
+                              uint64_t precharge_ns) {
   std::lock_guard<std::mutex> lock(mu_);
-  if (precharged && limit_percent_ > 0 && limit_percent_ < 100) {
+  if (precharge_ns > 0 && limit_percent_ > 0 && limit_percent_ < 100) {
     refill(mono_now_ns());
-    // Replace the pre-charged estimate with the observed cost.
-    tokens_ns_ += (int64_t)est_ns_;
+    // Replace exactly what admit() pre-charged with the observed cost.
+    tokens_ns_ += (int64_t)precharge_ns;
     tokens_ns_ -= (int64_t)busy_ns;
   }
   est_ns_ = (est_ns_ * 7 + busy_ns) / 8;  // EMA, 1/8 weight
@@ -135,12 +147,12 @@ static uint64_t clamp_charge(uint64_t charged, uint64_t window_ns) {
 }
 
 void DutyCycleLimiter::settle_interval(uint64_t start_ns, uint64_t end_ns,
-                                       bool precharged) {
+                                       uint64_t precharge_ns) {
   std::lock_guard<std::mutex> lock(mu_);
   uint64_t charged = uncovered_and_insert(start_ns, end_ns);
-  if (precharged && limit_percent_ > 0 && limit_percent_ < 100) {
+  if (precharge_ns > 0 && limit_percent_ > 0 && limit_percent_ < 100) {
     refill(mono_now_ns());
-    tokens_ns_ += (int64_t)est_ns_;  // refund the pre-charge
+    tokens_ns_ += (int64_t)precharge_ns;  // refund exactly the pre-charge
     tokens_ns_ -= (int64_t)charged;
   }
   // The EMA tracks the union-charged (device-attributed) cost, NOT the raw

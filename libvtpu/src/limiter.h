@@ -23,13 +23,18 @@ class DutyCycleLimiter {
       : limit_percent_(limit_percent), window_ns_(window_ns) {}
 
   // Block until the allowance covers the next execution, then pre-charge the
-  // current estimate. Returns the nanoseconds waited.
-  uint64_t admit(uint64_t now_ns);
+  // capped requirement (never more than one window's burst budget — settle
+  // reconciles the observed cost either way, and pre-charging a transport-
+  // anomaly-inflated EMA would sink tokens windows-negative and stall later
+  // admits until the refund lands). Returns the nanoseconds waited; the
+  // amount actually pre-charged is written to *precharge_ns (0 when not
+  // enforcing) and must be passed back to the matching settle call.
+  uint64_t admit(uint64_t now_ns, uint64_t* precharge_ns = nullptr);
 
-  // Settle a completed execution: when it was pre-charged by admit(), replace
-  // the estimate with the observed busy time; otherwise only update the EMA
-  // and util window (no token debt for unenforced submissions).
-  void settle(uint64_t busy_ns, uint64_t now_ns, bool precharged);
+  // Settle a completed execution: refund exactly what admit() pre-charged
+  // (precharge_ns, 0 for unenforced submissions — then no token debt) and
+  // charge the observed busy time; always update the EMA and util window.
+  void settle(uint64_t busy_ns, uint64_t now_ns, uint64_t precharge_ns);
 
   // Settle a completed execution from its MONOTONIC [submit, ready] interval,
   // with UNION accounting against every other charged interval: time already
@@ -37,7 +42,7 @@ class DutyCycleLimiter {
   // twice. The EMA estimate tracks the union-charged (device-attributed)
   // cost — NOT the raw submit->ready latency, which on a deep pipeline
   // includes the whole queue wait and would ratchet past the admit budget.
-  void settle_interval(uint64_t start_ns, uint64_t end_ns, bool precharged);
+  void settle_interval(uint64_t start_ns, uint64_t end_ns, uint64_t precharge_ns);
 
   // Charge a wall-clock interval the process spent blocked ON the runtime
   // (D2H reads, event waits). This is the busy signal of last resort:

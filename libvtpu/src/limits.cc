@@ -67,6 +67,10 @@ Limits parse_limits_from_env() {
     long long ms = std::atoll(v);
     limits.charge_floor_ns = ms > 0 ? (uint64_t)ms * 1000000ull : 0;
   }
+  if (const char* v = std::getenv("VTPU_D2H_EVENT_HOOK")) {
+    limits.d2h_event_hook =
+        !(std::strcmp(v, "false") == 0 || std::strcmp(v, "0") == 0);
+  }
   return limits;
 }
 

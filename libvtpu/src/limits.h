@@ -34,6 +34,11 @@ struct Limits {
   // constant-cost real work as floor. 0 (default) = charge full walls,
   // correct for local runtimes with µs dispatch.
   uint64_t charge_floor_ns = 0;
+  // VTPU_D2H_EVENT_HOOK=0 disables piggybacking OnReady listeners on the
+  // caller-owned D2H transfer event (for PJRT plugins with single-listener
+  // event semantics); the shim then charges only the synchronous portion of
+  // ToHostBuffer. Default on: XLA-family plugins support multi-listener.
+  bool d2h_event_hook = true;
 
   bool mem_enforced() const { return !disable_control; }
   bool core_enforced() const {

@@ -152,6 +152,9 @@ struct State {
   std::mutex mu;
   std::vector<DeviceState> devices;
   std::unordered_map<PJRT_Device*, size_t> device_index;
+  // Lock-free mirror of device_index.size() for hot paths (event await)
+  // that only need "single chip or not" — fixed after client creation.
+  std::atomic<size_t> device_count{0};
   // buffer -> (device index, bytes)
   std::unordered_map<PJRT_Buffer*, std::pair<size_t, uint64_t>> buffers;
 
@@ -237,6 +240,7 @@ size_t device_index_of(PJRT_Device* device) {
   if (it != s.device_index.end()) return it->second;
   size_t idx = s.device_index.size();
   s.device_index.emplace(device, idx);
+  s.device_count.store(s.device_index.size(), std::memory_order_relaxed);
   return idx;
 }
 
@@ -259,6 +263,7 @@ void refresh_device_map(PJRT_Client* client) {
   for (size_t i = 0; i < args.num_addressable_devices; i++) {
     s.device_index[args.addressable_devices[i]] = i;
   }
+  s.device_count.store(s.device_index.size(), std::memory_order_relaxed);
   VTPU_INFO("mapped %zu addressable devices", args.num_addressable_devices);
 }
 
@@ -857,9 +862,14 @@ PJRT_Error* wrapped_event_await(PJRT_Event_Await_Args* args) {
   uint64_t t1 = tick_ns();
   st.await_ns.fetch_add(t1 - t0, std::memory_order_relaxed);
   // An event alone does not identify its device; charge chip 0 — exact for
-  // the single-chip containers vTPU shares (a multi-chip assignment gets
-  // its attribution from the per-buffer D2H path instead).
-  charge_sync_wall(0, t0, t1);
+  // the single-chip containers vTPU shares. On a multi-chip assignment the
+  // owning chip is unknowable here, so skip entirely: charging chip 0 for
+  // waits on chips 1..N would over-throttle it while the busy chip goes
+  // uncharged. Those assignments get attribution from the per-buffer D2H
+  // path and per-device execute completion events instead.
+  if (S().device_count.load(std::memory_order_relaxed) <= 1) {
+    charge_sync_wall(0, t0, t1);
+  }
   return err;
 }
 
@@ -896,10 +906,16 @@ PJRT_Error* wrapped_to_host(PJRT_Buffer_ToHostBuffer_Args* args) {
   if (err != nullptr) return err;
   // The D2H completion EVENT is the one signal even eager-event runtimes
   // must keep honest — the caller's bytes have to actually arrive. Observe
-  // it (without consuming: OnReady supports multiple listeners) and charge
-  // [call, ready]; if there is no event, the call itself was synchronous.
+  // it WITHOUT consuming and charge [call, ready]; if there is no event,
+  // the call itself was synchronous. Piggybacking on the caller-owned event
+  // assumes PJRT_Event_OnReady supports multiple listeners and callbacks
+  // survive the caller's PJRT_Event_Destroy — true for the XLA reference
+  // implementation (libtpu, CPU/GPU plugins) but not a stated C-API
+  // guarantee, so VTPU_D2H_EVENT_HOOK=0 opts out for plugins with
+  // single-listener semantics (falls back to charging the sync portion).
   bool hooked = false;
-  if (args->event != nullptr && s.real->PJRT_Event_OnReady != nullptr) {
+  if (s.limits.d2h_event_hook && args->event != nullptr &&
+      s.real->PJRT_Event_OnReady != nullptr) {
     auto* ctx = new D2hCtx{dev_idx, t0};
     PJRT_Event_OnReady_Args on;
     std::memset(&on, 0, sizeof(on));
@@ -960,6 +976,7 @@ PJRT_Error* wrapped_client_destroy(PJRT_Client_Destroy_Args* args) {
   {
     std::lock_guard<std::mutex> lock(s.mu);
     s.device_index.clear();
+    s.device_count.store(0, std::memory_order_relaxed);
     s.buffers.clear();
     released.resize(s.devices.size(), 0);
     for (size_t i = 0; i < s.devices.size(); i++) {
@@ -990,7 +1007,7 @@ PJRT_Error* wrapped_loaded_executable_destroy(
 struct ExecDoneCtx {
   size_t dev_idx;
   uint64_t submit_ns;
-  bool precharged;
+  uint64_t precharge_ns;  // exactly what admit() pre-charged (0 = unenforced)
   PJRT_Event* own_event;  // non-null when the SHIM requested the event
 };
 
@@ -1004,7 +1021,7 @@ void exec_done_cb(PJRT_Error* error, void* user_arg) {
   {
     std::lock_guard<std::mutex> lock(s.mu);
     s.dev(ctx->dev_idx).limiter->settle_interval(ctx->submit_ns, now,
-                                                 ctx->precharged);
+                                                 ctx->precharge_ns);
   }
   if (s.region) {
     std::lock_guard<std::mutex> lock(s.mu);
@@ -1044,11 +1061,10 @@ PJRT_Error* wrapped_execute(PJRT_LoadedExecutable_Execute_Args* args) {
     std::lock_guard<std::mutex> lock(s.mu);
     limiter = s.dev(dev_idx).limiter;
   }
-  bool precharged = false;
+  uint64_t precharge_ns = 0;
   if (enforce) {
     ScopedNs timer(st.admit_ns);
-    waited = limiter->admit(now_ns());
-    precharged = limiter->enforcing();
+    waited = limiter->admit(now_ns(), &precharge_ns);
   }
 
   // Busy-time feedback needs a completion event. JAX does NOT request
@@ -1094,7 +1110,7 @@ PJRT_Error* wrapped_execute(PJRT_LoadedExecutable_Execute_Args* args) {
                               : nullptr);
   if (ev != nullptr && s.real->PJRT_Event_OnReady != nullptr) {
     ScopedNs timer(st.onready_ns);
-    auto* ctx = new ExecDoneCtx{dev_idx, submit_ns, precharged,
+    auto* ctx = new ExecDoneCtx{dev_idx, submit_ns, precharge_ns,
                                 synthesized ? ev : nullptr};
     PJRT_Event_OnReady_Args on;
     std::memset(&on, 0, sizeof(on));
@@ -1120,7 +1136,7 @@ PJRT_Error* wrapped_execute(PJRT_LoadedExecutable_Execute_Args* args) {
   }
   if (!hooked) {
     // No completion signal: the pre-charged estimate stands as the cost.
-    limiter->settle(limiter->estimate_ns(), submit_ns, precharged);
+    limiter->settle(limiter->estimate_ns(), submit_ns, precharge_ns);
   }
 
   // Account execute outputs so the cap covers results, not just host uploads.

@@ -134,3 +134,36 @@ def test_moe_prefill_right_padding_is_harmless():
     np.testing.assert_allclose(
         np.asarray(cache_pad["k"][:, :, :12]), np.asarray(cache_true["k"][:, :, :12]),
         rtol=2e-5, atol=2e-5)
+
+
+def test_moe_prefill_true_len_masks_pads_and_bounds_capacity():
+    """ADVICE r3 (low): capacity = full token count grows dispatch/combine to
+    [T, E, T]. With true_len, pads are masked out of routing so capacity can
+    follow the cf formula — pads claim no capacity slot, so they can never
+    evict a real token. (Routing-imbalance overflow drops remain possible
+    under the formula capacity, as in training; this prompt stays well
+    within capacity at both bucket sizes, so outputs here are exact.)"""
+    from vtpu.models.moe import moe_prefill
+
+    cfg = MoEConfig(
+        vocab=128, d_model=32, n_heads=2, n_layers=2, d_ff=64,
+        n_experts=4, top_k=2, capacity_factor=2.0,
+        max_seq=64, head_dim=16, dtype=jnp.float32,
+    )
+    params = init_moe_params(jax.random.key(0), cfg)
+    true = jnp.asarray(
+        np.random.RandomState(1).randint(0, cfg.vocab, (1, 12)), jnp.int32)
+    # same prompt in two bucket sizes; pads masked via true_len
+    pad32 = jnp.concatenate([true, jnp.zeros((1, 20), jnp.int32)], axis=1)
+    pad48 = jnp.concatenate([true, jnp.zeros((1, 36), jnp.int32)], axis=1)
+    logits32, _ = moe_prefill(params, cfg, pad32, true_len=jnp.int32(12))
+    logits48, _ = moe_prefill(params, cfg, pad48, true_len=jnp.int32(12))
+    np.testing.assert_allclose(
+        np.asarray(logits32[:, :12]), np.asarray(logits48[:, :12]),
+        rtol=2e-5, atol=2e-5)
+    # and the masked path matches the no-drop exact forward at cf ample
+    # enough that the formula capacity can't drop a 12-token prompt
+    logits_exact, _ = moe_prefill(params, cfg, true)
+    np.testing.assert_allclose(
+        np.asarray(logits32[:, :12]), np.asarray(logits_exact),
+        rtol=2e-5, atol=2e-5)

@@ -80,7 +80,8 @@ def init_moe_params(rng: jax.Array, cfg: MoEConfig) -> Params:
 
 
 def route(
-    router_w: jax.Array, x: jax.Array, cfg: MoEConfig, capacity: int
+    router_w: jax.Array, x: jax.Array, cfg: MoEConfig, capacity: int,
+    pad_mask: jax.Array | None = None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Top-k routing over flat tokens x: [T, D].
 
@@ -88,6 +89,9 @@ def route(
     aux load-balancing loss scalar). Tokens beyond an expert's capacity are
     dropped (their combine row is zero -> residual passes them through),
     matching GShard semantics with k-th-choice priority ordering.
+    ``pad_mask`` ([T] bool, True = real token) excludes pads from routing
+    entirely: they claim no capacity slot, so real tokens' slot positions
+    depend only on other real tokens — right padding cannot change them.
     """
     t, e = x.shape[0], cfg.n_experts
     logits = x.astype(jnp.float32) @ router_w  # [T, E]
@@ -100,6 +104,8 @@ def route(
     prev_counts = jnp.zeros((e,), jnp.int32)
     for j in range(cfg.top_k):  # static unroll (top_k is 2)
         onehot = jax.nn.one_hot(gate_idx[:, j], e, dtype=jnp.int32)  # [T, E]
+        if pad_mask is not None:
+            onehot = onehot * pad_mask.astype(jnp.int32)[:, None]
         pos_all = jnp.cumsum(onehot, axis=0) - onehot + prev_counts[None, :]
         pos = jnp.sum(pos_all * onehot, axis=-1)  # [T] slot within chosen expert
         keep = pos < capacity
@@ -124,19 +130,23 @@ def expert_ffn(lp_e: dict[str, jax.Array], slots: jax.Array) -> jax.Array:
 
 
 def moe_ffn(lp: dict[str, jax.Array], x: jax.Array, cfg: MoEConfig,
-            capacity: int | None = None) -> tuple[jax.Array, jax.Array]:
+            capacity: int | None = None,
+            pad_mask: jax.Array | None = None) -> tuple[jax.Array, jax.Array]:
     """Single-device (or annotation-sharded) MoE block. x: [B, S, D].
 
     With `w_gate`/`w_up`/`w_down` sharded P('ep') on the expert axis, XLA turns
     the dispatch/combine einsums into all-to-alls over 'ep' by itself -- the
     pjit path. ``capacity`` overrides the config formula (serving decode
     passes the full token count so routing can never drop a token).
+    ``pad_mask`` ([B, S] bool, True = real) keeps pads out of routing.
     Returns (out [B, S, D], aux_loss).
     """
     b, s, d = x.shape
     flat = x.reshape(b * s, d)
     cap = capacity or cfg.capacity(b * s)
-    dispatch, combine, aux = route(lp["router"], flat, cfg, cap)
+    dispatch, combine, aux = route(
+        lp["router"], flat, cfg, cap,
+        pad_mask=None if pad_mask is None else pad_mask.reshape(b * s))
     slots = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), flat)  # [E, C, D]
     out_slots = expert_ffn(lp, slots)
     out = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), out_slots)
@@ -211,11 +221,25 @@ def moe_decode_ffn(cfg: MoEConfig):
 
 
 def moe_prefill(
-    params: Params, cfg: MoEConfig, tokens: jax.Array
+    params: Params, cfg: MoEConfig, tokens: jax.Array,
+    true_len: jax.Array | None = None,
 ) -> tuple[jax.Array, dict[str, jax.Array]]:
     """Full-sequence forward that also fills a KV cache — the serving-side
     sibling of moe_forward (same trunk, same expert routing; the aux term is
-    dropped). tokens: [B, S] -> (logits [B, S, V], cache)."""
+    dropped). tokens: [B, S] -> (logits [B, S, V], cache).
+
+    ``true_len`` (scalar or [B] int32) marks where the right padding starts.
+    When given, pads are masked OUT of expert routing — they claim no
+    capacity slot, so a pad can never evict a real token — and capacity
+    uses the config's capacity-factor formula over the bucket instead of
+    the full token count, bounding dispatch/combine memory at the largest
+    prefill buckets (ADVICE r3). Note the formula capacity carries GShard
+    drop semantics, exactly like training: under extreme routing imbalance
+    a real token's overflow choice past capacity drops to the residual
+    path (and since capacity scales with the bucket, the drop threshold
+    does too). Without true_len, capacity = full token count: no token
+    (real or pad) can ever drop — exact, but O(E/cf) more dispatch memory.
+    """
     from vtpu.models.transformer import init_kv_cache
 
     b, s = tokens.shape
@@ -223,15 +247,21 @@ def moe_prefill(
     positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
     x = params["embed"][tokens].astype(cfg.dtype)
 
+    pad_mask = None
+    if true_len is not None:
+        lens = jnp.reshape(jnp.asarray(true_len, jnp.int32), (-1, 1))  # [B|1, 1]
+        pad_mask = positions < lens  # [B, S]
+
     def serving_ffn(lp, normed, cfg_):
-        # capacity = the full token count, like moe_decode_ffn: the serving
-        # engine prefills RIGHT-PADDED [1, bucket] prompts, and under the
-        # training capacity formula a pad token's first choice could exhaust
-        # an expert before a real token's second choice claims its slot —
-        # padding would change a real token's output. With capacity >= T no
-        # token (real or pad) can ever be dropped, and since expert outputs
-        # are slot-position-invariant, right padding becomes exactly
-        # harmless (prefill_into_slot's contract).
+        # The serving engine prefills RIGHT-PADDED [1, bucket] prompts, and
+        # under the raw training formula a pad token's first choice could
+        # exhaust an expert before a real token's second choice claims its
+        # slot — padding would change a real token's output. Two exact-safe
+        # modes: with true_len, pads are masked out of routing so real
+        # tokens compete only with each other and the cf formula bounds
+        # capacity; without it, capacity >= T means nobody can drop.
+        if pad_mask is not None:
+            return moe_ffn(lp, normed, cfg_, pad_mask=pad_mask)
         return moe_ffn(lp, normed, cfg_, capacity=normed.shape[0] * normed.shape[1])
 
     def layer(x, lp):

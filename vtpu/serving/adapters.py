@@ -106,9 +106,11 @@ class MoeSlotModel:
         from vtpu.models.moe import moe_prefill
         from vtpu.serving.engine import prefill_into_slot
 
+        # Forward true_len so pads are masked out of routing and capacity
+        # follows the cf formula instead of the full bucket (moe_prefill).
         return prefill_into_slot(
             params, self.cfg, state, padded, slot, true_len,
-            prefill_fn=moe_prefill,
+            prefill_fn=lambda p, c, t: moe_prefill(p, c, t, true_len=true_len),
         )
 
     def decode_step(self, params, state, tokens, active, kv_bucket,
