@@ -45,7 +45,7 @@ def register_tpu_backend(quota: QuotaManager | None = None, **cfg) -> TpuDevices
 
 
 def tpu_pod(name, tpu=None, tpumem=None, tpucores=None, ns="default", annotations=None,
-            extra_containers=0):
+            extra_containers=0, init_limits=None):
     limits = {}
     if tpu is not None:
         limits["google.com/tpu"] = str(tpu)
@@ -56,10 +56,14 @@ def tpu_pod(name, tpu=None, tpumem=None, tpucores=None, ns="default", annotation
     containers = [{"name": "main", "resources": {"limits": limits}}]
     for i in range(extra_containers):
         containers.append({"name": f"side{i}", "resources": {}})
+    spec = {"containers": containers}
+    if init_limits is not None:
+        spec["initContainers"] = [
+            {"name": "init0", "resources": {"limits": dict(init_limits)}}]
     return {
         "metadata": {"name": name, "namespace": ns, "uid": f"uid-{name}",
                      "annotations": dict(annotations or {})},
-        "spec": {"containers": containers},
+        "spec": spec,
     }
 
 

@@ -123,9 +123,10 @@ class Devices(abc.ABC):
         LockNode). Default: lock when any container has a non-empty request."""
         from vtpu.util import nodelock
 
+        spec = pod.get("spec", {})
         if not any(
             not self.generate_resource_requests(c).empty()
-            for c in pod.get("spec", {}).get("containers", [])
+            for c in (spec.get("initContainers") or []) + (spec.get("containers") or [])
         ):
             return
         nodelock.lock_node(client, node["metadata"]["name"], pod)
@@ -133,9 +134,10 @@ class Devices(abc.ABC):
     def release_node_lock(self, node: dict, pod: dict, client: "KubeClient") -> None:
         from vtpu.util import nodelock
 
+        spec = pod.get("spec", {})
         if not any(
             not self.generate_resource_requests(c).empty()
-            for c in pod.get("spec", {}).get("containers", [])
+            for c in (spec.get("initContainers") or []) + (spec.get("containers") or [])
         ):
             return
         nodelock.release_node_lock(client, node["metadata"]["name"], pod)

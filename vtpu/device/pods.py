@@ -58,9 +58,14 @@ class PodManager:
                 uid=meta["uid"],
                 node_id=node_id,
                 devices=devices,
+                # aligned with the decision's per-container device rows:
+                # init containers first (Scheduler.pod_requests order)
                 ctr_ids=[
                     c.get("name", f"ctr{i}")
-                    for i, c in enumerate(pod.get("spec", {}).get("containers") or [])
+                    for i, c in enumerate(
+                        (pod.get("spec", {}).get("initContainers") or [])
+                        + (pod.get("spec", {}).get("containers") or [])
+                    )
                 ],
                 group=pod_group_name(pod),
                 slice_workers=slice_workers(pod),

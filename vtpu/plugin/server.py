@@ -178,7 +178,8 @@ class TpuDevicePlugin:
             )
         try:
             response = self._allocate_pending(pod, request)
-            pod_allocation_try_success(self.client, pod)
+            pod_allocation_try_success(
+                self.client, pod, in_request_annos=[IN_REQUEST_ANNO])
             return response
         except Exception as e:
             log.exception("allocate failed for %s", pod["metadata"].get("name"))
@@ -199,7 +200,12 @@ class TpuDevicePlugin:
         if not raw:
             raise RuntimeError(f"pod has no {IN_REQUEST_ANNO} annotation")
         slots = codec.decode_pod_single_device(raw)
-        containers = pod.get("spec", {}).get("containers", [])
+        # Decision slots are written init containers FIRST, then app
+        # containers (Scheduler.pod_requests; reference Resourcereqs
+        # devices.go:611-663) — the same order kubelet issues Allocate calls
+        # in, since init containers are admitted and run before app ones.
+        spec = pod.get("spec", {})
+        containers = (spec.get("initContainers") or []) + (spec.get("containers") or [])
         # non-empty slots pair up, in order, with kubelet's container_requests
         pending = [(i, slot) for i, slot in enumerate(slots) if slot]
         if len(request.container_requests) > len(pending):
@@ -242,8 +248,12 @@ class TpuDevicePlugin:
             responses.append(self._container_response(pod, ctr_name, devices))
             consumed.append(slot_idx)
         # consume the assignment (reference eraseNextDeviceTypeFromAnnotation
-        # plugin/util.go:96-122): drop used slots, keep the rest
-        remaining = [slot for i, slot in enumerate(slots) if i not in consumed]
+        # plugin/util.go:96-122): EMPTY used slots in place rather than drop
+        # them — slot index must keep addressing the same container across
+        # successive Allocate calls (kubelet issues one per container), or
+        # the second call's ctr_name/region-dir pairing shifts onto the
+        # wrong container
+        remaining = [[] if i in consumed else slot for i, slot in enumerate(slots)]
         self.client.patch_pod_annotations(
             pod["metadata"].get("namespace", "default"),
             pod["metadata"]["name"],

@@ -36,6 +36,8 @@ from vtpu.scheduler.policy import pick_winner
 from vtpu.util import nodelock
 from vtpu.util import types as t
 from vtpu.util.helpers import (
+    app_containers,
+    init_containers,
     is_pod_deleted,
     pod_annotations,
     pod_group_name,
@@ -251,12 +253,17 @@ class Scheduler:
 
     @staticmethod
     def pod_requests(pod: dict) -> list[score_mod.ContainerRequests]:
-        """Per-container, per-vendor device requests (reference Resourcereqs
-        devices.go:611-663). Init containers: the scheduler requires each init
-        container's ask to be covered by the pod's regular containers (the
-        common k8s device-plugin pattern); a larger init ask is unsupported."""
+        """Per-container, per-vendor device requests with init containers
+        FIRST (reference Resourcereqs devices.go:611-663): every init
+        container gets its own request row, sized and fit like a regular
+        container's. Row order matters — kubelet allocates init containers
+        before app containers, so the plugin's in-order pairing of Allocate
+        calls with non-empty decision slots holds. Fitting init rows
+        cumulatively with app rows is conservative (kubelet may reuse an
+        init container's devices for an app container), matching the
+        reference."""
         out: list[score_mod.ContainerRequests] = []
-        for ctr in pod.get("spec", {}).get("containers", []) or []:
+        for ctr in init_containers(pod) + app_containers(pod):
             reqs: score_mod.ContainerRequests = {}
             for vendor, backend in DEVICES_MAP.items():
                 r = backend.generate_resource_requests(ctr)

@@ -44,15 +44,26 @@ class WebHook:
             # Foreign scheduler owns this pod (reference webhook.go:64-69).
             return out
 
+        # Init containers are mutated and quota-checked like app containers:
+        # the scheduler sizes a request row for each (Resourcereqs semantics,
+        # reference devices.go:611-663), so admission must normalize them the
+        # same way. The reference webhook walks only spec.containers — a
+        # device-requesting init container silently bypassed it; closed here.
         found = False
-        for ctr in spec.get("containers", []) or []:
-            if (ctr.get("securityContext") or {}).get("privileged"):
-                # Privileged containers see all devices anyway; don't hook them
-                # (reference webhook.go:74-79).
-                continue
-            for backend in DEVICES_MAP.values():
-                if backend.mutate_admission(ctr, pod):
-                    found = True
+        init_found = False
+        for is_init, ctrs in (
+            (False, spec.get("containers", []) or []),
+            (True, spec.get("initContainers", []) or []),
+        ):
+            for ctr in ctrs:
+                if (ctr.get("securityContext") or {}).get("privileged"):
+                    # Privileged containers see all devices anyway; don't hook
+                    # them (reference webhook.go:74-79).
+                    continue
+                for backend in DEVICES_MAP.values():
+                    if backend.mutate_admission(ctr, pod):
+                        found = True
+                        init_found = init_found or is_init
         if not found:
             return out
 
@@ -74,6 +85,12 @@ class WebHook:
             {"op": "replace", "path": "/spec/containers", "value": spec["containers"]},
             {"op": "add", "path": "/spec/schedulerName", "value": self.scheduler_name},
         ]
+        if init_found:
+            patch.insert(1, {
+                "op": "replace",
+                "path": "/spec/initContainers",
+                "value": spec["initContainers"],
+            })
         response["patchType"] = "JSONPatch"
         response["patch"] = base64.b64encode(json.dumps(patch).encode()).decode()
         return out
@@ -82,7 +99,8 @@ class WebHook:
         """Admission-time namespace quota pre-check (reference
         fitResourceQuota webhook.go:111-158)."""
         ns = pod.get("metadata", {}).get("namespace", "default")
-        for ctr in pod.get("spec", {}).get("containers", []) or []:
+        spec = pod.get("spec", {})
+        for ctr in (spec.get("initContainers") or []) + (spec.get("containers") or []):
             for vendor, backend in DEVICES_MAP.items():
                 req = backend.generate_resource_requests(ctr)
                 if req.empty():
