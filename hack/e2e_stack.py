@@ -385,6 +385,7 @@ def main() -> int:
 
     kubelet = FakeKubelet()
     kubelet.server.start()
+    cleanups: list = []  # extra binaries started mid-run (monitor)
     plugin_env = dict(os.environ)
     plugin_env.update({"VTPU_MOCK_DEVICES": "4", "VTPU_MOCK_DEVMEM": "16384"})
     plugin = BinaryUnderTest("vtpu.plugin", [
@@ -483,7 +484,14 @@ def main() -> int:
         run_env = dict(os.environ)
         run_env.update({k: v for k, v in env.items()
                         if k.startswith(("TPU_", "VTPU_", "LIBVTPU_"))})
-        run_env["VTPU_SHARED_REGION"] = str(work / "workload.cache")
+        # write the region where the kubelet's bind-mount would put it — the
+        # host-side container cache dir Allocate created — so the monitor
+        # binary scrapes a REAL workload region in the next phase
+        mounts = {m.container_path: m.host_path
+                  for m in resp.container_responses[0].mounts}
+        from vtpu.plugin.envs import CONTAINER_CACHE_DIR
+        region_dir = mounts[CONTAINER_CACHE_DIR]
+        run_env["VTPU_SHARED_REGION"] = os.path.join(region_dir, "workload.cache")
         run_env["VTPU_REAL_LIBTPU"] = str(lib / "fake_pjrt.so")
         r = subprocess.run(
             [str(lib / "pjrt_smoke"), str(lib / "libvtpu.so"), "1024", "10", "0"],
@@ -496,6 +504,119 @@ def main() -> int:
               out["allocated"] == 4 and "HBM limit exceeded" in out["alloc_error"])
         phase("libvtpu enforcement under the allocated env")
 
+        # ---- monitor binary scrapes the workload's live region
+        monitor_port = 19394
+        monitor = BinaryUnderTest("vtpu.monitor", [
+            "--hook-path", str(hook), "--node-name", NODE,
+            "--metrics-port", str(monitor_port),
+            "--kube-api", f"http://127.0.0.1:{api.port}",
+            "--feedback-interval", "0.5",
+        ])
+        cleanups.append(monitor.cleanup)
+
+        def scrape() -> str:
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{monitor_port}/metrics",
+                        timeout=5) as r:
+                    return r.read().decode()
+            except Exception:
+                return ""
+
+        wait_for("monitor scrapes the workload region", lambda: (
+            "vtpu_memory_used_bytes" in scrape()
+            and 'podUid="uid-workload"' in scrape()))
+        check("monitor export carries the workload's region by pod uid", True)
+        phase("monitor binary scraped the live region")
+
+        # ---- every Grafana dashboard query resolves against the scrapes
+        import re as _re
+        dash_path = REPO / "charts/vtpu/dashboards/vtpu-overview.json"
+        wanted = sorted(set(_re.findall(r"vtpu_[a-z_]+", dash_path.read_text())))
+        with urllib.request.urlopen(
+                f"https://127.0.0.1:{sched_port}/metrics",
+                timeout=10, context=ctx) as r:
+            sched_families = r.read().decode()
+        available = set(_re.findall(r"vtpu_[a-z_]+", sched_families + scrape()))
+        unresolved = [n for n in wanted if n not in available]
+        check(f"all {len(wanted)} dashboard metric names resolve "
+              f"(unresolved: {unresolved})", not unresolved)
+        phase("grafana dashboard queries resolve", families=len(wanted))
+
+        # ---- dynamic repartition THROUGH the running binaries: an
+        # exclusive ask flips the chip's operating mode under the apply
+        # lock and the register loop republishes the new geometry
+        # (reference plugin/server.go:960-1002 + docs/develop/dynamic-mig.md)
+        from vtpu.device import codec as dcodec
+        excl = _tpu_pod("excl")
+        excl["spec"]["containers"][0]["resources"]["limits"][
+            "google.com/tpucores"] = "100"
+        pod = api.create_pod(excl)
+        result = post_json(f"https://127.0.0.1:{sched_port}/filter",
+                           {"Pod": pod, "NodeNames": [NODE]}, context=ctx)
+        check("exclusive ask filtered onto the node",
+              result["NodeNames"] == [NODE])
+        excl_annos = api.pods[(NS, "excl")]["metadata"]["annotations"]
+        excl_slots = dcodec.decode_pod_single_device(
+            excl_annos["vtpu.io/tpu-devices-to-allocate"])
+        excl_uuid = excl_slots[0][0].uuid
+        result = post_json(f"https://127.0.0.1:{sched_port}/bind",
+                           {"PodName": "excl", "PodNamespace": NS,
+                            "Node": NODE}, context=ctx)
+        check("exclusive bind succeeded", result["Error"] == "")
+        with grpc.insecure_channel(f"unix://{kubelet_dir / 'vtpu.sock'}") as ch:
+            stub = DevicePluginStub(ch)
+            stub.Allocate(pb.AllocateRequest(container_requests=[
+                pb.ContainerAllocateRequest(devicesIDs=[f"{excl_uuid}::0"]),
+            ]), timeout=30)
+
+        def mode_republished() -> bool:
+            raw = api.nodes[NODE]["metadata"]["annotations"].get(
+                "vtpu.io/node-tpu-register", "")
+            try:
+                devs = dcodec.decode_node_devices(raw)
+            except Exception:
+                return False
+            return any(d.id == excl_uuid and d.mode == "exclusive" for d in devs)
+
+        wait_for("repartitioned geometry re-registered", mode_republished)
+        check("Allocate repartitioned the chip to exclusive and the register "
+              "loop republished the geometry through the strict apiserver", True)
+
+        # the next fractional pod must land in a REMAINING slot, never on
+        # the repartitioned chip
+        pod = api.create_pod(_tpu_pod("frac"))
+        result = post_json(f"https://127.0.0.1:{sched_port}/filter",
+                           {"Pod": pod, "NodeNames": [NODE]}, context=ctx)
+        check("fractional pod scheduled after repartition",
+              result["NodeNames"] == [NODE])
+        frac_slots = dcodec.decode_pod_single_device(
+            api.pods[(NS, "frac")]["metadata"]["annotations"][
+                "vtpu.io/tpu-devices-to-allocate"])
+        check("fractional pod avoided the exclusive chip",
+              frac_slots[0][0].uuid != excl_uuid)
+        phase("dynamic repartition end-to-end", exclusive_chip=excl_uuid)
+
+        # ---- pod delete -> monitor GCs the region dir -> plugin keeps
+        # re-registering (the full lifecycle tail)
+        client.delete_pod(NS, "workload")
+        wait_for("monitor GC'd the dead pod's region dir",
+                 lambda: not os.path.isdir(region_dir), timeout=60)
+        check("region dir GC'd after pod delete (cudevshr.go:184-201 parity)",
+              True)
+        # kubelet gRPC Register fires on socket-watch events, not per
+        # interval; the plugin's ONGOING reconciliation is the node
+        # annotation loop — wipe the registration and watch it come back
+        with api.lock:
+            api.nodes[NODE]["metadata"]["annotations"].pop(
+                "vtpu.io/node-tpu-register", None)
+        wait_for("plugin re-registers the wiped node annotation",
+                 lambda: api.nodes[NODE]["metadata"]["annotations"].get(
+                     "vtpu.io/node-tpu-register"))
+        check("plugin reconciled the wiped registration (register loop live "
+              "after the full lifecycle)", True)
+        phase("pod delete -> region GC -> re-register")
+
         ok = True
     except BaseException as exc:  # record the failure, then re-raise
         phases.append({"name": "FAILED", "error": str(exc)[:2000]})
@@ -504,7 +625,7 @@ def main() -> int:
     finally:
         # every teardown step is independent: one failing must not skip the
         # rest nor the evidence write below
-        for step in (scheduler.cleanup, plugin.cleanup,
+        for step in (*cleanups, scheduler.cleanup, plugin.cleanup,
                      lambda: kubelet.server.stop(grace=0.2),
                      api.server.shutdown):
             try:

@@ -239,7 +239,7 @@ def test_cdi_spec_and_qualified_devices(mock_chips, tmp_path):
     class _Req:
         container_requests = [type("C", (), {"devicesIDs": ["host1-tpu-0::0"]})()]
 
-    resp = plugin._allocate_pending(client.get_pod("default", "cdi-pod"), _Req())
+    resp, _done = plugin._allocate_pending(client.get_pod("default", "cdi-pod"), _Req())
     ctr = resp.container_responses[0]
     assert [d.name for d in ctr.cdi_devices] == ["vtpu.io/tpu=host1-tpu-0"]
     assert not ctr.devices  # no raw device paths in CDI mode
@@ -393,6 +393,12 @@ def test_allocate_two_calls_keep_container_pairing(served_plugin):
     assert "init0" in m1[envs.CONTAINER_CACHE_DIR]
     e1 = dict(r1.container_responses[0].envs)
     assert e1[envs.ENV_DEVICE_MEMORY_LIMIT.format(index=0)] == "2048m"
+    # mid-sequence: still allocating, node lock still HELD — releasing
+    # between container calls would let another pod bind and steal
+    # get_pending_pod (newest bind-time wins)
+    annos = annotations(client.get_pod("default", "twostep"))
+    assert annos[t.BIND_PHASE] == t.BIND_PHASE_ALLOCATING
+    assert t.NODE_LOCK_ANNO in annotations(client.get_node("host1"))
 
     # call 2: the app container — must NOT inherit the init slot's identity
     r2 = stub.Allocate(pb.AllocateRequest(
@@ -404,4 +410,6 @@ def test_allocate_two_calls_keep_container_pairing(served_plugin):
 
     annos = annotations(client.get_pod("default", "twostep"))
     assert "vtpu.io/tpu-devices-to-allocate" not in annos  # fully consumed
+    assert annos[t.BIND_PHASE] == t.BIND_PHASE_SUCCESS
+    assert t.NODE_LOCK_ANNO not in annotations(client.get_node("host1"))
     sched.stop()

@@ -86,6 +86,12 @@ class TpuDevices(Devices):
         self.quota = quota
         # case-folded once: checked per candidate device on the filter path
         self._allowed_types_lower = [a.lower() for a in self.config.allowed_types]
+        # (annos-object, parsed selectors): one Filter calls fit() once per
+        # candidate node with the SAME pod dict, so the parse is per-filter,
+        # not per-node. Identity-compared with a strong ref (keeps the dict
+        # alive, so its id can't be reused while cached). Concurrent score
+        # threads share the pod object; a race rewrites identical data.
+        self._sel_cache: tuple | None = None
 
     # ------------------------------------------------------------- identity
 
@@ -176,15 +182,20 @@ class TpuDevices(Devices):
         return [s.strip() for s in raw.split(",") if s.strip()]
 
     def _selectors(self, annos: dict):
-        """Parse the four device-selector annotations ONCE per fit — they
-        were re-split per candidate device and dominated the filter profile
-        at 100-node scale."""
-        return (
+        """Parse the four device-selector annotations ONCE per filter — they
+        were re-split per candidate device (then per candidate node) and
+        dominated the filter profile at 100- and 1,000-node scale."""
+        cached = self._sel_cache
+        if cached is not None and cached[0] is annos:
+            return cached[1]
+        sel = (
             self._split_anno(annos, t.USE_DEVICE_UUID_ANNO),
             self._split_anno(annos, t.NO_USE_DEVICE_UUID_ANNO),
             [u.lower() for u in self._split_anno(annos, t.USE_DEVICE_TYPE_ANNO)],
             [u.lower() for u in self._split_anno(annos, t.NO_USE_DEVICE_TYPE_ANNO)],
         )
+        self._sel_cache = (annos, sel)
+        return sel
 
     def _check_uuid(self, selectors, dev: DeviceUsage) -> bool:
         use, nouse = selectors[0], selectors[1]

@@ -8,7 +8,6 @@ every device carries optional ICI torus coordinates so topology-aware placement
 
 from __future__ import annotations
 
-import copy
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -39,7 +38,7 @@ class IciCoord:
         return abs(self.x - other.x) + abs(self.y - other.y) + abs(self.z - other.z)
 
 
-@dataclass
+@dataclass(slots=True)
 class DeviceInfo:
     """A physical device as registered by the node agent.
 
@@ -59,10 +58,15 @@ class DeviceInfo:
     index: int = 0  # stable device index on the node
 
     def clone(self) -> "DeviceInfo":
-        # shallow C-level copy: dataclasses.replace dominated the scheduler's
-        # filter profile at 100-node scale. IciCoord is shared — it is
-        # placement metadata nothing mutates after decode.
-        return copy.copy(self)
+        # Direct construction: copy.copy's __reduce_ex__/_reconstruct path
+        # was 40k calls and ~60 ms per filter at 1,000-node scale. IciCoord
+        # is shared — it is placement metadata nothing mutates after decode.
+        return DeviceInfo(
+            id=self.id, count=self.count, devmem=self.devmem,
+            devcore=self.devcore, type=self.type, numa=self.numa,
+            health=self.health, ici=self.ici, mode=self.mode,
+            index=self.index,
+        )
 
 
 @dataclass
@@ -105,7 +109,7 @@ PodSingleDevice = list[ContainerDevices]
 PodDevices = dict[str, PodSingleDevice]
 
 
-@dataclass
+@dataclass(slots=True)
 class DeviceUsage:
     """Mutable per-device usage snapshot the score engine fits requests into.
 

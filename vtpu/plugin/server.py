@@ -177,24 +177,33 @@ class TpuDevicePlugin:
                 f"no pod with bind-phase=allocating on node {node}",
             )
         try:
-            response = self._allocate_pending(pod, request)
-            pod_allocation_try_success(
-                self.client, pod, in_request_annos=[IN_REQUEST_ANNO])
-            return response
+            response, fully_consumed = self._allocate_pending(pod, request)
         except Exception as e:
             log.exception("allocate failed for %s", pod["metadata"].get("name"))
             try:
                 pod_allocation_failed(self.client, pod)
             except ApiError:
                 log.exception("marking allocation failed")
+            self._release_node_lock(node, pod)
             context.abort(grpc.StatusCode.INTERNAL, f"allocate: {e}")
-        finally:
-            try:
-                nodelock.release_node_lock(self.client, node, pod)
-            except ApiError:
-                log.exception("release node lock after allocate")
+        # Success is marked — and the node lock released — ONLY once every
+        # slot is consumed (reference updatePodAnnotationsAndReleaseLock via
+        # podAllocationTrySuccess, plugin/util.go:493-528). Releasing after a
+        # PARTIAL allocation would let the scheduler bind another pod to this
+        # node mid-sequence, and get_pending_pod (newest bind-time wins)
+        # would then pair this pod's remaining containers with the newcomer.
+        if fully_consumed:
+            pod_allocation_try_success(self.client, pod)
+            self._release_node_lock(node, pod)
+        return response
 
-    def _allocate_pending(self, pod: dict, request) -> pb.AllocateResponse:
+    def _release_node_lock(self, node: str, pod: dict) -> None:
+        try:
+            nodelock.release_node_lock(self.client, node, pod)
+        except ApiError:
+            log.exception("release node lock after allocate")
+
+    def _allocate_pending(self, pod: dict, request) -> tuple[pb.AllocateResponse, bool]:
         annos = pod_annotations(pod)
         raw = annos.get(IN_REQUEST_ANNO, "")
         if not raw:
@@ -263,7 +272,10 @@ class TpuDevicePlugin:
                 else None
             },
         )
-        return pb.AllocateResponse(container_responses=responses)
+        # whether this call drained the pod's assignments: the caller marks
+        # bind success / releases the node lock on exactly that condition
+        # (no pod re-read — this function just computed the truth)
+        return pb.AllocateResponse(container_responses=responses), not any(remaining)
 
     def _container_response(
         self, pod: dict, ctr_name: str, devices: ContainerDevices

@@ -161,6 +161,23 @@ class SchedulerServer:
         self.httpd = ThreadingHTTPServer(
             (host, port), make_handler(scheduler, webhook, profiling=profiling)
         )
+        # Export the allocation-view families (vtpu_tpu_*, vtpu_node_tpu_
+        # overview, quota) alongside the auto-registered latency histograms —
+        # the Grafana dashboard queries both (reference cmd/scheduler/
+        # metrics.go registers its collector at server start the same way).
+        try:
+            from prometheus_client import REGISTRY
+
+            from vtpu.scheduler.metrics import SchedulerCollector
+
+            self._collector = SchedulerCollector(scheduler)
+            REGISTRY.register(self._collector)
+        except Exception:
+            # ValueError: a previous server in this process already
+            # registered one (tests spin several servers) — that export
+            # stands. ImportError: no prometheus_client — the rest of this
+            # module degrades without metrics, so must this.
+            self._collector = None
         # graceful shutdown must DRAIN in-flight Filter/Bind handlers: a bind
         # killed between the allocating annotation and the Binding call
         # strands the pod and the node lock until timeout recovery
@@ -215,3 +232,11 @@ class SchedulerServer:
         self._stop_watch.set()
         self.httpd.shutdown()
         self.httpd.server_close()
+        if self._collector is not None:
+            try:
+                from prometheus_client import REGISTRY
+
+                REGISTRY.unregister(self._collector)
+            except KeyError:  # pragma: no cover
+                pass
+            self._collector = None

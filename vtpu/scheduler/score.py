@@ -125,7 +125,17 @@ def calc_score(
     if len(names) == 1:
         results = [score_node(names[0])]
     else:
-        results = list(_SCORE_POOL.map(score_node, names))
+        # Chunked fan-out: one future per node meant 1,000 submissions +
+        # result waits per Filter at 1,000-node scale — the futures machinery
+        # cost more than the scoring. Each worker takes a contiguous slice.
+        chunk = max(1, (len(names) + _SCORE_POOL._max_workers - 1)
+                    // _SCORE_POOL._max_workers)
+        chunks = [names[i:i + chunk] for i in range(0, len(names), chunk)]
+
+        def score_chunk(chunk_names: list[str]) -> list:
+            return [score_node(n) for n in chunk_names]
+
+        results = [r for part in _SCORE_POOL.map(score_chunk, chunks) for r in part]
     for name, (ns, reason) in zip(names, results):
         if ns is None:
             failures[name] = reason

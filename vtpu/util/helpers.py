@@ -11,7 +11,7 @@ import time
 from typing import Optional
 
 from vtpu.util import types as t
-from vtpu.util.k8sclient import ApiError, KubeClient
+from vtpu.util.k8sclient import KubeClient
 
 log = logging.getLogger(__name__)
 
@@ -146,28 +146,18 @@ def get_pending_pod(client: KubeClient, node_name: str) -> Optional[dict]:
     return candidates[-1]
 
 
-def pod_allocation_try_success(
-    client: KubeClient, pod: dict, in_request_annos: list[str] | None = None
-) -> None:
+def pod_allocation_try_success(client: KubeClient, pod: dict) -> None:
     """Mark bind success once Allocate consumed ALL assignments (reference
-    plugin/util.go podAllocationTrySuccess:493-508): re-read the pod and, if
-    any *in_request_annos* annotation still carries pending device slots
-    (a later container's Allocate call hasn't landed yet — kubelet issues
-    one per container, init containers first), leave the bind phase at
-    "allocating" so get_pending_pod keeps finding the pod."""
-    ns = pod["metadata"].get("namespace", "default")
-    name = pod["metadata"]["name"]
-    if in_request_annos:
-        try:
-            refreshed = client.get_pod(ns, name)
-        except ApiError:
-            log.exception("re-reading pod %s/%s for try-success", ns, name)
-            return
-        annos = pod_annotations(refreshed or {})
-        for key in in_request_annos:
-            if annos.get(key, ""):
-                return  # assignments remain; a later Allocate finishes the job
-    client.patch_pod_annotations(ns, name, {t.BIND_PHASE: t.BIND_PHASE_SUCCESS})
+    plugin/util.go podAllocationTrySuccess:493-508). The caller decides
+    "all consumed" from the state it just wrote (plugin server.py
+    _allocate_pending) — kubelet issues one Allocate per container, init
+    containers first, and a partially-allocated pod must stay at
+    bind-phase=allocating so get_pending_pod keeps finding it."""
+    client.patch_pod_annotations(
+        pod["metadata"].get("namespace", "default"),
+        pod["metadata"]["name"],
+        {t.BIND_PHASE: t.BIND_PHASE_SUCCESS},
+    )
 
 
 def pod_allocation_failed(client: KubeClient, pod: dict) -> None:
