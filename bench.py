@@ -58,17 +58,19 @@ TENANTS = 4
 DUTY_FACTOR = 8.0
 NEW_TOKENS = 4  # decode tokens streamed per request after the first
 # Shared tenants run the FULL libvtpu stack (HBM/4 hard cap, shared region,
-# priority gate, accounting) with core PACING off (100 = unthrottled). Any
-# core cap is untestable as a *sharing* SLO on THIS platform: the limiter
-# charges client-observable busy, and the tunnel's ~100-200 ms transport
-# floor rides every serving-engine decode tick, so a 1/8-duty tenant's
-# charged duty lands at 40-70% regardless of its true ~2% chip usage —
-# measured 110 s of admit waits per tenant at cap 25 and still ~30 s at cap
-# 60 (shared_tenant_throttle in the artifact). The bench would then measure
-# enforcement amplifying transport drift, not sharing. Proportional core
-# enforcement is proven separately on the same hardware in CORESHARE.json;
-# a real deployment's µs dispatch floor would leave these tenants unpaced.
-SHARE_CORE_LIMIT = 100
+# priority gate, accounting) WITH core pacing at 25% (r4: pacing ON in the
+# headline run, VERDICT r3 #1). This became testable on the tunneled dev
+# platform when libvtpu grew the self-calibrating transport floor (shim.cc
+# RttFloor): the limiter used to charge the tunnel's ~100-200 ms dispatch
+# RTT that rides every serving decode tick as busy — a 1/8-duty tenant's
+# charged duty read 40-70% regardless of its true ~2% chip usage, and cap
+# 25 paced transport for ~110 s/tenant. The floor (windowed minimum of
+# small-upload walls, i.e. the fastest observed round trip) now exempts
+# transport automatically, so charges approximate true chip busy and a 25%
+# cap leaves a ~2%-duty tenant unpaced. shared_tenant_throttle in the
+# artifact audits exactly that: residual admit waits are REAL pacing, and
+# at this workload's duty they must be ~0.
+SHARE_CORE_LIMIT = 25
 
 
 def log(msg: str) -> None:
@@ -570,12 +572,14 @@ def main() -> None:
         "shared_tenant_throttle": shared_throttle,
         "tenants": TENANTS,
         "tenant_contract": {"hbm": "4g", "core_limit": SHARE_CORE_LIMIT,
-                            "note": "full stack, core pacing off: the "
-                                    "tunnel transport floor dominates "
-                                    "client-observed duty (see "
-                                    "SHARE_CORE_LIMIT comment); core-knob "
-                                    "enforcement is proven in "
-                                    "CORESHARE.json on this hardware"},
+                            "note": "full stack, core pacing ON: libvtpu's "
+                                    "self-calibrating transport floor "
+                                    "(RttFloor, windowed min of small-"
+                                    "upload walls) exempts the tunnel RTT "
+                                    "from duty charges, so the 25% cap "
+                                    "paces real chip busy only; "
+                                    "shared_tenant_throttle audits residual "
+                                    "admit waits (~0 at this duty)"},
         "samples_shared": len(shared_ttfts),
         "sharing_rounds": len(round_degradations),
         "per_round_degradation": [round(d, 2) for d in round_degradations],

@@ -67,6 +67,14 @@ Limits parse_limits_from_env() {
     long long ms = std::atoll(v);
     limits.charge_floor_ns = ms > 0 ? (uint64_t)ms * 1000000ull : 0;
   }
+  if (const char* v = std::getenv("VTPU_CHARGE_FLOOR_AUTO")) {
+    limits.charge_floor_auto =
+        !(std::strcmp(v, "false") == 0 || std::strcmp(v, "0") == 0);
+  }
+  if (const char* v = std::getenv("VTPU_CHARGE_FLOOR_MAX_MS")) {
+    long long ms = std::atoll(v);
+    if (ms > 0) limits.charge_floor_max_ns = (uint64_t)ms * 1000000ull;
+  }
   if (const char* v = std::getenv("VTPU_D2H_EVENT_HOOK")) {
     limits.d2h_event_hook =
         !(std::strcmp(v, "false") == 0 || std::strcmp(v, "0") == 0);

@@ -29,11 +29,19 @@ struct Limits {
   // runtimes the client-observed wall of every completion-coupled call
   // carries the dispatch RTT (~100-200 ms here), which is not chip busy —
   // without a floor, any serving tenant's charged duty saturates its core
-  // cap on transport alone. Explicit (the plugin can probe and set it)
-  // rather than auto-detected: a rolling-min detector would misread
-  // constant-cost real work as floor. 0 (default) = charge full walls,
-  // correct for local runtimes with µs dispatch.
+  // cap on transport alone. When 0 (default) the shim SELF-CALIBRATES the
+  // floor from small host->device upload walls (shim.cc RttFloor: windowed
+  // minimum — real work only ever adds on top of the fastest observed
+  // round trip, so the minimum can't misread constant-cost work as floor).
+  // An explicit value overrides calibration.
   uint64_t charge_floor_ns = 0;
+  // VTPU_CHARGE_FLOOR_AUTO=0 disables self-calibration (then floor 0 =
+  // charge full walls, the pre-r4 behavior for local runtimes).
+  bool charge_floor_auto = true;
+  // VTPU_CHARGE_FLOOR_MAX_MS: operator ceiling on the SELF-CALIBRATED
+  // floor (the calibration samples are tenant-controlled; see shim.cc
+  // RttFloor adversarial notes). Default 1000 ms.
+  uint64_t charge_floor_max_ns = 1000ull * 1000000;
   // VTPU_D2H_EVENT_HOOK=0 disables piggybacking OnReady listeners on the
   // caller-owned D2H transfer event (for PJRT plugins with single-listener
   // event semantics); the shim then charges only the synchronous portion of

@@ -102,6 +102,99 @@ struct ScopedNs {
   ~ScopedNs() { acc.fetch_add(tick_ns() - t0, std::memory_order_relaxed); }
 };
 
+// --------------------------------------------------------- transport floor
+// Auto-calibrated dispatch-RTT floor (the reference's CUDA_DEVICE_SM_LIMIT
+// needs no operator tuning; neither should the core knob here). Over a
+// proxied/tunneled PJRT plugin, every completion-coupled wall the sync-wall
+// charger sees carries the transport round trip — which is not chip busy.
+// Small host->device uploads are the calibration signal: BufferFromHostBuffer
+// is synchronous over such runtimes, and for a tiny payload the device-side
+// work is microseconds, so the wall IS the round trip. The floor is the
+// MINIMUM wall over a two-bucket rotating window:
+//   - min, not mean: a busy tunnel makes samples SLOWER, never faster, so a
+//     minimum can't drift above the true transport cost — and unlike a
+//     rolling mean it cannot misread constant-cost real work as floor
+//     (real work only ever adds on top of the fastest observed round trip);
+//   - size-gated: only payloads <= 64 KiB sample (serving feeds sampled
+//     tokens every decode tick, a steady stream of near-pure-RTT walls);
+//   - rotation bounds staleness by COUNT and by TIME: a bucket rotates
+//     after 64 samples or 30 s, and buckets older than 150 s are ignored
+//     entirely (a floor calibrated during transient congestion must not
+//     outlive it; no recent signal = charge full walls, conservative in
+//     the limit's favor);
+//   - local runtimes self-calibrate to ~microseconds: effectively no floor.
+//
+// Adversarial bounds (the floor is computed from tenant-controlled calls):
+// a tenant saturating the tunnel with its own traffic can inflate observed
+// walls and with them the minimum. Two independent caps bound the damage:
+// the floor is clamped to VTPU_CHARGE_FLOOR_MAX_MS (operator ceiling,
+// default 1 s), and charge_sync_wall always charges at least 1/16 of the
+// raw wall regardless of floor — so even a fully-gamed floor pays 6.25%
+// of observed busy, while honest serving (floor = real RTT) is unaffected
+// at any practical duty.
+class RttFloor {
+ public:
+  static constexpr uint64_t kSmallUploadBytes = 64 * 1024;
+  static constexpr int kMinSamples = 4;
+  static constexpr int kBucketSamples = 64;
+  static constexpr uint64_t kRotateNs = 30ull * 1000'000'000;
+  static constexpr uint64_t kMaxAgeNs = 150ull * 1000'000'000;
+
+  void record(uint64_t wall_ns, uint64_t now_ns) {
+    std::lock_guard<std::mutex> lock(mu_);
+    if (cur_n_ == 0) cur_start_ns_ = now_ns;
+    if (wall_ns < cur_min_) cur_min_ = wall_ns;
+    cur_last_ns_ = now_ns;
+    if (++cur_n_ >= kBucketSamples || now_ns - cur_start_ns_ >= kRotateNs) {
+      prev_min_ = cur_min_;
+      prev_n_ = cur_n_;
+      prev_last_ns_ = cur_last_ns_;
+      cur_min_ = UINT64_MAX;
+      cur_n_ = 0;
+    }
+  }
+
+  // 0 (charge full walls) until enough FRESH samples have been seen.
+  uint64_t floor_ns(uint64_t now_ns) {
+    std::lock_guard<std::mutex> lock(mu_);
+    bool cur_fresh = cur_n_ > 0 && now_ns - cur_last_ns_ <= kMaxAgeNs;
+    bool prev_fresh = prev_n_ > 0 && now_ns - prev_last_ns_ <= kMaxAgeNs;
+    int n = (cur_fresh ? cur_n_ : 0) + (prev_fresh ? prev_n_ : 0);
+    if (n < kMinSamples) return 0;
+    uint64_t m = UINT64_MAX;
+    if (cur_fresh && cur_min_ < m) m = cur_min_;
+    if (prev_fresh && prev_min_ < m) m = prev_min_;
+    return m == UINT64_MAX ? 0 : m;
+  }
+
+ private:
+  std::mutex mu_;
+  uint64_t cur_min_ = UINT64_MAX;
+  uint64_t prev_min_ = UINT64_MAX;
+  uint64_t cur_start_ns_ = 0;
+  uint64_t cur_last_ns_ = 0;
+  uint64_t prev_last_ns_ = 0;
+  int cur_n_ = 0;
+  int prev_n_ = 0;
+};
+
+RttFloor& rtt_floor() {
+  static RttFloor* f = new RttFloor();
+  return *f;
+}
+
+// The floor charge_sync_wall actually starts from (before the per-wall 1/16
+// clamp): the operator-declared value when set, else the calibrated minimum
+// capped at the operator ceiling. Single source for the charge path AND the
+// rtt_floor_ns stat, so operators debug the floor that is really applied.
+uint64_t base_charge_floor_ns(const Limits& limits) {
+  if (limits.charge_floor_ns > 0) return limits.charge_floor_ns;
+  if (!limits.charge_floor_auto) return 0;
+  uint64_t floor = rtt_floor().floor_ns(tick_ns());
+  return floor > limits.charge_floor_max_ns ? limits.charge_floor_max_ns : floor;
+}
+
+
 // Escape hatch for A/B attribution runs: VTPU_DISABLE_SIZE_CACHE=1 restores
 // the per-call sizing the cache replaces, so the overhead of the cold path
 // can be measured against the cached one on the same binary.
@@ -641,6 +734,31 @@ bool memory_is_host(PJRT_Memory* mem);
 PJRT_Error* settle_or_reject(PJRT_Buffer** buffer, uint64_t est, uint64_t sig,
                              bool trust_est = false);
 
+// Run the real BufferFromHostBuffer under the upload timer and, for small
+// payloads, feed the wall into the RTT-floor calibration (single site for
+// the gate so the two upload branches cannot diverge).
+PJRT_Error* timed_real_upload(PJRT_Client_BufferFromHostBuffer_Args* args,
+                              uint64_t est_bytes, bool auto_floor) {
+  uint64_t t0 = tick_ns();
+  PJRT_Error* err;
+  {
+    ScopedNs real_timer(stats().upload_real_ns);
+    err = S().real->PJRT_Client_BufferFromHostBuffer(args);
+  }
+  if (err == nullptr && auto_floor && est_bytes <= RttFloor::kSmallUploadBytes) {
+    uint64_t t1 = tick_ns();
+    rtt_floor().record(t1 - t0, t1);
+  }
+  return err;
+}
+
+// Calibration is live only when it would be consulted: auto mode AND no
+// operator-declared floor overriding it (no wasted mutex on the hot path,
+// and rtt_floor_ns can't report a stale value the charger ignores).
+bool floor_calibrating(const Limits& limits) {
+  return limits.charge_floor_auto && limits.charge_floor_ns == 0;
+}
+
 PJRT_Error* wrapped_buffer_from_host(PJRT_Client_BufferFromHostBuffer_Args* args) {
   auto& s = S();
   stats().uploads.fetch_add(1, std::memory_order_relaxed);
@@ -663,22 +781,14 @@ PJRT_Error* wrapped_buffer_from_host(PJRT_Client_BufferFromHostBuffer_Args* args
       ScopedNs real_timer(stats().upload_real_ns);
       return s.real->PJRT_Client_BufferFromHostBuffer(args);
     }
-    PJRT_Error* err;
-    {
-      ScopedNs real_timer(stats().upload_real_ns);
-      err = s.real->PJRT_Client_BufferFromHostBuffer(args);
-    }
+    PJRT_Error* err = timed_real_upload(args, est, floor_calibrating(s.limits));
     if (err != nullptr || args->buffer == nullptr) return err;
     return settle_or_reject(&args->buffer, est, sig);
   }
   size_t dev_idx = args->device ? device_index_of(args->device) : 0;
   bool reserved = false;
   if (PJRT_Error* verr = precheck_alloc(dev_idx, est, &reserved)) return verr;
-  PJRT_Error* err;
-  {
-    ScopedNs real_timer(stats().upload_real_ns);
-    err = s.real->PJRT_Client_BufferFromHostBuffer(args);
-  }
+  PJRT_Error* err = timed_real_upload(args, est, floor_calibrating(s.limits));
   if (err != nullptr || args->buffer == nullptr) {
     if (reserved) unreserve(dev_idx, est);
     return err;
@@ -830,10 +940,13 @@ PJRT_Error* wrapped_copy_to_memory(PJRT_Buffer_CopyToMemory_Args* args) {
 
 // Charge a wall interval the process spent blocked on the runtime to the
 // device's duty-cycle limiter (union accounting inside the limiter prevents
-// double charges where faithful completion events already paid). The
-// operator-declared transport floor (VTPU_CHARGE_FLOOR_MS) is deducted:
-// over a proxied plugin every completion-coupled wall carries the dispatch
-// RTT, which is transport, not chip busy.
+// double charges where faithful completion events already paid). A
+// transport floor is deducted first: over a proxied plugin every
+// completion-coupled wall carries the dispatch RTT, which is transport,
+// not chip busy. The floor is the operator-declared VTPU_CHARGE_FLOOR_MS
+// when set, else the self-calibrated small-upload minimum (RttFloor) — so
+// the core knob works out of the box on tunneled runtimes, like the
+// reference's SM limit does locally.
 void charge_sync_wall(size_t dev_idx, uint64_t start_ns, uint64_t end_ns) {
   auto& s = S();
   if (!s.limits.core_enforced() && s.region == nullptr) return;
@@ -842,7 +955,17 @@ void charge_sync_wall(size_t dev_idx, uint64_t start_ns, uint64_t end_ns) {
     std::lock_guard<std::mutex> lock(s.mu);
     limiter = s.dev(dev_idx).limiter;
   }
-  start_ns += s.limits.charge_floor_ns;
+  uint64_t floor = base_charge_floor_ns(s.limits);
+  if (s.limits.charge_floor_ns == 0 && floor > 0) {
+    // Bound the gameable surface: the auto floor never exempts more than
+    // 15/16 of a wall, so a tenant that inflated its own calibration still
+    // pays 1/16 of observed busy (see RttFloor adversarial notes). An
+    // operator-DECLARED floor is trusted in full.
+    uint64_t wall = end_ns > start_ns ? end_ns - start_ns : 0;
+    uint64_t max_exempt = wall - wall / 16;
+    if (floor > max_exempt) floor = max_exempt;
+  }
+  start_ns += floor;
   if (end_ns > start_ns) {
     limiter->charge_interval(start_ns, end_ns);
   }
@@ -1307,7 +1430,7 @@ size_t vtpu_stats_json(char* buf, size_t cap) {
       "\"size_cache_misses\": %llu, \"settles\": %llu, "
       "\"settled_busy_ns\": %llu, \"tohost_calls\": %llu, "
       "\"tohost_ns\": %llu, \"await_calls\": %llu, "
-      "\"await_ns\": %llu}",
+      "\"await_ns\": %llu, \"rtt_floor_ns\": %llu}",
       (unsigned long long)st.executes.load(),
       (unsigned long long)st.gate_ns.load(),
       (unsigned long long)st.admit_ns.load(),
@@ -1330,7 +1453,8 @@ size_t vtpu_stats_json(char* buf, size_t cap) {
       (unsigned long long)st.tohost_calls.load(),
       (unsigned long long)st.tohost_ns.load(),
       (unsigned long long)st.await_calls.load(),
-      (unsigned long long)st.await_ns.load());
+      (unsigned long long)st.await_ns.load(),
+      (unsigned long long)vtpu::base_charge_floor_ns(vtpu::S().limits));
   return n > 0 && (size_t)n < cap ? (size_t)n : 0;
 }
 

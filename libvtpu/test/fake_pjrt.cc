@@ -72,6 +72,15 @@ bool events_at_enqueue() {
   return e != nullptr && e[0] == '1';
 }
 
+// Tunnel-runtime emulation: the transport round trip every synchronous call
+// pays (observed ~100-200 ms over the real tunnel). Applied to uploads —
+// BufferFromHostBuffer is synchronous-blocking over proxied plugins — so the
+// shim's RttFloor self-calibration has the same signal it sees in production.
+uint64_t transport_rtt_ns() {
+  const char* e = std::getenv("FAKE_PJRT_RTT_NS");
+  return e ? std::strtoull(e, nullptr, 10) : 0;
+}
+
 void sleep_until(uint64_t deadline_ns) {
   uint64_t now = mono_ns();
   if (deadline_ns <= now) return;
@@ -149,6 +158,7 @@ uint64_t dtype_bytes(PJRT_Buffer_Type t) {
 }
 
 PJRT_Error* BufferFromHostBuffer(PJRT_Client_BufferFromHostBuffer_Args* args) {
+  if (uint64_t rtt = transport_rtt_ns()) sleep_until(mono_ns() + rtt);
   uint64_t n = 1;
   for (size_t i = 0; i < args->num_dims; i++) n *= args->dims[i];
   auto* buf = new FakeBuffer{n * dtype_bytes(args->type)};
@@ -189,8 +199,14 @@ PJRT_Error* BufferToHost(PJRT_Buffer_ToHostBuffer_Args* args) {
   // Async D2H, like real runtimes: the call returns immediately and the
   // COMPLETION EVENT fires when the device has drained up to this point —
   // the one event even eager-event proxies must keep honest (the caller's
-  // bytes have to arrive). The shim charges duty off this event.
-  args->event = reinterpret_cast<PJRT_Event*>(new FakeEvent{g_busy_until.load()});
+  // bytes have to arrive). The shim charges duty off this event. Over an
+  // emulated tunnel the client additionally pays the transport round trip
+  // on top of the drain, exactly like the D2H walls observed in production.
+  uint64_t ready = g_busy_until.load();
+  uint64_t now = mono_ns();
+  if (ready < now) ready = now;
+  ready += transport_rtt_ns();  // drain first, then the bytes cross the wire
+  args->event = reinterpret_cast<PJRT_Event*>(new FakeEvent{ready});
   return nullptr;
 }
 

@@ -171,6 +171,13 @@ int main(int argc, char** argv) {
   // completion events lie the ONLY call that tracks the device's pace.
   bool d2h = getenv("PJRT_SMOKE_D2H") != nullptr &&
              getenv("PJRT_SMOKE_D2H")[0] == '1';
+  // PJRT_SMOKE_FEED=1: upload a tiny (16-byte) buffer before each execute —
+  // the serving engine's per-tick token feed, and the shim's transport-floor
+  // calibration stream (small synchronous uploads whose wall IS the RTT).
+  bool feed = getenv("PJRT_SMOKE_FEED") != nullptr &&
+              getenv("PJRT_SMOKE_FEED")[0] == '1';
+  float feed_src[4] = {0, 1, 2, 3};
+  int64_t feed_dims[1] = {4};
   std::vector<char> host_dst(4096);
   size_t n_out = 1;
   std::vector<PJRT_Buffer*> out_row(n_out, nullptr);
@@ -179,6 +186,30 @@ int main(int argc, char** argv) {
   double t0 = now_s();
   int execs_ok = 0;
   for (int i = 0; i < n_execs; i++) {
+    if (feed) {
+      PJRT_Client_BufferFromHostBuffer_Args fargs;
+      memset(&fargs, 0, sizeof(fargs));
+      fargs.struct_size = PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE;
+      fargs.client = cargs.client;
+      fargs.data = feed_src;
+      fargs.type = PJRT_Buffer_Type_F32;
+      fargs.dims = feed_dims;
+      fargs.num_dims = 1;
+      fargs.host_buffer_semantics =
+          PJRT_HostBufferSemantics_kImmutableUntilTransferCompletes;
+      fargs.device = dargs.num_addressable_devices
+                         ? dargs.addressable_devices[0]
+                         : nullptr;
+      if (PJRT_Error* err = api->PJRT_Client_BufferFromHostBuffer(&fargs)) {
+        error_text(api, err);
+      } else if (fargs.buffer != nullptr) {
+        PJRT_Buffer_Destroy_Args del;
+        memset(&del, 0, sizeof(del));
+        del.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+        del.buffer = fargs.buffer;
+        api->PJRT_Buffer_Destroy(&del);
+      }
+    }
     PJRT_LoadedExecutable_Execute_Args eargs;
     memset(&eargs, 0, sizeof(eargs));
     eargs.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;

@@ -173,6 +173,42 @@ fwall, tfree = float('$FWALL'), float('$TFREE')
 assert fwall < max(0.25, tfree * 2), f'floor not deducted: {fwall}s (free {tfree}s)'
 print(f'   floored wall: {fwall}s (unthrottled {tfree}s, throttled $TWALL s)')"
 
+echo "== 7e. AUTO transport floor: small-upload RTT self-calibrates =="
+# Tunnel-shaped run with a 3ms emulated transport RTT and NO operator floor:
+# the per-tick token feed (PJRT_SMOKE_FEED) gives the shim its calibration
+# stream, the windowed-minimum floor converges to ~RTT, and D2H walls charge
+# only the time ABOVE it — so with ~0 real compute the limiter must not
+# throttle (the out-of-the-box behavior the reference's SM limit has locally).
+env VTPU_REAL_LIBTPU=$PWD/$B/fake_pjrt.so TPU_CORE_LIMIT=20 \
+    FAKE_PJRT_EXEC_NS=100000 FAKE_PJRT_EVENT_AT_ENQUEUE=1 FAKE_PJRT_RTT_NS=3000000 \
+    PJRT_SMOKE_NO_EVENTS=1 PJRT_SMOKE_D2H=1 PJRT_SMOKE_FEED=1 \
+    $B/pjrt_smoke $B/libvtpu.so 1 1 50 > "$TMP/autofloor.out"
+AWALL=$(result_field "$TMP/autofloor.out" exec_seconds)
+AFLOOR=$(grep -o '"rtt_floor_ns": [0-9]*' "$TMP/autofloor.out" | grep -o '[0-9]*$' || echo 0)
+# control: same run with calibration disabled -> full walls charge -> throttled
+env VTPU_REAL_LIBTPU=$PWD/$B/fake_pjrt.so TPU_CORE_LIMIT=20 \
+    FAKE_PJRT_EXEC_NS=100000 FAKE_PJRT_EVENT_AT_ENQUEUE=1 FAKE_PJRT_RTT_NS=3000000 \
+    PJRT_SMOKE_NO_EVENTS=1 PJRT_SMOKE_D2H=1 PJRT_SMOKE_FEED=1 VTPU_CHARGE_FLOOR_AUTO=0 \
+    $B/pjrt_smoke $B/libvtpu.so 1 1 50 > "$TMP/autofloor_off.out"
+OWALL=$(result_field "$TMP/autofloor_off.out" exec_seconds)
+# and real compute ABOVE the floor still throttles: 2ms busy per step at 20%
+env VTPU_REAL_LIBTPU=$PWD/$B/fake_pjrt.so TPU_CORE_LIMIT=20 \
+    FAKE_PJRT_EXEC_NS=2000000 FAKE_PJRT_EVENT_AT_ENQUEUE=1 FAKE_PJRT_RTT_NS=3000000 \
+    PJRT_SMOKE_NO_EVENTS=1 PJRT_SMOKE_D2H=1 PJRT_SMOKE_FEED=1 \
+    $B/pjrt_smoke $B/libvtpu.so 1 1 50 > "$TMP/autofloor_busy.out"
+BWALL=$(result_field "$TMP/autofloor_busy.out" exec_seconds)
+python3 -c "
+awall, owall, bwall, floor = float('$AWALL'), float('$OWALL'), float('$BWALL'), int('$AFLOOR')
+# calibrated: ~50 x (3ms RTT + 0.1ms busy) serial, no pacing ~= 0.16-0.35s
+assert awall < 0.6, f'auto floor did not exempt transport: {awall}s (floor {floor}ns)'
+assert 2_500_000 <= floor <= 6_000_000, f'floor should read ~3ms RTT: {floor}ns'
+# disabled: full 3.1ms walls at 20% duty owe ~0.7s+ of pacing
+assert owall > awall * 1.8, f'control should throttle: {owall}s vs {awall}s'
+# busy above the floor still pays: 50 x 2ms = 100ms charged busy at 20%
+# duty -> wall >= (busy - one window burst) / duty = (0.1 - 0.02) / 0.2
+assert bwall >= 0.4, f'real compute above floor must throttle: {bwall}s'
+print(f'   auto floor ok: calibrated={floor}ns wall={awall}s (off={owall}s, busy={bwall}s)')"
+
 echo "== 8. core-limit proportionality: 75% vs 25% admitted duty ~ 3:1 =="
 # serial completion-coupled loop (execute -> D2H await), the serving pattern:
 # deterministic on a loaded 1-core box, where 500 free-running async submits

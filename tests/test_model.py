@@ -73,3 +73,50 @@ def test_decode_unroll_matches_fori(params):
                                    rtol=1e-5, atol=1e-5)
         np.testing.assert_allclose(np.asarray(cache_f["k"]), np.asarray(cache_u["k"]),
                                    rtol=1e-6, atol=1e-6)
+
+
+def test_int8_kv_decode_tracks_bf16(params):
+    """kv_int8=True: the cache stores int8 values + per-token-per-head f32
+    scales (half the decode read bytes); logits must track the exact-cache
+    path within quantization tolerance, and greedy tokens must match."""
+    cfg_q = dataclasses.replace(TINY, kv_int8=True)
+    tokens = jax.random.randint(jax.random.key(7), (2, 12), 0, TINY.vocab)
+
+    logits_ex, cache_ex = prefill(params, TINY, tokens)
+    logits_q, cache_q = prefill(params, cfg_q, tokens)
+    assert cache_q["k"].dtype == jnp.int8
+    assert cache_q["k_scale"].shape == (
+        TINY.n_layers, 2, TINY.max_seq, TINY.n_heads)
+    # prefill logits are computed from exact activations (quant only hits
+    # the STORED cache), so they match tightly
+    np.testing.assert_allclose(
+        np.asarray(logits_q), np.asarray(logits_ex), rtol=1e-5, atol=1e-5)
+
+    # decode reads the quantized window: close, not identical
+    step_ex, cache_ex = decode_step(params, TINY, cache_ex, tokens[:, 0])
+    step_q, cache_q = decode_step(params, cfg_q, cache_q, tokens[:, 0])
+    np.testing.assert_allclose(
+        np.asarray(step_q), np.asarray(step_ex), rtol=0.05, atol=0.05)
+    assert int(cache_q["len"][0]) == 13
+
+    # end to end: greedy argmax is robust to the quant noise at this scale
+    out_ex = greedy_generate(params, TINY, tokens, steps=5)
+    out_q = greedy_generate(params, cfg_q, tokens, steps=5)
+    np.testing.assert_array_equal(np.asarray(out_ex), np.asarray(out_q))
+
+
+def test_int8_kv_decode_bucketed_and_unrolled(params):
+    """The bounded-window read and the unrolled layer loop both honor the
+    quantized cache (view + scales sliced together)."""
+    cfg_q = dataclasses.replace(TINY, kv_int8=True, max_seq=64)
+    tokens = jax.random.randint(jax.random.key(8), (1, 10), 0, TINY.vocab)
+    _, cache = prefill(params, cfg_q, tokens)
+    lf, cf = decode_step(params, cfg_q, cache, tokens[:, 0],
+                         kv_bucket=32, unroll=False)
+    lu, cu = decode_step(params, cfg_q, cache, tokens[:, 0],
+                         kv_bucket=32, unroll=True)
+    np.testing.assert_allclose(np.asarray(lf), np.asarray(lu),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_array_equal(np.asarray(cf["k"]), np.asarray(cu["k"]))
+    np.testing.assert_allclose(np.asarray(cf["k_scale"]),
+                               np.asarray(cu["k_scale"]), rtol=1e-6, atol=1e-6)

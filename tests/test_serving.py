@@ -291,15 +291,18 @@ def test_moe_slot_model_serves_and_matches_prefill_path():
             out.append(tok)
             pos0 = cache["len"][0]
 
-            def write_kv(l, ks, vs, k, v):
-                ks = jax.lax.dynamic_update_slice(ks, k[None], (l, 0, pos0, 0, 0))
-                vs = jax.lax.dynamic_update_slice(vs, v[None], (l, 0, pos0, 0, 0))
-                return ks, vs
+            def write_kv(l, kv, k, v):
+                return {
+                    "k": jax.lax.dynamic_update_slice(
+                        kv["k"], k[None], (l, 0, pos0, 0, 0)),
+                    "v": jax.lax.dynamic_update_slice(
+                        kv["v"], v[None], (l, 0, pos0, 0, 0)),
+                }
 
-            lg, ks, vs = decode_layer_loop(
+            lg, new_kv = decode_layer_loop(
                 params, cfg, cache, jnp.asarray([tok], jnp.int32), 0,
                 write_kv, ffn_fn=moe_decode_ffn(cfg))
-            cache = {"k": ks, "v": vs, "len": cache["len"] + 1}
+            cache = {**new_kv, "len": cache["len"] + 1}
             logits = lg[0]
         return out
 

@@ -41,6 +41,7 @@ class MoEConfig:
     max_seq: int = 1024
     head_dim: int = 128
     dtype: Any = jnp.bfloat16
+    kv_int8: bool = False  # int8 KV cache (see ModelConfig.kv_int8)
 
     @property
     def qkv_dim(self) -> int:
@@ -240,7 +241,7 @@ def moe_prefill(
     does too). Without true_len, capacity = full token count: no token
     (real or pad) can ever drop — exact, but O(E/cf) more dispatch memory.
     """
-    from vtpu.models.transformer import init_kv_cache
+    from vtpu.models.transformer import fill_kv_cache, init_kv_cache
 
     b, s = tokens.shape
     cos, sin = rope_angles(cfg.max_seq, cfg.head_dim)
@@ -273,7 +274,6 @@ def moe_prefill(
     logits = (x @ params["embed"].T).astype(jnp.float32)
 
     cache = init_kv_cache(cfg, b)
-    cache["k"] = jax.lax.dynamic_update_slice(cache["k"], ks, (0, 0, 0, 0, 0))
-    cache["v"] = jax.lax.dynamic_update_slice(cache["v"], vs, (0, 0, 0, 0, 0))
+    cache.update(fill_kv_cache(cache, ks, vs))
     cache["len"] = jnp.full((b,), s, jnp.int32)
     return logits, cache

@@ -17,7 +17,11 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
-from vtpu.ops import scaled_normal, rms_norm, apply_rope, rope_angles, causal_attention, flash_attention
+from vtpu.ops import (
+    scaled_normal, rms_norm, apply_rope, rope_angles, causal_attention,
+    causal_attention_int8kv, flash_attention,
+)
+from vtpu.ops.attention import FLASH_MIN_SEQ
 
 Params = dict[str, Any]
 
@@ -33,6 +37,11 @@ class ModelConfig:
     head_dim: int = 128
     dtype: Any = jnp.bfloat16
     use_pallas: bool = True
+    # int8 KV cache with per-token-per-head f32 scales: halves the bytes the
+    # bandwidth-bound decode step streams (1 + 4/head_dim bytes/elem vs 2 for
+    # bf16) and doubles serving tenant density per HBM GiB. Off by default:
+    # training and tests keep exact bf16 KV.
+    kv_int8: bool = False
 
     @property
     def qkv_dim(self) -> int:
@@ -66,11 +75,39 @@ def init_params(rng: jax.Array, cfg: ModelConfig) -> Params:
 
 def init_kv_cache(cfg: ModelConfig, batch: int) -> dict[str, jax.Array]:
     shape = (cfg.n_layers, batch, cfg.max_seq, cfg.n_heads, cfg.head_dim)
+    if kv_quantized(cfg):
+        return {
+            "k": jnp.zeros(shape, jnp.int8),
+            "v": jnp.zeros(shape, jnp.int8),
+            "k_scale": jnp.zeros(shape[:-1], jnp.float32),
+            "v_scale": jnp.zeros(shape[:-1], jnp.float32),
+            "len": jnp.zeros((batch,), jnp.int32),
+        }
     return {
         "k": jnp.zeros(shape, cfg.dtype),
         "v": jnp.zeros(shape, cfg.dtype),
         "len": jnp.zeros((batch,), jnp.int32),
     }
+
+
+def kv_quantized(cfg) -> bool:
+    # getattr: MoEConfig and other families share this cache machinery
+    return bool(getattr(cfg, "kv_int8", False))
+
+
+def quantize_kv(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """[..., H, Dh] -> (int8 values, [..., H] f32 absmax/127 scales).
+
+    Per-token-per-head symmetric scaling — the standard KV-cache quant: each
+    head's token vector is scaled independently, so one outlier head cannot
+    crush another's resolution. Scales stay f32 (4/Dh bytes per element —
+    noise next to the 2x saved on values)."""
+    xf = x.astype(jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1), 1e-6) / 127.0
+    q = jnp.clip(jnp.round(xf / scale[..., None]), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
 
 
 def _qkv(cfg, lp, x, cos, sin, positions):
@@ -100,7 +137,7 @@ def transformer_layer(
     """
     b, s, _ = x.shape
     q, k, v = _qkv(cfg, lp, x, cos, sin, positions)
-    if cfg.use_pallas and s % 128 == 0:
+    if cfg.use_pallas and s % 128 == 0 and s >= FLASH_MIN_SEQ:
         attn = flash_attention(q, k, v)
     else:
         attn = causal_attention(q, k, v)
@@ -126,10 +163,33 @@ def prefill(
     logits = (x @ params["embed"].T).astype(jnp.float32)
 
     cache = init_kv_cache(cfg, b)
-    cache["k"] = jax.lax.dynamic_update_slice(cache["k"], ks, (0, 0, 0, 0, 0))
-    cache["v"] = jax.lax.dynamic_update_slice(cache["v"], vs, (0, 0, 0, 0, 0))
+    cache.update(fill_kv_cache(cache, ks, vs))
     cache["len"] = jnp.full((b,), s, jnp.int32)
     return logits, cache
+
+
+def fill_kv_cache(
+    cache: dict[str, jax.Array], ks: jax.Array, vs: jax.Array
+) -> dict[str, jax.Array]:
+    """Write freshly-computed [L, B, S, H, Dh] KV into a (possibly int8)
+    cache's leading positions — the single prefill fill site shared by the
+    dense and MoE families."""
+    out = {}
+    if "k_scale" in cache:
+        kq, ksc = quantize_kv(ks)
+        vq, vsc = quantize_kv(vs)
+        out["k"] = jax.lax.dynamic_update_slice(cache["k"], kq, (0, 0, 0, 0, 0))
+        out["v"] = jax.lax.dynamic_update_slice(cache["v"], vq, (0, 0, 0, 0, 0))
+        out["k_scale"] = jax.lax.dynamic_update_slice(
+            cache["k_scale"], ksc, (0, 0, 0, 0))
+        out["v_scale"] = jax.lax.dynamic_update_slice(
+            cache["v_scale"], vsc, (0, 0, 0, 0))
+        return out
+    out["k"] = jax.lax.dynamic_update_slice(
+        cache["k"], ks.astype(cache["k"].dtype), (0, 0, 0, 0, 0))
+    out["v"] = jax.lax.dynamic_update_slice(
+        cache["v"], vs.astype(cache["v"].dtype), (0, 0, 0, 0, 0))
+    return out
 
 
 def decode_step(
@@ -146,16 +206,26 @@ def decode_step(
     """
     pos0 = cache["len"][0]  # uniform batch position (benchmark decodes in lockstep)
 
-    def write_kv(l, ks, vs, k, v):
-        ks = jax.lax.dynamic_update_slice(ks, k[None], (l, 0, pos0, 0, 0))
-        vs = jax.lax.dynamic_update_slice(vs, v[None], (l, 0, pos0, 0, 0))
-        return ks, vs
+    def write_kv(l, kv, k, v):
+        out = dict(kv)
+        if "k_scale" in kv:
+            kq, ksc = quantize_kv(k)
+            vq, vsc = quantize_kv(v)
+            out["k"] = jax.lax.dynamic_update_slice(kv["k"], kq[None], (l, 0, pos0, 0, 0))
+            out["v"] = jax.lax.dynamic_update_slice(kv["v"], vq[None], (l, 0, pos0, 0, 0))
+            out["k_scale"] = jax.lax.dynamic_update_slice(
+                kv["k_scale"], ksc[None], (l, 0, pos0, 0))
+            out["v_scale"] = jax.lax.dynamic_update_slice(
+                kv["v_scale"], vsc[None], (l, 0, pos0, 0))
+            return out
+        out["k"] = jax.lax.dynamic_update_slice(kv["k"], k[None], (l, 0, pos0, 0, 0))
+        out["v"] = jax.lax.dynamic_update_slice(kv["v"], v[None], (l, 0, pos0, 0, 0))
+        return out
 
-    logits, new_ks, new_vs = decode_layer_loop(
+    logits, new_kv = decode_layer_loop(
         params, cfg, cache, token, kv_bucket, write_kv, unroll=unroll
     )
-    new_cache = {"k": new_ks, "v": new_vs, "len": cache["len"] + 1}
-    return logits, new_cache
+    return logits, {**new_kv, "len": cache["len"] + 1}
 
 
 def decode_layer_loop(
@@ -167,58 +237,72 @@ def decode_layer_loop(
     write_kv,
     ffn_fn=None,
     unroll: bool = False,
-) -> tuple[jax.Array, jax.Array, jax.Array]:
+) -> tuple[jax.Array, dict[str, jax.Array]]:
     """Shared decode-step body: a fori_loop carrying the STACKED cache (not a
     scan stacking fresh per-layer outputs), so the cache write — supplied by
-    the caller as ``write_kv(l, ks, vs, k, v)`` (lockstep column update here,
-    per-slot scatter in the serving engine) — aliases in place instead of
-    copying the whole cache. Decode is bandwidth-bound and that copy
+    the caller as ``write_kv(l, kv, k, v) -> kv`` (lockstep column update
+    here, per-slot scatter in the serving engine) — aliases in place instead
+    of copying the whole cache. Decode is bandwidth-bound and that copy
     dominated the step. The read view is bounded to ``kv_bucket`` (static;
-    0 = max_seq). ``ffn_fn(lp, x)`` swaps the post-attention block (dense
-    MLP here; routed experts for the MoE family — both share this attention
-    trunk). ``unroll`` trades compile time for a STATIC layer index: inside
-    fori_loop the bounded read is dynamic_index_in_dim(ks, l)[:, :bucket]
-    with a loop-carried l, which XLA materializes as a slice copy before
-    attention; unrolled, ks[l][:, :bucket] is a static view that fuses into
-    the attention reads. Returns (logits, new_ks, new_vs)."""
+    0 = max_seq); int8 caches (k_scale/v_scale present) dequantize the
+    bounded window inline, so the attention reads stream half the bytes.
+    ``ffn_fn(lp, x)`` swaps the post-attention block (dense MLP here; routed
+    experts for the MoE family — both share this attention trunk).
+    ``unroll`` trades compile time for a STATIC layer index: inside fori_loop
+    the bounded read is dynamic_index_in_dim(ks, l)[:, :bucket] with a
+    loop-carried l, which XLA materializes as a slice copy before attention;
+    unrolled, ks[l][:, :bucket] is a static view that fuses into the
+    attention reads. Returns (logits, new kv dict)."""
     b = token.shape[0]
     bucket = kv_bucket or cfg.max_seq
+    quant = "k_scale" in cache
     ffn = ffn_fn or _mlp_block
     cos, sin = rope_angles(cfg.max_seq, cfg.head_dim)
     positions = cache["len"][:, None]  # [B, 1]
     x = params["embed"][token[:, None]].astype(cfg.dtype)
     kv_len = cache["len"] + 1
+    kv_keys = ("k", "v", "k_scale", "v_scale") if quant else ("k", "v")
 
     def layer(l, carry, lp=None):
-        x, ks, vs = carry
+        x, kv = carry
         if lp is None:
             lp = jax.tree_util.tree_map(lambda a: a[l], params["layers"])
         q, k, v = _qkv(cfg, lp, x, cos, sin, positions)
-        ks, vs = write_kv(l, ks, vs, k, v)
+        kv = write_kv(l, kv, k, v)
         if unroll:
-            k_view = ks[l, :, :bucket]
-            v_view = vs[l, :, :bucket]
+            view = {key: kv[key][l, :, :bucket] for key in kv_keys}
         else:
-            k_view = jax.lax.dynamic_index_in_dim(ks, l, 0, keepdims=False)[:, :bucket]
-            v_view = jax.lax.dynamic_index_in_dim(vs, l, 0, keepdims=False)[:, :bucket]
-        attn = causal_attention(q, k_view, v_view, kv_len=kv_len)
+            view = {
+                key: jax.lax.dynamic_index_in_dim(kv[key], l, 0, keepdims=False)[
+                    :, :bucket]
+                for key in kv_keys
+            }
+        if quant:
+            # post-scale formulation: int8 values feed the MXU directly and
+            # the scales ride the score tensor (causal_attention_int8kv) —
+            # dequantize-then-attend materialized the bf16 window and LOST
+            # to the unquantized path on r4 hardware
+            attn = causal_attention_int8kv(
+                q, view["k"], view["k_scale"], view["v"], view["v_scale"],
+                kv_len=kv_len)
+        else:
+            attn = causal_attention(q, view["k"], view["v"], kv_len=kv_len)
         x = x + attn.reshape(b, 1, cfg.qkv_dim) @ lp["wo"]
         x = x + ffn(lp, x)
-        return x, ks, vs
+        return x, kv
 
+    kv0 = {key: cache[key] for key in kv_keys}
     if unroll:
-        carry = (x, cache["k"], cache["v"])
+        carry = (x, kv0)
         for l in range(cfg.n_layers):
             lp = jax.tree_util.tree_map(lambda a: a[l], params["layers"])
             carry = layer(l, carry, lp=lp)
-        x, new_ks, new_vs = carry
+        x, new_kv = carry
     else:
-        x, new_ks, new_vs = jax.lax.fori_loop(
-            0, cfg.n_layers, layer, (x, cache["k"], cache["v"])
-        )
+        x, new_kv = jax.lax.fori_loop(0, cfg.n_layers, layer, (x, kv0))
     x = rms_norm(x, params["final_norm"])
     logits = (x[:, 0] @ params["embed"].T).astype(jnp.float32)
-    return logits, new_ks, new_vs
+    return logits, new_kv
 
 
 def greedy_generate(

@@ -10,7 +10,11 @@ reference benchmarks/ai-benchmark/benchmark.py:1-50).
 from vtpu.ops.init import scaled_normal
 from vtpu.ops.norms import rms_norm
 from vtpu.ops.rope import apply_rope, rope_angles
-from vtpu.ops.attention import causal_attention, flash_attention
+from vtpu.ops.attention import (
+    causal_attention,
+    causal_attention_int8kv,
+    flash_attention,
+)
 
 __all__ = [
     "scaled_normal",
@@ -18,5 +22,6 @@ __all__ = [
     "apply_rope",
     "rope_angles",
     "causal_attention",
+    "causal_attention_int8kv",
     "flash_attention",
 ]

@@ -52,7 +52,67 @@ def causal_attention(
     return out.astype(q.dtype)
 
 
+def causal_attention_int8kv(
+    q: jax.Array,
+    kq: jax.Array,
+    k_scale: jax.Array,
+    vq: jax.Array,
+    v_scale: jax.Array,
+    kv_len: jax.Array | None = None,
+) -> jax.Array:
+    """Causal attention directly over an int8-quantized KV window.
+
+    The per-token-per-head scales are EXACT to apply after the matmuls
+    instead of to the operands: scores(q, k*s_k) = scores(q, k) * s_k and
+    sum_k p_k * (v_k * s_vk) = sum_k (p_k * s_vk) * v_k — so the int8 values
+    feed the MXU through a bare convert (which XLA fuses into the dot) and
+    the scales ride the [B,H,Sq,Sk] score tensor that exists anyway. A
+    dequantize-then-attend formulation measured SLOWER than bf16 on r4
+    hardware: XLA materialized the full dequantized window, paying the bf16
+    bytes the quantization was supposed to save.
+
+    q: [B,Sq,H,Dh]; kq, vq: [B,Sk,H,Dh] int8; k_scale, v_scale: [B,Sk,H]
+    f32 (absmax/127 per token per head); kv_len as in causal_attention.
+    """
+    b, sq, h, dh = q.shape
+    sk = kq.shape[1]
+    scale = 1.0 / math.sqrt(dh)
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, kq.astype(q.dtype),
+        preferred_element_type=jnp.float32) * scale
+    scores = scores * k_scale.transpose(0, 2, 1)[:, :, None, :]  # [B,H,1,Sk]
+    q_pos = jnp.arange(sq)[:, None] + (sk - sq)
+    k_pos = jnp.arange(sk)[None, :]
+    mask = k_pos <= q_pos
+    if kv_len is not None:
+        valid = k_pos < kv_len[:, None]
+        mask = (mask[None, :, :] & valid[:, None, :])[:, None, :, :]
+    else:
+        mask = mask[None, None, :, :]
+    scores = jnp.where(mask, scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    probs = probs * v_scale.transpose(0, 2, 1)[:, :, None, :]
+    out = jnp.einsum(
+        "bhqk,bkhd->bqhd", probs.astype(q.dtype), vq.astype(q.dtype))
+    return out.astype(q.dtype)
+
+
+# Below this sequence length the kernel is maintenance without payoff: with
+# K/V VMEM-resident, XLA's fused attention is within ~1.1x of the kernel at
+# serving shapes (measured r3+r4: 0.95-1.08x at s<=1024), while the kernel
+# wins 1.27x at 2048, 1.44x at 4096 and >12x at 8192, where XLA's score
+# materialization falls off the VMEM cliff. transformer_layer routes on this.
+FLASH_MIN_SEQ = 2048
+
+
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int, scale: float):
+    """Single-K-pass schedule, deliberately NOT the blocked online-softmax
+    loop: K/V for one (batch, head) are VMEM-resident at every supported
+    shape, so the whole-S score matmul runs as one MXU op. An r4 experiment
+    with a causal k-block skip (dynamic-trip fori_loop, online softmax)
+    measured SLOWER everywhere — 19.0 ms vs 15.8 at [16,2048], 18.3 vs 15.2
+    at [1,8192] — the loop's 128-wide matmuls and VPU rescaling cost more
+    than the upper-triangle waste it avoided."""
     j = pl.program_id(1)
     q = q_ref[0]  # (block_q, Dh)
     k = k_ref[0]  # (S, Dh)

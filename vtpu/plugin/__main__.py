@@ -46,8 +46,13 @@ def main() -> None:
     parser.add_argument("--charge-floor-ms", type=int,
                         default=int(os.environ.get("VTPU_CHARGE_FLOOR_MS", "0")),
                         help="transport floor (ms) libvtpu deducts from duty "
-                             "charges; set to the per-dispatch RTT on proxied "
-                             "runtimes (docs/protocol.md)")
+                             "charges; 0 (default) = libvtpu self-calibrates "
+                             "from small-upload round trips; a value "
+                             "overrides calibration (docs/protocol.md)")
+    parser.add_argument("--charge-floor-max-ms", type=int,
+                        default=int(os.environ.get("VTPU_CHARGE_FLOOR_MAX_MS", "0")),
+                        help="ceiling on the self-calibrated floor "
+                             "(0 = libvtpu's built-in 1000 ms)")
     parser.add_argument("-v", "--verbose", action="count", default=0)
     args = parser.parse_args()
 
@@ -118,6 +123,7 @@ def main() -> None:
         cdi_dir=args.cdi_dir,
         qos_enabled=args.qos,
         charge_floor_ms=args.charge_floor_ms,
+        charge_floor_max_ms=args.charge_floor_max_ms,
         slice_info=slice_info,
     )
     if args.cdi:

@@ -31,6 +31,8 @@ ENV_DISABLE_CONTROL = "VTPU_DISABLE_CONTROL"
 # backoff up to this long (docs/multitenancy.md). Unset/0 = fail fast.
 ENV_ATTACH_WAIT = "VTPU_ATTACH_WAIT_MS"
 ENV_CHARGE_FLOOR = "VTPU_CHARGE_FLOOR_MS"
+# Ceiling on libvtpu's self-calibrated transport floor (RttFloor).
+ENV_CHARGE_FLOOR_MAX = "VTPU_CHARGE_FLOOR_MAX_MS"
 # Fatal-health marker file: libvtpu appends a line on fatal PJRT errors; the
 # HealthWatcher promotes it to chip Unhealthy (the XID-event analog).
 ENV_HEALTH_FILE = "VTPU_HEALTH_FILE"

@@ -66,10 +66,17 @@ class PluginConfig:
     # exclusive-attach runtime the 2nd..Nth tenant's client create queues in
     # libvtpu up to this long instead of crash-looping the pod. 0 disables.
     attach_wait_ms: int = 120_000
-    # Transport floor (ms) deducted from libvtpu's sync-wall duty charges —
-    # set on proxied/tunneled runtimes to the per-dispatch RTT so core
-    # limits pace chip time, not transport (docs/protocol.md env table).
+    # Transport floor (ms) deducted from libvtpu's sync-wall duty charges.
+    # 0 (default): libvtpu SELF-CALIBRATES the floor from small-upload round
+    # trips (shim.cc RttFloor) — core limits work out of the box on proxied
+    # runtimes, like the reference's SM limit does locally. A value here
+    # overrides calibration with an operator-declared floor
+    # (docs/protocol.md env table).
     charge_floor_ms: int = 0
+    # Ceiling on the self-calibrated floor (the calibration samples are
+    # tenant-controlled; see shim.cc RttFloor adversarial notes). 0 = keep
+    # libvtpu's built-in 1000 ms default.
+    charge_floor_max_ms: int = 0
     # extra passthrough envs (reference vgpucfg.go node overrides)
     extra_envs: dict[str, str] = field(default_factory=dict)
     # multi-host slice membership of this node (rm.discover_slice()); when a
@@ -332,6 +339,8 @@ class TpuDevicePlugin:
             env[envs.ENV_OVERSUBSCRIBE] = "true"
         if cfg.charge_floor_ms > 0:
             env[envs.ENV_CHARGE_FLOOR] = str(cfg.charge_floor_ms)
+        if cfg.charge_floor_max_ms > 0:
+            env[envs.ENV_CHARGE_FLOOR_MAX] = str(cfg.charge_floor_max_ms)
         prio = pod_annotations(pod).get(t.TASK_PRIORITY_ANNO, "")
         if prio:
             env[envs.ENV_TASK_PRIORITY] = prio

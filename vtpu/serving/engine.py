@@ -33,6 +33,7 @@ from vtpu.models.transformer import (
     Params,
     decode_layer_loop,
     prefill,
+    quantize_kv,
 )
 
 log = logging.getLogger(__name__)
@@ -107,26 +108,34 @@ def batched_decode_step(
     lens = cache["len"]
     rows = jnp.arange(b)
 
-    def write_kv(l, ks, vs, k, v):
+    def write_kv(l, kv, k, v):
         # per-slot scatter at (l, row, lens[row]); inactive rows keep old KV
-        ks = ks.at[l, rows, lens].set(
-            jnp.where(active[:, None, None], k[:, 0], ks[l, rows, lens])
+        out = dict(kv)
+        if "k_scale" in kv:
+            kq, ksc = quantize_kv(k[:, 0])  # [B, H, Dh] -> int8 + [B, H]
+            vq, vsc = quantize_kv(v[:, 0])
+            out["k"] = kv["k"].at[l, rows, lens].set(
+                jnp.where(active[:, None, None], kq, kv["k"][l, rows, lens]))
+            out["v"] = kv["v"].at[l, rows, lens].set(
+                jnp.where(active[:, None, None], vq, kv["v"][l, rows, lens]))
+            out["k_scale"] = kv["k_scale"].at[l, rows, lens].set(
+                jnp.where(active[:, None], ksc, kv["k_scale"][l, rows, lens]))
+            out["v_scale"] = kv["v_scale"].at[l, rows, lens].set(
+                jnp.where(active[:, None], vsc, kv["v_scale"][l, rows, lens]))
+            return out
+        out["k"] = kv["k"].at[l, rows, lens].set(
+            jnp.where(active[:, None, None], k[:, 0], kv["k"][l, rows, lens])
         )
-        vs = vs.at[l, rows, lens].set(
-            jnp.where(active[:, None, None], v[:, 0], vs[l, rows, lens])
+        out["v"] = kv["v"].at[l, rows, lens].set(
+            jnp.where(active[:, None, None], v[:, 0], kv["v"][l, rows, lens])
         )
-        return ks, vs
+        return out
 
-    logits, new_ks, new_vs = decode_layer_loop(
+    logits, new_kv = decode_layer_loop(
         params, cfg, cache, tokens, kv_bucket, write_kv, ffn_fn=ffn_fn,
         unroll=unroll,
     )
-    new_cache = {
-        "k": new_ks,
-        "v": new_vs,
-        "len": jnp.where(active, lens + 1, lens),
-    }
-    return logits, new_cache
+    return logits, {**new_kv, "len": jnp.where(active, lens + 1, lens)}
 
 
 def prefill_into_slot(
@@ -148,14 +157,15 @@ def prefill_into_slot(
     """
     logits, seq_cache = (prefill_fn or prefill)(params, cfg, tokens)
     # [L, 1, max_seq, H, Dh] -> the bucket's worth, written at (layer, slot, 0)
+    # (int8 caches carry k_scale/v_scale alongside; copied the same way)
     s = tokens.shape[1]
-    k = seq_cache["k"][:, 0, :s]
-    v = seq_cache["v"][:, 0, :s]
-    new_k = cache["k"].at[:, slot, :s].set(k)
-    new_v = cache["v"].at[:, slot, :s].set(v)
-    new_len = cache["len"].at[slot].set(true_len)
+    new_cache = dict(cache)
+    for key in ("k", "v", "k_scale", "v_scale"):
+        if key in cache:
+            new_cache[key] = cache[key].at[:, slot, :s].set(seq_cache[key][:, 0, :s])
+    new_cache["len"] = cache["len"].at[slot].set(true_len)
     last = logits[0, true_len - 1]
-    return last, {"k": new_k, "v": new_v, "len": new_len}
+    return last, new_cache
 
 
 class ServingEngine:
