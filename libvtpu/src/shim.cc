@@ -107,38 +107,36 @@ struct ScopedNs {
 // needs no operator tuning; neither should the core knob here). Over a
 // proxied/tunneled PJRT plugin, every completion-coupled wall the sync-wall
 // charger sees carries the transport round trip — which is not chip busy.
-// Small host->device uploads are the calibration signal: BufferFromHostBuffer
-// is synchronous over such runtimes, and for a tiny payload the device-side
-// work is microseconds, so the wall IS the round trip. The floor is the
-// MINIMUM wall over a two-bucket rotating window:
-//   - min, not mean: a busy tunnel makes samples SLOWER, never faster, so a
-//     minimum can't drift above the true transport cost — and unlike a
-//     rolling mean it cannot misread constant-cost real work as floor
-//     (real work only ever adds on top of the fastest observed round trip);
-//   - size-gated: only payloads <= 64 KiB sample (serving feeds sampled
-//     tokens every decode tick, a steady stream of near-pure-RTT walls);
-//   - rotation bounds staleness by COUNT and by TIME: a bucket rotates
-//     after 64 samples or 30 s, and buckets older than 150 s are ignored
-//     entirely (a floor calibrated during transient congestion must not
-//     outlive it; no recent signal = charge full walls, conservative in
-//     the limit's favor);
-//   - local runtimes self-calibrate to ~microseconds: effectively no floor.
 //
-// Adversarial bounds (the floor is computed from tenant-controlled calls):
-// a tenant saturating the tunnel with its own traffic can inflate observed
-// walls and with them the minimum. Two independent caps bound the damage:
-// the floor is clamped to VTPU_CHARGE_FLOOR_MAX_MS (operator ceiling,
-// default 1 s), and charge_sync_wall always charges at least 1/16 of the
-// raw wall regardless of floor — so even a fully-gamed floor pays 6.25%
-// of observed busy, while honest serving (floor = real RTT) is unaffected
-// at any practical duty.
+// The calibration signal is the shim's OWN attach-time probe
+// (probe_transport_floor): a tiny upload + device-to-host read-back, waited
+// to transfer completion, on the freshly attached client BEFORE any tenant
+// work exists. That wall is pure transport (the read-back has no compute
+// ahead of it and moves 256 bytes) and is un-gameable — the tenant hasn't
+// run yet. Tenant-call-derived signals were tried and rejected (r4):
+// small-UPLOAD walls measure ~0.2 ms on the dev tunnel (its H2D is
+// pipelined; only D2H completion carries the RTT), and tenant D2H walls
+// include whatever compute the tenant queued — a min over them misreads
+// constant-cost real work as floor, exactly the failure the CORESHARE
+// proportionality proof would hit.
+//
+// Floor = MINIMUM probe wall (min, not mean: congestion makes samples
+// slower, never faster). The floor is attach-time-static thereafter:
+// transport drift upward over-charges duty (conservative, in the limit's
+// favor); drift downward under-charges, bounded by the caps below.
+//
+// Adversarial / staleness bounds: the floor is clamped to
+// VTPU_CHARGE_FLOOR_MAX_MS (operator ceiling, default 1 s), every wall
+// pays at least 1/16 regardless of floor, and bucket aging (kMaxAgeNs) is
+// retained for any future periodic re-probe.
 class RttFloor {
  public:
-  static constexpr uint64_t kSmallUploadBytes = 64 * 1024;
   static constexpr int kMinSamples = 4;
   static constexpr int kBucketSamples = 64;
   static constexpr uint64_t kRotateNs = 30ull * 1000'000'000;
-  static constexpr uint64_t kMaxAgeNs = 150ull * 1000'000'000;
+  // attach-time probes must not age out over a long-lived process: the
+  // fallback to "charge full walls" would silently re-throttle transport
+  static constexpr uint64_t kMaxAgeNs = UINT64_MAX;
 
   void record(uint64_t wall_ns, uint64_t now_ns) {
     std::lock_guard<std::mutex> lock(mu_);
@@ -358,6 +356,126 @@ void refresh_device_map(PJRT_Client* client) {
   }
   s.device_count.store(s.device_index.size(), std::memory_order_relaxed);
   VTPU_INFO("mapped %zu addressable devices", args.num_addressable_devices);
+}
+
+void destroy_real_error(PJRT_Error* err);
+void destroy_event(PJRT_Event* ev);
+
+// Attach-time transport probe: the shim's own tiny upload + read-back,
+// waited to transfer completion, on the fresh client — BEFORE any tenant
+// work exists. The minimum of 4 round trips seeds the transport floor (see
+// RttFloor). Everything goes through s.real directly so the shim's own HBM
+// accounting never sees the probe buffers. Cost: ~4 RTTs once per attach
+// (µs locally, ~0.5 s on the dev tunnel — noise next to attach+compile).
+// Await-then-destroy a real-API event (probe helper).
+bool await_and_destroy(PJRT_Event* ev) {
+  if (ev == nullptr) return true;
+  auto& s = S();
+  PJRT_Event_Await_Args aw;
+  std::memset(&aw, 0, sizeof(aw));
+  aw.struct_size = PJRT_Event_Await_Args_STRUCT_SIZE;
+  aw.event = ev;
+  bool ok = true;
+  if (PJRT_Error* aerr = s.real->PJRT_Event_Await(&aw)) {
+    destroy_real_error(aerr);
+    ok = false;
+  }
+  destroy_event(ev);
+  return ok;
+}
+
+void probe_transport_floor(PJRT_Client* client) {
+  auto& s = S();
+  if (!s.limits.charge_floor_auto || s.limits.charge_floor_ns > 0) return;
+  // Probe ONCE per process, at the FIRST attach: that is the pre-tenant-work
+  // moment the un-gameability argument rests on. Re-creating clients must
+  // not re-open calibration — probe walls on a later attach would include
+  // whatever the tenant queued, the adversarial drift this design removes.
+  static std::atomic<bool> probed{false};
+  if (probed.exchange(true)) return;
+  if (s.real->PJRT_Client_BufferFromHostBuffer == nullptr ||
+      s.real->PJRT_Buffer_ToHostBuffer == nullptr ||
+      s.real->PJRT_Buffer_Destroy == nullptr ||
+      s.real->PJRT_Event_Await == nullptr ||
+      s.real->PJRT_Event_Destroy == nullptr) {
+    VTPU_WARN("transport floor probe skipped: plugin lacks a required entry "
+              "point; full walls will be charged (declare "
+              "VTPU_CHARGE_FLOOR_MS on proxied runtimes)");
+    return;
+  }
+  PJRT_Client_AddressableDevices_Args da;
+  std::memset(&da, 0, sizeof(da));
+  da.struct_size = PJRT_Client_AddressableDevices_Args_STRUCT_SIZE;
+  da.client = client;
+  if (PJRT_Error* err = s.real->PJRT_Client_AddressableDevices(&da)) {
+    destroy_real_error(err);
+    VTPU_WARN("transport floor probe failed listing devices; full walls "
+              "will be charged");
+    return;
+  }
+  if (da.num_addressable_devices == 0) return;
+
+  float src[64] = {0};
+  int64_t dims[1] = {64};
+  char dst[sizeof(src)];
+  for (int i = 0; i < RttFloor::kMinSamples; i++) {
+    PJRT_Client_BufferFromHostBuffer_Args ba;
+    std::memset(&ba, 0, sizeof(ba));
+    ba.struct_size = PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE;
+    ba.client = client;
+    ba.data = src;
+    ba.type = PJRT_Buffer_Type_F32;
+    ba.dims = dims;
+    ba.num_dims = 1;
+    ba.host_buffer_semantics =
+        PJRT_HostBufferSemantics_kImmutableUntilTransferCompletes;
+    ba.device = da.addressable_devices[0];
+    if (PJRT_Error* err = s.real->PJRT_Client_BufferFromHostBuffer(&ba)) {
+      destroy_real_error(err);
+      VTPU_WARN("transport floor probe upload failed (iteration %d); "
+                "floor stays at %llu ns", i,
+                (unsigned long long)rtt_floor().floor_ns(tick_ns()));
+      return;
+    }
+    // kImmutableUntilTransferCompletes: src (stack) must stay valid until
+    // this fires — await it, never just destroy it, or an error return
+    // below could free src under an in-flight H2D
+    bool ok = await_and_destroy(ba.done_with_host_buffer);
+    if (ba.buffer == nullptr) return;
+    uint64_t t0 = tick_ns();
+    PJRT_Buffer_ToHostBuffer_Args th;
+    std::memset(&th, 0, sizeof(th));
+    th.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
+    th.src = ba.buffer;
+    th.dst = dst;
+    th.dst_size = sizeof(dst);
+    if (ok) {
+      PJRT_Error* terr = s.real->PJRT_Buffer_ToHostBuffer(&th);
+      if (terr != nullptr) {
+        destroy_real_error(terr);
+        ok = false;
+      } else {
+        ok = await_and_destroy(th.event);
+      }
+    }
+    uint64_t t1 = tick_ns();
+    PJRT_Buffer_Destroy_Args del;
+    std::memset(&del, 0, sizeof(del));
+    del.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+    del.buffer = ba.buffer;
+    if (PJRT_Error* derr = s.real->PJRT_Buffer_Destroy(&del)) {
+      destroy_real_error(derr);
+    }
+    if (!ok) {
+      VTPU_WARN("transport floor probe round trip failed (iteration %d); "
+                "floor stays at %llu ns", i,
+                (unsigned long long)rtt_floor().floor_ns(tick_ns()));
+      return;
+    }
+    rtt_floor().record(t1 - t0, t1);
+  }
+  VTPU_INFO("transport floor probed: %llu ns",
+            (unsigned long long)rtt_floor().floor_ns(tick_ns()));
 }
 
 uint64_t buffer_device_size(PJRT_Buffer* buffer) {
@@ -580,7 +698,10 @@ PJRT_Error* wrapped_client_create(PJRT_Client_Create_Args* args) {
   for (;;) {
     PJRT_Error* err = s.real->PJRT_Client_Create(args);
     if (err == nullptr) {
-      if (args->client != nullptr) refresh_device_map(args->client);
+      if (args->client != nullptr) {
+        refresh_device_map(args->client);
+        probe_transport_floor(args->client);
+      }
       return nullptr;
     }
     PJRT_Error_Code code = real_error_code(err);
@@ -734,29 +855,11 @@ bool memory_is_host(PJRT_Memory* mem);
 PJRT_Error* settle_or_reject(PJRT_Buffer** buffer, uint64_t est, uint64_t sig,
                              bool trust_est = false);
 
-// Run the real BufferFromHostBuffer under the upload timer and, for small
-// payloads, feed the wall into the RTT-floor calibration (single site for
-// the gate so the two upload branches cannot diverge).
-PJRT_Error* timed_real_upload(PJRT_Client_BufferFromHostBuffer_Args* args,
-                              uint64_t est_bytes, bool auto_floor) {
-  uint64_t t0 = tick_ns();
-  PJRT_Error* err;
-  {
-    ScopedNs real_timer(stats().upload_real_ns);
-    err = S().real->PJRT_Client_BufferFromHostBuffer(args);
-  }
-  if (err == nullptr && auto_floor && est_bytes <= RttFloor::kSmallUploadBytes) {
-    uint64_t t1 = tick_ns();
-    rtt_floor().record(t1 - t0, t1);
-  }
-  return err;
-}
-
-// Calibration is live only when it would be consulted: auto mode AND no
-// operator-declared floor overriding it (no wasted mutex on the hot path,
-// and rtt_floor_ns can't report a stale value the charger ignores).
-bool floor_calibrating(const Limits& limits) {
-  return limits.charge_floor_auto && limits.charge_floor_ns == 0;
+// Every branch routes the real call through this so the upload timing can
+// never diverge between them.
+PJRT_Error* timed_real_upload(PJRT_Client_BufferFromHostBuffer_Args* args) {
+  ScopedNs real_timer(stats().upload_real_ns);
+  return S().real->PJRT_Client_BufferFromHostBuffer(args);
 }
 
 PJRT_Error* wrapped_buffer_from_host(PJRT_Client_BufferFromHostBuffer_Args* args) {
@@ -778,17 +881,16 @@ PJRT_Error* wrapped_buffer_from_host(PJRT_Client_BufferFromHostBuffer_Args* args
     // spaces bypass HBM accounting; device spaces settle post-hoc from the
     // resulting buffer's device.
     if (memory_is_host(args->memory)) {
-      ScopedNs real_timer(stats().upload_real_ns);
-      return s.real->PJRT_Client_BufferFromHostBuffer(args);
+      return timed_real_upload(args);
     }
-    PJRT_Error* err = timed_real_upload(args, est, floor_calibrating(s.limits));
+    PJRT_Error* err = timed_real_upload(args);
     if (err != nullptr || args->buffer == nullptr) return err;
     return settle_or_reject(&args->buffer, est, sig);
   }
   size_t dev_idx = args->device ? device_index_of(args->device) : 0;
   bool reserved = false;
   if (PJRT_Error* verr = precheck_alloc(dev_idx, est, &reserved)) return verr;
-  PJRT_Error* err = timed_real_upload(args, est, floor_calibrating(s.limits));
+  PJRT_Error* err = timed_real_upload(args);
   if (err != nullptr || args->buffer == nullptr) {
     if (reserved) unreserve(dev_idx, est);
     return err;

@@ -173,12 +173,13 @@ fwall, tfree = float('$FWALL'), float('$TFREE')
 assert fwall < max(0.25, tfree * 2), f'floor not deducted: {fwall}s (free {tfree}s)'
 print(f'   floored wall: {fwall}s (unthrottled {tfree}s, throttled $TWALL s)')"
 
-echo "== 7e. AUTO transport floor: small-upload RTT self-calibrates =="
+echo "== 7e. AUTO transport floor: attach-time probe self-calibrates =="
 # Tunnel-shaped run with a 3ms emulated transport RTT and NO operator floor:
-# the per-tick token feed (PJRT_SMOKE_FEED) gives the shim its calibration
-# stream, the windowed-minimum floor converges to ~RTT, and D2H walls charge
-# only the time ABOVE it — so with ~0 real compute the limiter must not
-# throttle (the out-of-the-box behavior the reference's SM limit has locally).
+# at client create the shim probes its own tiny upload+read-back round trip
+# (pure transport, pre-tenant-work), seeds the floor at ~RTT, and D2H walls
+# charge only the time ABOVE it — so with ~0 real compute the limiter must
+# not throttle (the out-of-the-box behavior the reference's SM limit has
+# locally). PJRT_SMOKE_FEED keeps the serving shape (per-tick token upload).
 env VTPU_REAL_LIBTPU=$PWD/$B/fake_pjrt.so TPU_CORE_LIMIT=20 \
     FAKE_PJRT_EXEC_NS=100000 FAKE_PJRT_EVENT_AT_ENQUEUE=1 FAKE_PJRT_RTT_NS=3000000 \
     PJRT_SMOKE_NO_EVENTS=1 PJRT_SMOKE_D2H=1 PJRT_SMOKE_FEED=1 \
