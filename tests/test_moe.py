@@ -167,3 +167,37 @@ def test_moe_prefill_true_len_masks_pads_and_bounds_capacity():
     np.testing.assert_allclose(
         np.asarray(logits32[:, :12]), np.asarray(logits_exact),
         rtol=2e-5, atol=2e-5)
+
+
+def test_moe_prefill_int8_kv_cache():
+    """kv_int8 flows through the MoE family's shared cache machinery: the
+    prefill fill site quantizes, and the serving decode trunk reads the
+    int8 window through the post-scale attention path."""
+    import dataclasses
+
+    from vtpu.models.moe import moe_decode_ffn, moe_prefill
+    from vtpu.serving.engine import batched_decode_step
+
+    cfg = MoEConfig(
+        vocab=128, d_model=32, n_heads=2, n_layers=2, d_ff=64,
+        n_experts=4, top_k=2, max_seq=64, head_dim=16, dtype=jnp.float32,
+    )
+    cfg_q = dataclasses.replace(cfg, kv_int8=True)
+    params = init_moe_params(jax.random.key(0), cfg)
+    tokens = jnp.asarray(
+        np.random.RandomState(3).randint(0, cfg.vocab, (2, 12)), jnp.int32)
+
+    logits_ex, cache_ex = moe_prefill(params, cfg, tokens)
+    logits_q, cache_q = moe_prefill(params, cfg_q, tokens)
+    assert cache_q["k"].dtype == jnp.int8 and "k_scale" in cache_q
+    np.testing.assert_allclose(
+        np.asarray(logits_q), np.asarray(logits_ex), rtol=1e-5, atol=1e-5)
+
+    active = jnp.ones((2,), bool)
+    tok = jnp.argmax(logits_ex[:, -1], axis=-1).astype(jnp.int32)
+    step_ex, _ = batched_decode_step(
+        params, cfg, cache_ex, tok, active, ffn_fn=moe_decode_ffn(cfg))
+    step_q, _ = batched_decode_step(
+        params, cfg_q, cache_q, tok, active, ffn_fn=moe_decode_ffn(cfg_q))
+    np.testing.assert_allclose(
+        np.asarray(step_q), np.asarray(step_ex), rtol=0.05, atol=0.05)
