@@ -354,3 +354,31 @@ def test_moe_decode_isolated_from_retired_slots():
             eng.stop()
 
     assert run(dirty=True) == run(dirty=False)
+
+
+def test_mesh_engine_with_int8_kv_cache():
+    """TransformerSlotModel with a tp mesh AND kv_int8: the sharded-alloc
+    path must cover the scale planes (kv_cache_shardings quantized=True) and
+    the engine must serve through the post-scale attention under the mesh."""
+    import dataclasses
+
+    from vtpu.parallel.mesh import make_axis_mesh
+    from vtpu.serving.adapters import TransformerSlotModel
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 devices")
+    cfg = dataclasses.replace(CFG, kv_int8=True)
+    params = init_params(jax.random.key(0), cfg)
+    mesh = make_axis_mesh("tp", 2)  # n_heads=2 shards over tp=2
+    eng = ServingEngine(
+        model=TransformerSlotModel(params, cfg, mesh=mesh),
+        serving=ServingConfig(slots=2, prefill_buckets=(16,), max_new_tokens=4),
+    )
+    assert eng.state["k"].dtype == jnp.int8
+    assert "k_scale" in eng.state
+    eng.start()
+    try:
+        toks = list(eng.submit([3, 1, 4, 1, 5]).stream())
+        assert len(toks) == 4
+    finally:
+        eng.stop()

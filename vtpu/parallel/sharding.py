@@ -52,13 +52,22 @@ def shard_params(params: Any, mesh: Mesh) -> Any:
     return jax.tree.map(jax.device_put, params, specs)
 
 
-def kv_cache_shardings(mesh: Mesh) -> dict[str, NamedSharding]:
+def kv_cache_shardings(mesh: Mesh, quantized: bool = False) -> dict[str, NamedSharding]:
     """KV cache [L, B, S, H, Dh]: heads over 'tp' (matching the q/k/v column
-    shards), lengths replicated. Serving is tp-only — see shard_kv_cache."""
+    shards), lengths replicated. ``quantized`` adds the int8 cache's
+    per-token-per-head scale planes [L, B, S, H], head-sharded alongside
+    their values. Serving is tp-only — see shard_kv_cache."""
     kv = NamedSharding(mesh, P(None, None, None, "tp", None))
-    return {"k": kv, "v": kv, "len": NamedSharding(mesh, P())}
+    out = {"k": kv, "v": kv, "len": NamedSharding(mesh, P())}
+    if quantized:
+        sc = NamedSharding(mesh, P(None, None, None, "tp"))
+        out["k_scale"] = sc
+        out["v_scale"] = sc
+    return out
 
 
 def shard_kv_cache(cache: dict[str, jax.Array], mesh: Mesh) -> dict[str, jax.Array]:
     """Place (or re-place) a KV cache per kv_cache_shardings."""
-    return jax.tree.map(jax.device_put, cache, kv_cache_shardings(mesh))
+    return jax.tree.map(
+        jax.device_put, cache,
+        kv_cache_shardings(mesh, quantized="k_scale" in cache))

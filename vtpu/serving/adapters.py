@@ -60,13 +60,15 @@ class TransformerSlotModel:
 
         if self.mesh is None:
             return init_kv_cache(self.cfg, slots)
+        from vtpu.models.transformer import kv_quantized
         from vtpu.parallel.sharding import kv_cache_shardings
 
         # allocate the cache directly sharded: a head-sharded cache that
         # would not fit one chip must never be materialized unsharded
         return jax.jit(
             lambda: init_kv_cache(self.cfg, slots),
-            out_shardings=kv_cache_shardings(self.mesh),
+            out_shardings=kv_cache_shardings(
+                self.mesh, quantized=kv_quantized(self.cfg)),
         )()
 
     def prefill_into_slot(self, params, state, padded, slot, true_len):
