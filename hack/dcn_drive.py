@@ -1,0 +1,261 @@
+#!/usr/bin/env python3
+"""End-to-end drive of measured DCN link quality + multislice gang placement
+through the RUNNING binaries (verification companion to hack/e2e_stack.py).
+
+What runs for real:
+  * a strict apiserver (imported from e2e_stack);
+  * FOUR `python -m vtpu.plugin` processes (hosts a0,a1 of slice s1 and b0,b1
+    of slice s2), each with a DCN probe server on loopback — they discover
+    each other through `vtpu.io/node-dcn-endpoint` annotations and publish
+    MEASURED `vtpu.io/node-dcn` scores over real TCP;
+  * two statically seeded nodes c0,c1 (slice s3) whose hand-written scores
+    advertise a SLOW path to the a-hosts — the loopback measurements between
+    real plugins are orders of magnitude faster, so the scheduler's
+    multislice slice choice is observable;
+  * a `python -m vtpu.scheduler` process serving the extender protocol.
+
+Asserted: endpoint + score publication by real probers; a num-slices=2 gang
+whose first two workers are pinned to s1 opens s2 (measured-fast), never s3
+(measured-slow); per-slice ranks and MEGASCALE_* identity stamped.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import shutil
+import signal
+import sys
+import time
+import urllib.request
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from hack.e2e_stack import StrictApiserver  # noqa: E402
+
+
+def post_json(url: str, payload: dict) -> dict:
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+def wait_for(desc: str, fn, timeout: float = 30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = fn()
+        if v:
+            return v
+        time.sleep(0.25)
+    raise AssertionError(f"timed out waiting for {desc}")
+
+
+def main() -> int:
+    import os
+    from concurrent import futures
+
+    import grpc
+
+    from tests.helpers import BinaryUnderTest
+    from vtpu.device import codec
+    from vtpu.device.types import decode_dcn_scores
+    from vtpu.plugin.api import deviceplugin_pb2 as pb
+    from vtpu.plugin.api.grpc_api import add_registration_servicer
+    from vtpu.util import types as t
+
+    work = REPO / "build" / "dcn_drive"
+    if work.exists():
+        shutil.rmtree(work)
+    work.mkdir(parents=True)
+
+    checks: list[str] = []
+
+    def check(desc: str, ok: bool):
+        assert ok, desc
+        checks.append(desc)
+        print(f"ok: {desc}", file=sys.stderr, flush=True)
+
+    api = StrictApiserver()
+    hosts = {"a0": ("s1", 0), "a1": ("s1", 1), "b0": ("s2", 0), "b1": ("s2", 1)}
+    for name in hosts:
+        api.put_node({"metadata": {"name": name, "annotations": {}, "labels": {}}})
+    # slice s3: statically seeded peers with a measured-SLOW path to the
+    # a-hosts (100 Mbps / 5 ms vs loopback's GB/s) — the control group
+    from vtpu.device.types import DeviceInfo, IciCoord, SliceInfo
+
+    def chip(node, i):
+        return DeviceInfo(id=f"{node}-tpu-{i}", count=4, devmem=16384,
+                          devcore=100, type="tpu-v5e", health=True,
+                          ici=IciCoord(i, 0, 0))
+
+    for i, name in enumerate(("c0", "c1")):
+        api.put_node({"metadata": {"name": name, "annotations": {
+            "vtpu.io/node-tpu-register": codec.encode_node_devices(
+                [chip(name, j) for j in range(4)]),
+            t.NODE_HANDSHAKE_PREFIX + "tpu": "Reported_2099-01-01T00:00:00Z",
+            t.NODE_SLICE_ANNO: SliceInfo("s3", i, 2, "v5e-8", "2x4").encode(),
+            t.NODE_DCN_ANNO: f"a0,100,5000:a1,100,5000",
+        }, "labels": {}}})
+
+    # one fake kubelet per plugin (each plugin serves its own socket dir)
+    kubelets = []
+    plugins = []
+    probe_ports = {"a0": 19401, "a1": 19402, "b0": 19403, "b1": 19404}
+    for name, (sid, wid) in hosts.items():
+        kdir = work / f"dp-{name}"
+        kdir.mkdir()
+        ksock = str(kdir / "kubelet.sock")
+
+        class FakeKubelet:
+            def __init__(self, path):
+                self.server = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
+                add_registration_servicer(self.server, self)
+                self.server.add_insecure_port(f"unix://{path}")
+                self.server.start()
+
+            def Register(self, request, context):
+                return pb.Empty()
+
+        kubelets.append(FakeKubelet(ksock))
+        env = dict(os.environ)
+        env.update({
+            "VTPU_MOCK_DEVICES": "4", "VTPU_MOCK_DEVMEM": "16384",
+            "VTPU_MOCK_SLICE": f"{sid}:{wid}:2:v5e-8:2x4",
+        })
+        plugins.append(BinaryUnderTest("vtpu.plugin", [
+            "--node-name", name, "--socket-dir", str(kdir),
+            "--kubelet-socket", ksock, "--hook-path", str(work / f"hook-{name}"),
+            "--kube-api", f"http://127.0.0.1:{api.port}",
+            "--register-interval", "1",
+            "--dcn-probe-port", str(probe_ports[name]),
+            "--dcn-advertise-host", "127.0.0.1",
+            "--dcn-probe-interval", "2", "--dcn-probe-bytes", str(1 << 20),
+        ], env=env))
+
+    sched_port = 19395
+    scheduler = BinaryUnderTest("vtpu.scheduler", [
+        "--port", str(sched_port),
+        "--kube-api", f"http://127.0.0.1:{api.port}",
+        "--register-interval", "1",
+    ])
+
+    try:
+        # ---- real probers discover each other and publish measured scores
+        def endpoints_up():
+            return all(
+                (api.nodes[n]["metadata"].get("annotations") or {}).get(
+                    t.NODE_DCN_ENDPOINT_ANNO) == f"127.0.0.1:{probe_ports[n]}"
+                for n in hosts
+            )
+        wait_for("dcn endpoints advertised by all four plugins", endpoints_up)
+        check("probe endpoints advertised via node annotations", True)
+
+        def scores_up():
+            annos = (api.nodes["a0"]["metadata"].get("annotations") or {})
+            raw = annos.get(t.NODE_DCN_ANNO, "")
+            if not raw:
+                return None
+            scores = decode_dcn_scores(raw)
+            return scores if {"b0", "b1"} <= set(scores) else None
+        scores = wait_for("a0 publishes measured scores for its cross-slice peers",
+                          scores_up, timeout=45)
+        check("a0 measured its cross-slice peers over TCP "
+              f"(e.g. b0: {scores['b0'].bw_mbps} Mbps, {scores['b0'].rtt_us} us)",
+              all(s.bw_mbps > 100 and s.rtt_us > 0 for s in scores.values()))
+        check("slice-mate a1 NOT probed (intra-slice quality is ICI geometry)",
+              "a1" not in scores)
+        # statically seeded c-nodes claim only 100 Mbps toward the a-hosts
+        check("control slice s3 advertises a measured-slow path",
+              decode_dcn_scores(
+                  api.nodes["c0"]["metadata"]["annotations"][t.NODE_DCN_ANNO]
+              )["a0"].bw_mbps == 100)
+
+        # ---- scheduler ingests; multislice gang placement through /filter
+        all_nodes = list(hosts) + ["c0", "c1"]
+
+        def sched_ready():
+            # /inspect is the cache-introspection route: wait until the
+            # scheduler has ingested EVERY node's registration (the plugins
+            # take several seconds to first-register under 5-process CPU
+            # contention; a filter fired earlier sees "no registered
+            # devices").
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{sched_port}/inspect", timeout=5) as r:
+                    return set(all_nodes) <= set(json.loads(r.read()))
+            except Exception:
+                return False
+
+        def _gang_pod(name):
+            return {
+                "metadata": {
+                    "name": name, "namespace": "default", "uid": f"uid-{name}",
+                    "annotations": {
+                        t.SLICE_WORKERS_ANNO: "2", t.NUM_SLICES_ANNO: "2",
+                        "pod-group.scheduling.sigs.k8s.io/name": "msjob",
+                    },
+                },
+                "spec": {"containers": [{"name": "main", "resources": {
+                    "limits": {"google.com/tpu": "4"}}}]},
+            }
+
+        wait_for("scheduler serving + caches warm", sched_ready, timeout=45)
+
+        def place(name, nodes):
+            pod = api.create_pod(_gang_pod(name))
+            r = post_json(f"http://127.0.0.1:{sched_port}/filter",
+                          {"Pod": pod, "NodeNames": nodes})
+            assert r.get("NodeNames"), f"{name}: {r}"
+            return r["NodeNames"][0]
+
+        # pin slice s1 with the first two workers
+        w0 = place("w0", ["a0", "a1"])
+        w1 = place("w1", ["a0", "a1"])
+        check(f"workers w0/w1 pinned slice s1 ({w0}, {w1})",
+              {w0, w1} == {"a0", "a1"})
+        # the gang's second slice must be the measured-fast s2, never s3
+        w2 = place("w2", all_nodes)
+        check(f"w2 opened the measured-fast slice s2 ({w2})", w2 in ("b0", "b1"))
+        w3 = place("w3", all_nodes)
+        check(f"w3 filled s2 on the remaining host ({w3})",
+              w3 in ("b0", "b1") and w3 != w2)
+
+        seats = set()
+        for name in ("w0", "w1", "w2", "w3"):
+            annos = api.pods[("default", name)]["metadata"]["annotations"]
+            seats.add((annos[t.MEGASCALE_SLICE_ID_ANNO], annos[t.GANG_RANK_ANNO]))
+            assert annos[t.MEGASCALE_NUM_SLICES_ANNO] == "2"
+        check("per-slice ranks + megascale slice ids stamped "
+              f"({sorted(seats)})",
+              seats == {("0", "0"), ("0", "1"), ("1", "0"), ("1", "1")})
+
+        # a fifth worker is refused: the gang is complete
+        pod = api.create_pod(_gang_pod("w4"))
+        r = post_json(f"http://127.0.0.1:{sched_port}/filter",
+                      {"Pod": pod, "NodeNames": all_nodes})
+        check("fifth worker refused (gang complete)",
+              not r.get("NodeNames") and any(
+                  "4 live workers" in v for v in r["FailedNodes"].values()))
+
+        # ---- graceful shutdown withdraws the probe endpoint
+        plugins[0].terminate(signal.SIGTERM)
+        wait_for("a0 endpoint withdrawn on SIGTERM", lambda: t.NODE_DCN_ENDPOINT_ANNO
+                 not in (api.nodes["a0"]["metadata"].get("annotations") or {}))
+        check("deregister withdraws the dcn endpoint annotation", True)
+
+        print(json.dumps({"ok": True, "checks": checks}))
+        return 0
+    finally:
+        for b in plugins + [scheduler]:
+            b.cleanup()
+        for k in kubelets:
+            k.server.stop(None)
+        api.server.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

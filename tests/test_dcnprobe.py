@@ -1,0 +1,151 @@
+"""DCN link-quality probe: server/prober protocol, discovery via annotations,
+publication with jitter tolerance (reference analog: measured NVLink/P2P pair
+scores, nvidia/links.go:124-260 -> hami.io/node-nvidia-score)."""
+
+import socket
+import struct
+
+import pytest
+
+from vtpu.device.types import DcnScore, decode_dcn_scores, encode_dcn_scores
+from vtpu.plugin.dcnprobe import ACK, HEADER, MAGIC, DcnProber, DcnProbeServer
+from vtpu.util import types as t
+from vtpu.util.k8sclient import FakeKubeClient, annotations
+
+
+def test_dcn_score_codec_roundtrip():
+    scores = {
+        "node-b": DcnScore(peer="node-b", bw_mbps=8200, rtt_us=950),
+        "node-a": DcnScore(peer="node-a", bw_mbps=41, rtt_us=12000),
+    }
+    raw = encode_dcn_scores([scores[p] for p in sorted(scores)])
+    assert raw == "node-a,41,12000:node-b,8200,950"
+    assert decode_dcn_scores(raw) == scores
+    assert decode_dcn_scores("") == {}
+    with pytest.raises(ValueError):
+        decode_dcn_scores("node-a,notanumber,1")
+    with pytest.raises(ValueError):
+        decode_dcn_scores(",1,2")
+
+
+@pytest.fixture
+def probe_server():
+    server = DcnProbeServer(host="127.0.0.1").start_background()
+    yield server
+    server.stop()
+
+
+def test_probe_server_echo_and_sink(probe_server):
+    with socket.create_connection(("127.0.0.1", probe_server.port), timeout=5) as conn:
+        # zero-length echo (the RTT sample)
+        conn.sendall(HEADER.pack(MAGIC, 0))
+        assert ACK.unpack(conn.recv(ACK.size))[0] == 0
+        # burst sink (the bandwidth sample); connection is reused
+        payload = b"\x00" * 65536
+        conn.sendall(HEADER.pack(MAGIC, len(payload)) + payload)
+        assert ACK.unpack(conn.recv(ACK.size))[0] == len(payload)
+
+
+def test_probe_server_rejects_bad_magic(probe_server):
+    with socket.create_connection(("127.0.0.1", probe_server.port), timeout=5) as conn:
+        conn.sendall(struct.pack(">8sQ", b"BADMAGIC", 0))
+        assert conn.recv(ACK.size) == b""  # server hangs up, no ack
+
+
+def _cluster_with_peer(endpoint: str) -> FakeKubeClient:
+    client = FakeKubeClient()
+    client.put_node({"metadata": {"name": "n1"}})
+    client.put_node(
+        {"metadata": {"name": "n2",
+                      "annotations": {t.NODE_DCN_ENDPOINT_ANNO: endpoint}}}
+    )
+    # a node that never enabled probing is simply not a peer
+    client.put_node({"metadata": {"name": "n3"}})
+    return client
+
+
+def test_prober_measures_and_publishes(probe_server):
+    client = _cluster_with_peer(f"127.0.0.1:{probe_server.port}")
+    prober = DcnProber(client, "n1", samples=3, burst_bytes=1 << 20)
+    assert prober.discover_peers() == {"n2": f"127.0.0.1:{probe_server.port}"}
+    prober.probe_and_publish()
+    scores = decode_dcn_scores(annotations(client.get_node("n1"))[t.NODE_DCN_ANNO])
+    assert set(scores) == {"n2"}
+    assert scores["n2"].bw_mbps >= 1 and scores["n2"].rtt_us >= 1
+
+
+def test_prober_skips_jitter_republish_and_drops_dead_peer(probe_server):
+    client = _cluster_with_peer(f"127.0.0.1:{probe_server.port}")
+    prober = DcnProber(client, "n1", samples=1, burst_bytes=1 << 16)
+    base = {"n2": DcnScore(peer="n2", bw_mbps=1000, rtt_us=100)}
+    assert prober.publish(base) is True
+    # within 25% tolerance: no patch
+    assert prober.publish(
+        {"n2": DcnScore(peer="n2", bw_mbps=1150, rtt_us=90)}
+    ) is False
+    # beyond tolerance: re-published
+    assert prober.publish(
+        {"n2": DcnScore(peer="n2", bw_mbps=5000, rtt_us=90)}
+    ) is True
+    # a peer that stopped answering disappears from the annotation (absence
+    # means unknown, not bad)
+    probe_server.stop()
+    prober.probe_and_publish()
+    assert annotations(client.get_node("n1")).get(t.NODE_DCN_ANNO) is None
+
+
+def test_scheduler_ingests_dcn_annotation():
+    from tests.helpers import fake_cluster, register_tpu_backend, v5e_devices
+    from vtpu.scheduler.scheduler import Scheduler
+
+    register_tpu_backend()
+    client = fake_cluster({"nodeA": v5e_devices(4), "nodeB": v5e_devices(4)})
+    raw = DcnScore(peer="nodeB", bw_mbps=9000, rtt_us=800).encode()
+    client.patch_node_annotations("nodeA", {t.NODE_DCN_ANNO: raw})
+    sched = Scheduler(client)
+    sched.register_from_node_annotations()
+    info = sched.node_manager.get_node("nodeA")
+    assert info.dcn == {"nodeB": DcnScore(peer="nodeB", bw_mbps=9000, rtt_us=800)}
+    # withdrawal clears the held scores
+    client.patch_node_annotations("nodeA", {t.NODE_DCN_ANNO: None})
+    sched.register_from_node_annotations()
+    assert sched.node_manager.get_node("nodeA").dcn == {}
+
+
+def test_prober_skips_slice_mates(probe_server):
+    """Intra-slice quality is deterministic ICI geometry; the prober only
+    measures cross-slice (DCN) peers, keeping fleet probing o(N^2)."""
+    from vtpu.device.types import SliceInfo
+
+    endpoint = f"127.0.0.1:{probe_server.port}"
+    client = FakeKubeClient()
+    client.put_node({"metadata": {"name": "n1", "annotations": {
+        t.NODE_SLICE_ANNO: SliceInfo("s1", 0, 2).encode()}}})
+    client.put_node({"metadata": {"name": "mate", "annotations": {
+        t.NODE_SLICE_ANNO: SliceInfo("s1", 1, 2).encode(),
+        t.NODE_DCN_ENDPOINT_ANNO: endpoint}}})
+    client.put_node({"metadata": {"name": "far", "annotations": {
+        t.NODE_SLICE_ANNO: SliceInfo("s2", 0, 2).encode(),
+        t.NODE_DCN_ENDPOINT_ANNO: endpoint}}})
+    prober = DcnProber(client, "n1", samples=1, burst_bytes=1 << 16)
+    assert prober.discover_peers() == {"far": endpoint}
+
+
+def test_registrar_withdraws_stale_scores_when_probing_disabled(monkeypatch):
+    """A node that stops probing must not leave frozen measurements behind:
+    the register tick clears vtpu.io/node-dcn when no endpoint is
+    advertised (stale-good steers placement worse than unknown)."""
+    from vtpu.plugin.register import Registrar
+    from vtpu.plugin.rm import TpuResourceManager, discover_chips
+
+    monkeypatch.setenv("VTPU_MOCK_DEVICES", "2")
+    client = FakeKubeClient()
+    client.put_node({"metadata": {"name": "n1", "annotations": {
+        t.NODE_DCN_ANNO: "peer,9000,100",
+        t.NODE_DCN_ENDPOINT_ANNO: "127.0.0.1:1"}}})
+    rm = TpuResourceManager(
+        discover_chips(split_count=4, hostname="n1"), split_count=4)
+    Registrar(client, rm, "n1").register_once()  # no dcn_endpoint
+    annos = annotations(client.get_node("n1"))
+    assert t.NODE_DCN_ANNO not in annos
+    assert t.NODE_DCN_ENDPOINT_ANNO not in annos

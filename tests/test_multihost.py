@@ -478,3 +478,119 @@ def test_allocate_injects_worker_envs(monkeypatch):
     assert plugin._worker_envs(pod)["TPU_WORKER_ID"] == "0"
     # non-gang pod: no wiring
     assert plugin._worker_envs(tpu_pod("plain", tpu=1)) == {}
+
+
+# --------------------------------------------------------------- multislice
+
+
+def _ms_worker(name, workers=2, slices=2, annos=None):
+    a = {
+        t.SLICE_WORKERS_ANNO: str(workers),
+        t.NUM_SLICES_ANNO: str(slices),
+        **GANG,
+        **(annos or {}),
+    }
+    return tpu_pod(name, tpu=4, annotations=a)
+
+
+def test_multislice_gang_spans_m_slices_with_per_slice_ranks(cluster):
+    """A num-slices=2 x slice-workers=2 gang fills two distinct slices, each
+    with per-slice ranks 0..1, and every member is stamped a stable
+    megascale slice id at Filter time (Allocate's MEGASCALE_* pass-through
+    reads exactly these annotations)."""
+    client, sched = cluster
+    placed = {}
+    for i in range(4):
+        _, r = _filter(sched, client, _ms_worker(f"w{i}"))
+        assert r["Error"] == "" and len(r["NodeNames"]) == 1, r
+        placed[f"w{i}"] = r["NodeNames"][0]
+    assert set(placed.values()) == {"a0", "a1", "b0", "b1"}
+    slice_of = {"a0": "s1", "a1": "s1", "b0": "s2", "b1": "s2"}
+    by_slice = {}
+    for name, node in placed.items():
+        a = client.get_pod("default", name)["metadata"]["annotations"]
+        assert a[t.MEGASCALE_NUM_SLICES_ANNO] == "2"
+        by_slice.setdefault(slice_of[node], []).append(
+            (int(a[t.MEGASCALE_SLICE_ID_ANNO]), int(a[t.GANG_RANK_ANNO]))
+        )
+    assert set(by_slice) == {"s1", "s2"}
+    for sid, pairs in by_slice.items():
+        # one slice id per slice, ranks 0..N-1 within it
+        assert len({idx for idx, _ in pairs}) == 1
+        assert sorted(r for _, r in pairs) == [0, 1]
+    assert {idx for pairs in by_slice.values() for idx, _ in pairs} == {0, 1}
+    # a fifth worker is refused: the gang is complete
+    _, r5 = _filter(sched, client, _ms_worker("w4"))
+    assert r5["NodeNames"] == []
+    assert any("4 live workers" in v for v in r5["FailedNodes"].values())
+
+
+def test_multislice_prefers_best_measured_dcn_slice():
+    """When the pin set grows, the scheduler opens the candidate slice with
+    the best measured DCN bandwidth toward the already-placed hosts
+    (vtpu.io/node-dcn), not an arbitrary one."""
+    nodes = {n: v5e_devices(4, prefix=n) for n in
+             ("a0", "a1", "b0", "b1", "c0", "c1")}
+    client = fake_cluster(nodes)
+    for node, (sid, wid) in {
+        "a0": ("s1", 0), "a1": ("s1", 1),
+        "b0": ("s2", 0), "b1": ("s2", 1),
+        "c0": ("s3", 0), "c1": ("s3", 1),
+    }.items():
+        client.patch_node_annotations(
+            node, {t.NODE_SLICE_ANNO: _slice_anno(sid, wid, 2)})
+    # measured DCN from slice-1 hosts: fast path to s2, slow path to s3
+    client.patch_node_annotations(
+        "a0", {t.NODE_DCN_ANNO: "b0,9000,500:c0,100,5000"})
+    client.patch_node_annotations(
+        "a1", {t.NODE_DCN_ANNO: "b1,9000,500:c1,100,5000"})
+    sched = Scheduler(client)
+    register_tpu_backend(quota=sched.quota_manager)
+    sched.start(register_interval=3600)
+    try:
+        # pin slice s1 by restricting the first two workers to its hosts
+        for i, node_set in ((0, ("a0", "a1")), (1, ("a0", "a1"))):
+            _, r = _filter(sched, client, _ms_worker(f"w{i}"), nodes=node_set)
+            assert r["NodeNames"], r
+        # third worker opens a NEW slice: must be s2 (bw 9000 over 100)
+        _, r2 = _filter(sched, client, _ms_worker("w2"))
+        assert r2["NodeNames"][0] in ("b0", "b1"), r2
+    finally:
+        sched.stop()
+
+
+def test_multislice_refuses_corrupt_member_without_identity(cluster):
+    """A multislice member missing its rank or slice id annotation is
+    corrupted state (identity is stamped atomically at Filter); placement
+    refuses rather than guessing — there is no legacy-repair path here."""
+    client, sched = cluster
+    stray = client.put_pod(_ms_worker("stray"))
+    sched.pod_manager.add_pod(stray, "a0", {})
+    _, r = _filter(sched, client, _ms_worker("w0"))
+    assert r["NodeNames"] == []
+    assert any("lacks a rank or slice id" in v for v in r["FailedNodes"].values())
+
+
+def test_multislice_scheduler_restart_rederives_pin_set(cluster):
+    """Annotations are the database: a fresh Scheduler instance reconstructs
+    the multislice pin set (slice ids, per-slice ranks) from scheduled pods
+    and keeps placing the gang consistently."""
+    client, sched = cluster
+    for i in range(3):
+        _, r = _filter(sched, client, _ms_worker(f"w{i}"))
+        assert r["NodeNames"], r
+    sched.stop()
+    fresh = Scheduler(client)
+    fresh.start(register_interval=3600)
+    try:
+        pod = client.put_pod(_ms_worker("w3"))
+        r = fresh.filter({"Pod": pod, "NodeNames": list(ALL_NODES)})
+        assert r["NodeNames"], r
+        # all four seats taken, both slices complete with ranks 0..1
+        seats = set()
+        for i in range(4):
+            a = client.get_pod("default", f"w{i}")["metadata"]["annotations"]
+            seats.add((a[t.MEGASCALE_SLICE_ID_ANNO], a[t.GANG_RANK_ANNO]))
+        assert seats == {("0", "0"), ("0", "1"), ("1", "0"), ("1", "1")}
+    finally:
+        fresh.stop()

@@ -24,7 +24,9 @@ class PodInfo:
     ctr_ids: list[str] = field(default_factory=list)
     group: str = ""  # gang-scheduling pod group (multi-host slice placement)
     slice_workers: int = 0  # >1: this pod is a multi-host slice worker
+    num_slices: int = 1  # >1: the gang spans this many slices (multislice)
     gang_rank: int = -1  # scheduler-assigned gang-own worker rank (-1: none)
+    slice_index: int = -1  # scheduler-assigned multislice slice id (-1: none)
     completion_index: int = -1  # job-controller rank label (-1: none)
     # Whether the pod carried the worker-hostnames annotation: decides which
     # rank source Allocate's env wiring actually used (plugin/server.py
@@ -34,6 +36,18 @@ class PodInfo:
     @property
     def key(self) -> str:
         return f"{self.namespace}/{self.name}"
+
+
+def _slice_index(annos: dict) -> int:
+    """Scheduler-stamped multislice slice id (megascale-slice-id anno), or -1.
+    Tolerant parse: a user-supplied non-numeric value must not break ingest."""
+    from vtpu.util import types as t
+
+    try:
+        i = int(annos.get(t.MEGASCALE_SLICE_ID_ANNO, "-1"))
+    except ValueError:
+        return -1
+    return i if i >= 0 else -1
 
 
 class PodManager:
@@ -46,6 +60,8 @@ class PodManager:
         from vtpu.util.helpers import (
             completion_index,
             gang_rank,
+            num_slices,
+            pod_annotations,
             pod_group_name,
             slice_workers,
         )
@@ -69,7 +85,9 @@ class PodManager:
                 ],
                 group=pod_group_name(pod),
                 slice_workers=slice_workers(pod),
+                num_slices=num_slices(pod),
                 gang_rank=gang_rank(pod),
+                slice_index=_slice_index(pod_annotations(pod)),
                 completion_index=completion_index(pod),
                 has_worker_hostnames=bool(
                     (pod["metadata"].get("annotations") or {}).get(

@@ -222,15 +222,63 @@ class SliceInfo:
         )
 
 
+@dataclass(frozen=True)
+class DcnScore:
+    """One measured DCN link-quality sample from this node to a peer host.
+
+    TPU-native analog of the reference's measured NVLink/P2P pair scores
+    (nvidia/links.go:124-260 published as ``hami.io/node-nvidia-score``):
+    intra-slice ICI quality is deterministic torus geometry (topology.py),
+    but inter-slice DCN quality is not — so the node agent measures it and
+    publishes it for multislice gang placement.
+
+    Wire form (one entry of ``vtpu.io/node-dcn``):
+    ``{peer_node},{bw_mbps},{rtt_us}``; entries joined by ``:``.
+    """
+
+    peer: str = ""
+    bw_mbps: int = 0  # measured streaming bandwidth to the peer
+    rtt_us: int = 0  # measured round-trip latency to the peer
+
+    def encode(self) -> str:
+        return f"{self.peer},{self.bw_mbps},{self.rtt_us}"
+
+    @classmethod
+    def decode(cls, s: str) -> "DcnScore":
+        parts = s.split(",")
+        if len(parts) != 3 or not parts[0]:
+            raise ValueError(f"bad dcn score entry {s!r}")
+        return cls(peer=parts[0], bw_mbps=int(parts[1]), rtt_us=int(parts[2]))
+
+
+def encode_dcn_scores(scores: list[DcnScore]) -> str:
+    return ":".join(s.encode() for s in scores)
+
+
+def decode_dcn_scores(raw: str) -> dict[str, DcnScore]:
+    """peer node name -> score; raises ValueError on a malformed entry."""
+    out: dict[str, DcnScore] = {}
+    for part in raw.split(":"):
+        if not part:
+            continue
+        score = DcnScore.decode(part)
+        out[score.peer] = score
+    return out
+
+
 @dataclass
 class NodeInfo:
     """Per-node registered devices, one entry per vendor.
 
     Parity: reference pkg/util NodeInfo + scheduler/nodes.go nodeManager payload.
-    TPU twist: the node may belong to a multi-host slice (see SliceInfo).
+    TPU twist: the node may belong to a multi-host slice (see SliceInfo) and
+    carries measured DCN link quality to peer hosts (see DcnScore).
     """
 
     node_name: str = ""
     # vendor common-word -> list[DeviceInfo]
     devices: dict[str, list[DeviceInfo]] = field(default_factory=dict)
     slice: Optional[SliceInfo] = None
+    # peer node name -> measured DCN quality (frozen entries; the dict is
+    # replaced whole on ingest, so snapshots may share it read-only)
+    dcn: dict[str, DcnScore] = field(default_factory=dict)

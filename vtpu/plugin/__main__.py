@@ -53,6 +53,16 @@ def main() -> None:
                         default=int(os.environ.get("VTPU_CHARGE_FLOOR_MAX_MS", "0")),
                         help="ceiling on the self-calibrated floor "
                              "(0 = libvtpu's built-in 1000 ms)")
+    parser.add_argument("--dcn-probe-port", type=int, default=0,
+                        help="listen port for the DCN link-quality probe server "
+                             "(0 = probing disabled). Peers discover it via the "
+                             "vtpu.io/node-dcn-endpoint annotation.")
+    parser.add_argument("--dcn-advertise-host", default="",
+                        help="hostname/IP peers should probe (default: --node-name, "
+                             "which resolves in-cluster)")
+    parser.add_argument("--dcn-probe-interval", type=float, default=300.0)
+    parser.add_argument("--dcn-probe-bytes", type=int, default=4 << 20,
+                        help="bandwidth burst size per peer probe")
     parser.add_argument("-v", "--verbose", action="count", default=0)
     args = parser.parse_args()
 
@@ -98,7 +108,22 @@ def main() -> None:
             "host is worker %d/%d of slice %s",
             slice_info.worker_id, slice_info.num_workers, slice_info.slice_id,
         )
-    registrar = Registrar(client, rm, args.node_name, mode=args.mode, slice_info=slice_info)
+    dcn_server = dcn_prober = None
+    dcn_endpoint = ""
+    if args.dcn_probe_port:
+        from vtpu.plugin.dcnprobe import DcnProbeServer, DcnProber
+
+        dcn_server = DcnProbeServer(port=args.dcn_probe_port).start_background()
+        dcn_endpoint = f"{args.dcn_advertise_host or args.node_name}:{dcn_server.port}"
+        dcn_prober = DcnProber(
+            client, args.node_name, burst_bytes=args.dcn_probe_bytes
+        )
+        dcn_prober.start_background(args.dcn_probe_interval)
+        logging.info("dcn probe endpoint %s, interval %.0fs",
+                     dcn_endpoint, args.dcn_probe_interval)
+
+    registrar = Registrar(client, rm, args.node_name, mode=args.mode,
+                          slice_info=slice_info, dcn_endpoint=dcn_endpoint)
     registrar.start_background(args.register_interval)
 
     from vtpu.plugin.health import HealthWatcher
@@ -191,7 +216,11 @@ def main() -> None:
                 server.stop()
     finally:
         health.stop()
-        registrar.stop()  # withdraws the handshake + node label
+        if dcn_prober is not None:
+            dcn_prober.stop()
+        if dcn_server is not None:
+            dcn_server.stop()
+        registrar.stop()  # withdraws the handshake + node label + dcn endpoint
         if server is not None:
             server.stop()
 

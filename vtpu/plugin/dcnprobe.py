@@ -1,0 +1,277 @@
+"""Measured DCN link quality between hosts, published via node annotations.
+
+TPU-native analog of the reference's measured link-quality registration
+(nvidia/links.go:124-260 `CalculateGPUScore` + register.go:214-229 publishing
+`hami.io/node-nvidia-score` under ENABLE_TOPOLOGY_SCORE): there the agent
+measures NVLink/P2P pair quality between local GPUs; here intra-slice ICI
+quality is deterministic torus geometry (device/tpu/topology.py), but the
+quality of the *data-center network* between hosts — the fabric multislice
+jobs ride (MEGASCALE_*, parallel/mesh.py 'slice' axis) — is not. So each node
+agent runs a tiny echo endpoint, probes its peers, and publishes
+``vtpu.io/node-dcn`` = measured per-peer bandwidth + RTT. The scheduler's
+multislice gang placement prefers slice pairings with the best measured DCN
+(scheduler.py _constrain_to_gang_slice).
+
+Peer discovery is the same annotation-handshake mechanism every other piece
+of this system uses: a node publishes ``vtpu.io/node-dcn-endpoint`` =
+``host:port`` and probes every OTHER node that has done the same.
+
+Probe protocol (one TCP connection per peer, reused for all samples):
+frame = 8-byte magic ``VTPUDCN1`` + 8-byte big-endian payload length +
+payload; the server drains the payload and replies with the 8-byte count it
+read. A zero-length frame round-trip is the RTT sample; a burst frame (default
+4 MiB) timed end-to-end is the bandwidth sample. Bandwidth uses the frame's
+full wall time minus the measured RTT floor, so a high-latency/high-bandwidth
+path is not misread as slow.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import struct
+import threading
+import time
+
+from vtpu.device.types import DcnScore, encode_dcn_scores
+from vtpu.util import types as t
+from vtpu.util.k8sclient import ApiError, KubeClient
+
+log = logging.getLogger(__name__)
+
+MAGIC = b"VTPUDCN1"
+HEADER = struct.Struct(">8sQ")  # magic + payload length
+ACK = struct.Struct(">Q")
+
+# Refuse absurd frames: the burst is operator-configured, but the server must
+# not let a stray client make it drain gigabytes.
+MAX_PAYLOAD = 64 << 20
+
+# Publish tolerance: skip the annotation patch when every peer's fresh sample
+# is within this relative band of the last published value. DCN measurements
+# jitter; re-patching the apiserver for noise would make every probe interval
+# an apiserver write on every node.
+TOLERANCE = 0.25
+
+
+def _recv_exact(conn: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = conn.recv(min(1 << 16, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("peer closed mid-frame")
+        buf += chunk
+    return bytes(buf)
+
+
+class DcnProbeServer:
+    """Echo/sink endpoint each node exposes for its peers' probes."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 0):
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(16)
+        self.port = self._sock.getsockname()[1]
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start_background(self) -> "DcnProbeServer":
+        th = threading.Thread(target=self._serve, daemon=True, name="dcn-probe-server")
+        th.start()
+        self._thread = th
+        return self
+
+    def _serve(self) -> None:
+        try:
+            self._sock.settimeout(0.5)
+        except OSError:  # stop() closed the socket before we ever ran
+            return
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            threading.Thread(
+                target=self._handle, args=(conn,), daemon=True
+            ).start()
+
+    def _handle(self, conn: socket.socket) -> None:
+        try:
+            conn.settimeout(10.0)
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            while True:
+                magic, length = HEADER.unpack(_recv_exact(conn, HEADER.size))
+                if magic != MAGIC or length > MAX_PAYLOAD:
+                    return
+                remaining = length
+                while remaining:
+                    chunk = conn.recv(min(1 << 16, remaining))
+                    if not chunk:
+                        return
+                    remaining -= len(chunk)
+                conn.sendall(ACK.pack(length))
+        except (ConnectionError, socket.timeout, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        if self._thread:
+            self._thread.join(timeout=2.0)
+
+
+class DcnProber:
+    """Probes peer endpoints and publishes ``vtpu.io/node-dcn``.
+
+    The registrar publishes this node's own endpoint annotation; the prober
+    reads everyone else's. Peers that fail to answer are simply absent from
+    the published scores — absence means "unknown", never "bad", and the
+    scheduler treats it as such.
+    """
+
+    def __init__(
+        self,
+        client: KubeClient,
+        node_name: str,
+        samples: int = 5,
+        burst_bytes: int = 4 << 20,
+        timeout: float = 5.0,
+    ):
+        self.client = client
+        self.node_name = node_name
+        self.samples = max(1, samples)
+        self.burst_bytes = burst_bytes
+        self.timeout = timeout
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._published: dict[str, DcnScore] = {}
+        self._published_raw: str | None = None
+
+    # ----------------------------------------------------------- discovery
+
+    def discover_peers(self) -> dict[str, str]:
+        """Peer endpoints worth probing: every OTHER node advertising one,
+        minus hosts of this node's own slice — intra-slice quality is
+        deterministic ICI torus geometry the scheduler never reads from
+        these scores, so probing slice-mates is pure wasted traffic (at
+        fleet scale the full mesh is O(N^2) x burst bytes per interval)."""
+
+        def slice_id(annos: dict) -> str:
+            return (annos.get(t.NODE_SLICE_ANNO, "") or ",").split(",")[0]
+
+        nodes = {
+            node["metadata"]["name"]: node.get("metadata", {}).get("annotations") or {}
+            for node in self.client.list_nodes()
+        }
+        own_slice = slice_id(nodes.get(self.node_name, {}))
+        peers: dict[str, str] = {}
+        for name, annos in nodes.items():
+            if name == self.node_name:
+                continue
+            if own_slice and slice_id(annos) == own_slice:
+                continue
+            endpoint = annos.get(t.NODE_DCN_ENDPOINT_ANNO, "")
+            if endpoint:
+                peers[name] = endpoint
+        return peers
+
+    # ------------------------------------------------------------- probing
+
+    def probe_endpoint(self, endpoint: str) -> DcnScore:
+        """One peer: RTT = min of `samples` zero-length frame round trips;
+        bandwidth = burst bytes over (burst wall time - RTT floor)."""
+        host, _, port = endpoint.rpartition(":")
+        with socket.create_connection((host, int(port)), timeout=self.timeout) as conn:
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            empty = HEADER.pack(MAGIC, 0)
+            rtts = []
+            for _ in range(self.samples):
+                t0 = time.perf_counter()
+                conn.sendall(empty)
+                _recv_exact(conn, ACK.size)
+                rtts.append(time.perf_counter() - t0)
+            rtt = min(rtts)
+            payload = b"\x00" * self.burst_bytes
+            t0 = time.perf_counter()
+            conn.sendall(HEADER.pack(MAGIC, len(payload)) + payload)
+            _recv_exact(conn, ACK.size)
+            wall = time.perf_counter() - t0
+        transfer = max(wall - rtt, 1e-9)
+        return DcnScore(
+            peer="",
+            bw_mbps=max(1, int(self.burst_bytes * 8 / transfer / 1e6)),
+            rtt_us=max(1, int(rtt * 1e6)),
+        )
+
+    def probe_once(self) -> dict[str, DcnScore]:
+        scores: dict[str, DcnScore] = {}
+        for peer, endpoint in sorted(self.discover_peers().items()):
+            try:
+                sample = self.probe_endpoint(endpoint)
+            except (OSError, ValueError, ConnectionError) as e:
+                log.warning("dcn probe of %s (%s) failed: %s", peer, endpoint, e)
+                continue
+            scores[peer] = DcnScore(
+                peer=peer, bw_mbps=sample.bw_mbps, rtt_us=sample.rtt_us
+            )
+        return scores
+
+    # ---------------------------------------------------------- publishing
+
+    def _within_tolerance(self, fresh: dict[str, DcnScore]) -> bool:
+        if set(fresh) != set(self._published):
+            return False
+        for peer, score in fresh.items():
+            old = self._published[peer]
+            for new_v, old_v in ((score.bw_mbps, old.bw_mbps), (score.rtt_us, old.rtt_us)):
+                if abs(new_v - old_v) > TOLERANCE * max(old_v, 1):
+                    return False
+        return True
+
+    def publish(self, scores: dict[str, DcnScore]) -> bool:
+        """Patch the annotation unless the fresh sample is just jitter around
+        what is already published. Returns whether a patch was written."""
+        if self._published_raw is not None and self._within_tolerance(scores):
+            return False
+        raw = encode_dcn_scores([scores[p] for p in sorted(scores)]) or None
+        if raw == self._published_raw:
+            return False
+        self.client.patch_node_annotations(self.node_name, {t.NODE_DCN_ANNO: raw})
+        self._published = dict(scores)
+        self._published_raw = raw
+        return True
+
+    def probe_and_publish(self) -> None:
+        self.publish(self.probe_once())
+
+    # ----------------------------------------------------------- lifecycle
+
+    def watch_and_probe(self, interval: float = 300.0) -> None:
+        while not self._stop.is_set():
+            try:
+                self.probe_and_publish()
+            except ApiError:
+                log.exception("dcn score publication")
+            self._stop.wait(interval)
+
+    def start_background(self, interval: float = 300.0) -> threading.Thread:
+        th = threading.Thread(
+            target=self.watch_and_probe, args=(interval,), daemon=True,
+            name="dcn-prober",
+        )
+        th.start()
+        self._thread = th
+        return th
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2.0)

@@ -32,6 +32,7 @@ class Registrar:
         node_name: str,
         mode: str = "",
         slice_info=None,
+        dcn_endpoint: str = "",
     ):
         self.client = client
         self.rm = rm
@@ -40,6 +41,10 @@ class Registrar:
         # Multi-host slice membership (rm.discover_slice()); published so the
         # scheduler can gang multi-host workers onto one fabric.
         self.slice_info = slice_info
+        # host:port of this node's DCN probe server (dcnprobe.py); published
+        # so peer nodes can find and measure us. Empty = probing disabled,
+        # annotation withdrawn.
+        self.dcn_endpoint = dcn_endpoint
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -49,7 +54,14 @@ class Registrar:
             REGISTER_ANNO: codec.encode_node_devices(infos),
             HANDSHAKE_ANNO: f"Reported_{timeutil.format_ts()}",
             t.NODE_SLICE_ANNO: self.slice_info.encode() if self.slice_info else None,
+            t.NODE_DCN_ENDPOINT_ANNO: self.dcn_endpoint or None,
         }
+        if not self.dcn_endpoint:
+            # Probing disabled: withdraw any previously measured scores too.
+            # Leaving them would steer multislice placement on measurements
+            # no live prober refreshes — stale-good is worse than unknown
+            # ("absence means unknown, never bad", dcnprobe.py).
+            annos[t.NODE_DCN_ANNO] = None
         self.client.patch_node_annotations(self.node_name, annos)
         # Label TPU nodes so DaemonSets/operators can select them; withdrawn
         # when the inventory empties (reference e2e node-label add/remove,
@@ -93,7 +105,14 @@ class Registrar:
         try:
             self.client.patch_node_annotations(
                 self.node_name,
-                {HANDSHAKE_ANNO: codec.handshake_deleted_value()},
+                {
+                    HANDSHAKE_ANNO: codec.handshake_deleted_value(),
+                    # withdraw the probe endpoint so peers stop probing a
+                    # dead agent (their next discovery pass drops us), and
+                    # the measured scores no live prober will refresh
+                    t.NODE_DCN_ENDPOINT_ANNO: None,
+                    t.NODE_DCN_ANNO: None,
+                },
             )
             self.client.patch_node_labels(self.node_name, {TPU_NODE_LABEL: None})
         except ApiError:

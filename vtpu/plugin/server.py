@@ -449,11 +449,27 @@ class TpuDevicePlugin:
             env[envs.ENV_WORKER_HOSTNAMES] = hostnames
         if sl.topology:
             env[envs.ENV_TOPOLOGY] = sl.topology
+        # Slice identity (scheduler-stamped on multislice gangs, or
+        # user-set) passes through unconditionally; the coordinator address
+        # is user-supplied (a headless-service DNS name the middleware
+        # cannot invent) and the megascale mesh cannot form without it —
+        # warn rather than silently strand a multislice worker.
         coordinator = annos.get(t.MEGASCALE_COORDINATOR_ANNO, "")
+        slices = annos.get(t.MEGASCALE_NUM_SLICES_ANNO, "")
+        if coordinator or slices:
+            env[envs.ENV_MEGASCALE_NUM_SLICES] = slices or "1"
+            env[envs.ENV_MEGASCALE_SLICE_ID] = annos.get(t.MEGASCALE_SLICE_ID_ANNO, "0")
         if coordinator:
             env[envs.ENV_MEGASCALE_COORDINATOR] = coordinator
-            env[envs.ENV_MEGASCALE_NUM_SLICES] = annos.get(t.MEGASCALE_NUM_SLICES_ANNO, "1")
-            env[envs.ENV_MEGASCALE_SLICE_ID] = annos.get(t.MEGASCALE_SLICE_ID_ANNO, "0")
+        elif slices not in ("", "1"):
+            log.warning(
+                "pod %s/%s: multislice gang (%s slices) without %s; "
+                "MEGASCALE_COORDINATOR_ADDRESS is unset and the cross-slice "
+                "mesh cannot form",
+                pod.get("metadata", {}).get("namespace", "default"),
+                pod.get("metadata", {}).get("name", ""),
+                slices, t.MEGASCALE_COORDINATOR_ANNO,
+            )
         return env
 
     # -------------------------------------------------------------- lifecycle

@@ -10,7 +10,7 @@ from __future__ import annotations
 import threading
 from dataclasses import replace
 
-from vtpu.device.types import DeviceInfo, DeviceUsage, NodeInfo, SliceInfo
+from vtpu.device.types import DcnScore, DeviceInfo, DeviceUsage, NodeInfo, SliceInfo
 
 
 class NodeManager:
@@ -30,6 +30,15 @@ class NodeManager:
             info = self._nodes.get(node_name)
             if info is not None:
                 info.slice = slice_info
+
+    def set_node_dcn(self, node_name: str, scores: dict[str, DcnScore]) -> None:
+        """Record the node's measured DCN link quality (vtpu.io/node-dcn).
+        The dict is replaced whole (entries are frozen), so snapshots that
+        shared the previous dict stay consistent."""
+        with self._lock:
+            info = self._nodes.get(node_name)
+            if info is not None:
+                info.dcn = dict(scores)
 
     def rm_node_devices(self, node_name: str, vendor: str | None = None) -> None:
         """Withdraw one vendor (or the whole node) from the cache (reference
@@ -53,6 +62,7 @@ class NodeManager:
                 node_name=info.node_name,
                 devices={v: [d.clone() for d in ds] for v, ds in info.devices.items()},
                 slice=replace(info.slice) if info.slice else None,
+                dcn=dict(info.dcn),
             )
 
     def usage_snapshot(
@@ -82,6 +92,7 @@ class NodeManager:
                     node_name=info.node_name,
                     devices=dict(info.devices),
                     slice=info.slice,
+                    dcn=info.dcn,  # replaced-whole on ingest; shared read-only
                 )
                 for name, info in items
             }

@@ -28,7 +28,7 @@ from vtpu.device.pods import PodManager
 from vtpu.device.quota import QuotaManager
 from vtpu.device.registry import DEVICES_MAP, SUPPORT_DEVICES
 from vtpu.device import codec
-from vtpu.device.types import DeviceUsage, NodeInfo, SliceInfo
+from vtpu.device.types import DeviceUsage, NodeInfo, SliceInfo, decode_dcn_scores
 from vtpu.scheduler import score as score_mod
 from vtpu.scheduler.events import EventRecorder
 from vtpu.scheduler.nodes import NodeManager
@@ -39,6 +39,7 @@ from vtpu.util.helpers import (
     app_containers,
     init_containers,
     is_pod_deleted,
+    num_slices,
     pod_annotations,
     pod_group_name,
     pod_key,
@@ -47,6 +48,48 @@ from vtpu.util.helpers import (
 from vtpu.util.k8sclient import ApiError, KubeClient, annotations
 
 log = logging.getLogger(__name__)
+
+
+class GangAssignment:
+    """Worker identity to stamp once the Filter picks a node.
+
+    Single-slice gangs carry one pre-computed rank (the winner's slice is
+    the pinned one whatever node wins). Multislice gangs cannot know the
+    rank OR the slice id until the winner is known — both depend on which
+    slice the winning node belongs to — so the per-slice maps are resolved
+    against the winner in annotations().
+    """
+
+    def __init__(
+        self,
+        rank: int = -1,
+        slices_wanted: int = 1,
+        rank_by_slice: dict[str, int] | None = None,
+        index_by_slice: dict[str, int] | None = None,
+        next_slice_index: int = -1,
+    ):
+        self.rank = rank
+        self.slices_wanted = slices_wanted
+        self.rank_by_slice = rank_by_slice or {}
+        self.index_by_slice = index_by_slice or {}
+        self.next_slice_index = next_slice_index
+
+    def annotations(self, winner_slice_id: str | None) -> dict[str, str]:
+        if self.slices_wanted == 1:
+            if self.rank < 0:
+                return {}
+            return {t.GANG_RANK_ANNO: str(self.rank)}
+        # a multislice tier only ever contains nodes with slice membership,
+        # so a winner without one cannot happen; guard anyway
+        if winner_slice_id is None:
+            return {}
+        rank = self.rank_by_slice.get(winner_slice_id, 0)
+        index = self.index_by_slice.get(winner_slice_id, self.next_slice_index)
+        return {
+            t.GANG_RANK_ANNO: str(rank),
+            t.MEGASCALE_SLICE_ID_ANNO: str(index),
+            t.MEGASCALE_NUM_SLICES_ANNO: str(self.slices_wanted),
+        }
 
 
 class Scheduler:
@@ -72,6 +115,9 @@ class Scheduler:
         # (node, vendor) -> last register-annotation string ingested; lets a
         # steady-state register pass skip re-decoding unchanged fleets
         self._register_seen: dict[tuple[str, str], str] = {}
+        # last-ingested vtpu.io/node-dcn string per node (skip re-parse of a
+        # byte-identical annotation on every register pass)
+        self._dcn_seen: dict[str, str] = {}
         # Per-pod serialization of decide+patch (see filter()): uid ->
         # [lock, refcount]; an entry removes itself when the last holder
         # leaves, so the map cannot leak and a racing re-filter can never
@@ -162,6 +208,7 @@ class Scheduler:
         # a re-added node with a byte-identical registration must re-ingest
         for key in [k for k in self._register_seen if k[0] == name]:
             self._register_seen.pop(key, None)
+        self._dcn_seen.pop(name, None)
 
     def sync_existing_pods(self) -> None:
         for pod in self.client.list_pods():
@@ -233,6 +280,15 @@ class Scheduler:
                     )
                 except ValueError:
                     log.exception("bad slice annotation on %s", name)
+                dcn_anno = annos.get(t.NODE_DCN_ANNO, "")
+                if self._dcn_seen.get(name) != dcn_anno:
+                    try:
+                        self.node_manager.set_node_dcn(
+                            name, decode_dcn_scores(dcn_anno) if dcn_anno else {}
+                        )
+                        self._dcn_seen[name] = dcn_anno
+                    except ValueError:
+                        log.exception("bad dcn annotation on %s", name)
 
     # ----------------------------------------------------------------- usage
 
@@ -382,7 +438,7 @@ class Scheduler:
         pod: dict,
         node_infos: dict[str, NodeInfo],
         candidates: dict[str, dict[str, list[DeviceUsage]]],
-    ) -> tuple[list[dict[str, dict[str, list[DeviceUsage]]]], dict[str, str], int]:
+    ) -> tuple[list[dict[str, dict[str, list[DeviceUsage]]]], dict[str, str], Optional[GangAssignment]]:
         """Multi-host slice gang placement (TPU-native analog of reference
         nvinternal/imex cross-node channels).
 
@@ -395,19 +451,22 @@ class Scheduler:
 
         Returns candidate tiers in preference order (right-sized slices
         first, larger slices as fallback), per-node exclusion reasons, and
-        the gang-own rank to assign this worker (-1 for non-gang pods): the
-        smallest rank no member holds, so TPU_WORKER_ID stays in 0..N-1 even
-        on the larger-slice fallback tier and a re-filtered worker cannot
-        collide with ranks assigned after its first placement.
+        the GangAssignment to stamp on the winner (None for non-gang pods):
+        the rank is the smallest no member holds, so TPU_WORKER_ID stays in
+        0..N-1 even on the larger-slice fallback tier and a re-filtered
+        worker cannot collide with ranks assigned after its first placement.
+
+        A pod additionally annotated ``vtpu.io/num-slices: M`` (M > 1)
+        dispatches to _constrain_multislice: M slices x N workers over DCN.
         """
         workers = slice_workers(pod)
         if not workers:
-            return [candidates], {}, -1
+            return [candidates], {}, None
         group = pod_group_name(pod)
         if not group:
             return [], {
                 n: f"{t.SLICE_WORKERS_ANNO} requires a pod-group marker" for n in candidates
-            }, -1
+            }, None
         ns = pod["metadata"].get("namespace", "default")
         # only slice-worker members count: a same-gang coordinator pod neither
         # pins the slice nor blacklists its host
@@ -445,7 +504,12 @@ class Scheduler:
                 n: f"gang {group} member on node with unknown slice membership "
                    f"({', '.join(unknown)})"
                 for n in candidates
-            }, -1
+            }, None
+        slices_wanted = num_slices(pod)
+        if slices_wanted > 1:
+            return self._constrain_multislice(
+                ns, group, workers, slices_wanted, members, node_infos, candidates
+            )
         gang_slices = {node_infos[n].slice.slice_id for n in used_hosts}
         if len(gang_slices) > 1:
             # corrupted placement: refusing to widen the split is the only
@@ -454,7 +518,7 @@ class Scheduler:
             return [], {
                 n: f"gang {group} already spans slices {sorted(gang_slices)}"
                 for n in candidates
-            }, -1
+            }, None
         # Members placed by an older scheduler carry no rank annotation, and
         # their containers may ALREADY be running with the physical-slice
         # rank that Allocate's fallback injected — an annotation patch can't
@@ -480,7 +544,7 @@ class Scheduler:
             return [], {
                 n: f"gang {group} members hold duplicate ranks; delete one"
                 for n in candidates
-            }, -1
+            }, None
         for member in unranked:
             # the id the live container actually holds — mirror Allocate's
             # branch logic exactly (plugin/server.py _worker_envs): with the
@@ -506,7 +570,7 @@ class Scheduler:
                     n: f"gang {group} member {member.key} holds an "
                        f"unrepairable worker id {repair}; restart it"
                     for n in candidates
-                }, -1
+                }, None
             try:
                 self.client.patch_pod_annotations(
                     member.namespace, member.name,
@@ -519,7 +583,7 @@ class Scheduler:
                     n: f"gang {group} member {member.key} lacks a rank and "
                        "repair failed"
                     for n in candidates
-                }, -1
+                }, None
             log.info("gang %s/%s: repaired member %s -> physical rank %d",
                      ns, group, member.key, repair)
             member.gang_rank = repair
@@ -535,7 +599,7 @@ class Scheduler:
             return [], {
                 n: f"gang {group} already has {workers} live workers"
                 for n in candidates
-            }, -1
+            }, None
         pinned = next(iter(gang_slices)) if gang_slices else ""
 
         kept: dict[str, dict[str, list[DeviceUsage]]] = {}
@@ -568,8 +632,163 @@ class Scheduler:
             }
             rest = {n: u for n, u in kept.items() if n not in exact}
             if exact and rest:
-                return [exact, rest], failed, rank
-        return [kept], failed, rank
+                return [exact, rest], failed, GangAssignment(rank=rank)
+        return [kept], failed, GangAssignment(rank=rank)
+
+    def _constrain_multislice(
+        self,
+        ns: str,
+        group: str,
+        workers: int,
+        slices_wanted: int,
+        members: list,
+        node_infos: dict[str, NodeInfo],
+        candidates: dict[str, dict[str, list[DeviceUsage]]],
+    ) -> tuple[list[dict[str, dict[str, list[DeviceUsage]]]], dict[str, str], Optional[GangAssignment]]:
+        """Multislice gang placement: M slices x N workers over DCN.
+
+        The gang pins up to M distinct slices; each slice hosts exactly N
+        workers with per-slice ranks 0..N-1 (TPU_WORKER_ID) and a stable
+        megascale slice id 0..M-1 (MEGASCALE_SLICE_ID). When the pin set is
+        not yet full, candidate NEW slices are tiered by measured DCN quality
+        toward the already-pinned slices (vtpu.io/node-dcn, published by the
+        plugin's prober — the reference's measured-link-score concept,
+        nvidia/links.go:124-260, applied to the fabric that actually is
+        non-deterministic on TPU: the data-center network between slices).
+
+        Unlike single-slice gangs there is no legacy-member repair here: a
+        multislice member is always stamped rank + slice id atomically in the
+        Filter's decision patch, so a member missing either is corrupted
+        state (crash mid-stamp) and placement refuses until it is deleted.
+        """
+        if len(members) >= slices_wanted * workers:
+            return [], {
+                n: f"gang {group} already has {slices_wanted * workers} live workers"
+                for n in candidates
+            }, None
+
+        def refuse(reason: str):
+            log.warning("gang %s/%s: %s; refusing placement", ns, group, reason)
+            return [], {n: f"gang {group}: {reason}" for n in candidates}, None
+
+        # Reconstruct the pin set from members (annotations are the
+        # database): slice_id -> megascale index, and per-slice used ranks.
+        index_by_slice: dict[str, int] = {}
+        ranks_by_slice: dict[str, set[int]] = {}
+        for p in members:
+            sl = node_infos[p.node_id].slice  # caller guards membership
+            if p.gang_rank < 0 or p.slice_index < 0:
+                return refuse(
+                    f"member {p.key} lacks a rank or slice id (crash mid-stamp?); "
+                    "delete it"
+                )
+            held = index_by_slice.get(sl.slice_id)
+            if held is not None and held != p.slice_index:
+                return refuse(
+                    f"slice {sl.slice_id} holds conflicting slice ids "
+                    f"{held} and {p.slice_index}"
+                )
+            index_by_slice[sl.slice_id] = p.slice_index
+            taken = ranks_by_slice.setdefault(sl.slice_id, set())
+            if p.gang_rank in taken or p.gang_rank >= workers:
+                return refuse(
+                    f"member {p.key} holds duplicate or out-of-range rank "
+                    f"{p.gang_rank} in slice {sl.slice_id}"
+                )
+            taken.add(p.gang_rank)
+        if len(index_by_slice) > slices_wanted:
+            return refuse(f"gang already spans {len(index_by_slice)} slices, wants {slices_wanted}")
+        indexes = list(index_by_slice.values())
+        if len(set(indexes)) != len(indexes) or any(
+            i >= slices_wanted for i in indexes
+        ):
+            return refuse(f"gang holds conflicting slice ids {sorted(indexes)}")
+        next_index = next(
+            i for i in range(slices_wanted + 1) if i not in set(indexes)
+        )
+
+        used_hosts = {p.node_id for p in members}
+        pin_full = len(index_by_slice) >= slices_wanted
+        kept_pinned: dict[str, dict[str, list[DeviceUsage]]] = {}
+        new_slices: dict[str, dict[str, dict[str, list[DeviceUsage]]]] = {}
+        failed: dict[str, str] = {}
+        for name, usage in candidates.items():
+            sl = node_infos[name].slice if name in node_infos else None
+            if sl is None:
+                failed[name] = "node is not part of a multi-host slice"
+            elif sl.num_workers < workers:
+                failed[name] = (
+                    f"slice {sl.slice_id} has {sl.num_workers} hosts, "
+                    f"gang needs {workers} per slice"
+                )
+            elif name in used_hosts:
+                failed[name] = f"host already runs a worker of gang {group}"
+            elif sl.slice_id in index_by_slice:
+                if len(ranks_by_slice.get(sl.slice_id, ())) >= workers:
+                    failed[name] = (
+                        f"slice {sl.slice_id} already has its {workers} workers"
+                    )
+                else:
+                    kept_pinned[name] = usage
+            elif pin_full:
+                failed[name] = (
+                    f"gang {group} is pinned to slices {sorted(index_by_slice)}"
+                )
+            else:
+                new_slices.setdefault(sl.slice_id, {})[name] = usage
+
+        # Tier order: finish filling pinned slices first, then open a new
+        # slice — right-sized slices before larger ones (the kunlun bubble
+        # preference, as in the single-slice path), best measured DCN toward
+        # the pinned hosts within each size class. One tier per new slice so
+        # the filter only falls past a better-DCN slice when none of its
+        # hosts fit.
+        member_hosts = sorted(used_hosts)
+
+        def slice_order(item: tuple[str, dict]) -> tuple:
+            slice_id, hosts = item
+            exact = any(
+                node_infos[n].slice.num_workers == workers for n in hosts
+            )
+            return (not exact, -self._dcn_slice_score(hosts, member_hosts, node_infos), slice_id)
+
+        tiers = [kept_pinned] if kept_pinned else []
+        tiers.extend(
+            hosts for _, hosts in sorted(new_slices.items(), key=slice_order)
+        )
+        rank_by_slice = {
+            sid: next(r for r in range(workers) if r not in taken)
+            for sid, taken in ranks_by_slice.items()
+            if len(taken) < workers
+        }
+        return tiers, failed, GangAssignment(
+            slices_wanted=slices_wanted,
+            rank_by_slice=rank_by_slice,
+            index_by_slice=index_by_slice,
+            next_slice_index=next_index,
+        )
+
+    def _dcn_slice_score(
+        self,
+        slice_hosts: dict[str, dict] | list[str],
+        member_hosts: list[str],
+        node_infos: dict[str, NodeInfo],
+    ) -> float:
+        """Mean measured DCN bandwidth (Mbps) between a candidate slice's
+        hosts and the gang's already-placed hosts, using whichever direction
+        either side published. No measurements -> 0.0 (unknown ranks below
+        any measured-good slice but ties with other unknowns, so clusters
+        without probing keep plain size/name ordering)."""
+        samples: list[float] = []
+        for a in slice_hosts:
+            a_info = node_infos.get(a)
+            for b in member_hosts:
+                b_info = node_infos.get(b)
+                if a_info and b in a_info.dcn:
+                    samples.append(float(a_info.dcn[b].bw_mbps))
+                if b_info and a in b_info.dcn:
+                    samples.append(float(b_info.dcn[a].bw_mbps))
+        return sum(samples) / len(samples) if samples else 0.0
 
     def _filter_locked(
         self, args: dict, pod: dict, requests
@@ -595,7 +814,7 @@ class Scheduler:
         failed: dict[str, str] = {
             n: "no registered devices" for n in node_names if n not in candidates
         }
-        tiers, slice_failed, gang_rank = self._constrain_to_gang_slice(
+        tiers, slice_failed, gang = self._constrain_to_gang_slice(
             pod, node_infos, candidates
         )
         failed.update(slice_failed)
@@ -627,13 +846,19 @@ class Scheduler:
             t.ASSIGNED_TIME: str(int(time.time())),
             t.BIND_PHASE: t.BIND_PHASE_ALLOCATING,
         }
-        if gang_rank >= 0:
-            # Gang-own worker rank for Allocate's TPU_WORKER_ID (annotations
-            # are the database: PodManager re-reads it after a restart).
-            patch[t.GANG_RANK_ANNO] = str(gang_rank)
-            pod.setdefault("metadata", {}).setdefault("annotations", {})[
-                t.GANG_RANK_ANNO
-            ] = str(gang_rank)
+        if gang is not None:
+            # Gang-own worker identity for Allocate's TPU_WORKER_ID (and, on
+            # multislice gangs, MEGASCALE_SLICE_ID/NUM_SLICES) — resolved
+            # against the winner's slice. Annotations are the database:
+            # PodManager re-reads them after a restart.
+            winner_slice = node_infos[winner.node_name].slice
+            for anno, value in gang.annotations(
+                winner_slice.slice_id if winner_slice else None
+            ).items():
+                patch[anno] = value
+                pod.setdefault("metadata", {}).setdefault("annotations", {})[
+                    anno
+                ] = value
         for backend in DEVICES_MAP.values():
             backend.patch_annotations(pod, patch, winner.devices)
         # A Filter retry for a still-unbound pod must supersede, not stack on,

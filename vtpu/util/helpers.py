@@ -51,6 +51,17 @@ def slice_workers(pod: dict) -> int:
     return n if n > 1 else 0
 
 
+def num_slices(pod: dict) -> int:
+    """Slice count of a multislice job (vtpu.io/num-slices), default 1. Only
+    meaningful on pods that are also slice-workers members; total gang size
+    is num_slices * slice_workers."""
+    try:
+        n = int(pod_annotations(pod).get(t.NUM_SLICES_ANNO, "1"))
+    except ValueError:
+        return 1
+    return n if n > 1 else 1
+
+
 def gang_rank(pod: dict) -> int:
     """Scheduler-assigned gang-own worker rank (vtpu.io/gang-rank), or -1.
     Assigned at Filter from the gang's own membership so TPU_WORKER_ID stays

@@ -59,9 +59,22 @@ QOS_CORE_POLICY = {  # -> VTPU_CORE_UTILIZATION_POLICY for libvtpu
 # Node side: which physical slice this host belongs to (published by the
 # device plugin; see SliceInfo in device/types.py for the wire form).
 NODE_SLICE_ANNO = "vtpu.io/node-slice"
+# Node side: measured DCN link quality to peer hosts (published by the device
+# plugin's DCN prober; see DcnScore in device/types.py for the wire form).
+# TPU-native analog of the reference's measured NVLink/P2P pair scores
+# (nvidia/links.go:124-260 -> hami.io/node-nvidia-score).
+NODE_DCN_ANNO = "vtpu.io/node-dcn"
+# Node side: host:port of the node's DCN probe echo endpoint; peers discover
+# each other through this annotation.
+NODE_DCN_ENDPOINT_ANNO = "vtpu.io/node-dcn-endpoint"
 # Pod side: "this pod is one of N workers of a multi-host job". All members of
 # the pod's gang (POD_GROUP_*) are placed on distinct hosts of ONE slice.
 SLICE_WORKERS_ANNO = "vtpu.io/slice-workers"
+# Pod side: the gang spans M slices (multislice over DCN), slice-workers N
+# hosts on EACH. The scheduler pins the gang to M distinct slices — chosen by
+# measured DCN quality where published — and stamps each member's
+# megascale-slice-id; gang-rank stays the rank WITHIN the member's slice.
+NUM_SLICES_ANNO = "vtpu.io/num-slices"
 # Optional pod-side overrides consumed at Allocate time:
 WORKER_HOSTNAMES_ANNO = "vtpu.io/worker-hostnames"  # -> TPU_WORKER_HOSTNAMES
 MEGASCALE_COORDINATOR_ANNO = "vtpu.io/megascale-coordinator"  # -> MEGASCALE_COORDINATOR_ADDRESS
