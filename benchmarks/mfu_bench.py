@@ -176,6 +176,64 @@ def bench_decode(cfg: ModelConfig, b: int, prompt_len: int, steps: int,
     }
 
 
+def bench_spec_tick(cfg: ModelConfig, b: int, prompt_len: int, k: int,
+                    steps: int, kv_bucket: int = 0, unroll: bool = True) -> dict:
+    """Cost of a speculative verify tick vs a plain decode tick.
+
+    The economics of speculation on TPU: decode streams the weights + KV
+    window per tick regardless of how many positions ride along, so a
+    (k+1)-position verify tick should cost barely more than a 1-token tick —
+    the measured ratio IS the breakeven mean-emitted-tokens, and projected
+    speedup at mean emitted E is E / ratio. Draft content is irrelevant to
+    timing (shapes are static); acceptance only changes how often you tick.
+    """
+    from vtpu.serving.engine import batched_spec_step
+
+    assert prompt_len + steps + k + 1 <= (kv_bucket or cfg.max_seq)
+    params = jax.jit(lambda key: init_params(key, cfg))(jax.random.key(0))
+    jax.block_until_ready(params)
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(0, cfg.vocab, (b, prompt_len)), jnp.int32)
+    _, cache = jax.jit(lambda p, t: prefill(p, cfg, t))(params, tokens)
+    jax.block_until_ready(cache)
+    draft = jnp.asarray(
+        np.random.RandomState(1).randint(0, cfg.vocab, (b, k + 1)), jnp.int32)
+    active = jnp.ones((b,), bool)
+    cap = jnp.full((b,), k + 1, jnp.int32)
+
+    @jax.jit
+    def chained(params, cache, draft):
+        def body(carry, _):
+            cache, draft = carry
+            pred, _, cache = batched_spec_step(
+                params, cfg, cache, draft, active, cap,
+                kv_bucket=kv_bucket, unroll=unroll)
+            return (cache, pred), None
+
+        (cache, _), _ = jax.lax.scan(body, (cache, draft), None, length=steps)
+        return cache["len"]
+
+    sec = timed(chained, params, cache, draft)
+    spec_ms = sec / steps * 1e3
+    plain = bench_decode(cfg, b, prompt_len, steps, kv_bucket=kv_bucket,
+                         unroll=unroll)
+    ratio = spec_ms / plain["ms_per_step"]
+    return {
+        "batch": b, "prompt_len": prompt_len, "spec_tokens": k,
+        "kv_bucket": kv_bucket or cfg.max_seq,
+        "ms_per_verify_tick": round(spec_ms, 3),
+        "ms_per_decode_tick": plain["ms_per_step"],
+        "verify_cost_ratio": round(ratio, 3),
+        # mean emitted tokens per tick at which speculation breaks even;
+        # anything above it is speedup (e.g. emitted 3.0 at ratio 1.3 ->
+        # 2.3x tokens/sec)
+        "breakeven_mean_emitted": round(ratio, 3),
+        "projected_speedup_at_mean_emitted": {
+            str(e): round(e / ratio, 2) for e in (2, 3, k + 1)
+        },
+    }
+
+
 def bench_ssm_decode(b: int, steps: int, on_tpu: bool) -> dict:
     """Selective-SSM decode throughput: O(1) recurrent state, so tokens/sec
     is independent of how long each sequence has run — the contrast point to
@@ -314,6 +372,16 @@ def main() -> None:
             "(62% at batch 32 / kv 2048 bf16)."
         )
         print("decode_fori_exhibit", r, flush=True)
+    # speculative verify-tick cost (r4+): the ratio to a plain decode tick
+    # is the breakeven mean-emitted-tokens for speculation
+    out["spec"] = []
+    spec_shapes = ([(8, 128, 4, 64, 256), (32, 128, 4, 64, 256),
+                    (8, 1024, 4, 64, 2048)] if on_tpu
+                   else [(2, 32, 4, 4, 0)])
+    for b, p, k, steps, bkt in spec_shapes:
+        r = bench_spec_tick(cfg, b, p, k, steps, kv_bucket=bkt)
+        out["spec"].append(r)
+        print("spec", r, flush=True)
     out["ssm_decode"] = []
     for b, steps in ([(8, 64), (32, 64)] if on_tpu else [(2, 4)]):
         r = bench_ssm_decode(b, steps, on_tpu)

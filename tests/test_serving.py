@@ -382,3 +382,135 @@ def test_mesh_engine_with_int8_kv_cache():
         assert len(toks) == 4
     finally:
         eng.stop()
+
+
+# ------------------------------------------------------------- speculative
+
+
+def _spec_cfg(**kw):
+    base = dict(slots=2, prefill_buckets=(16, 32), max_new_tokens=16,
+                spec_tokens=4)
+    base.update(kw)
+    return ServingConfig(**base)
+
+
+def test_spec_decode_stream_identical_to_plain(params):
+    """The speculative engine must emit EXACTLY the plain engine's greedy
+    stream — drafts only change how many ticks it takes, never a token.
+
+    The invariant is engine-vs-engine deliberately: on this random tiny
+    model, different executables (engine vs lockstep greedy_generate, padded
+    vs unpadded prefill) flip argmax at repetition attractors and near-tie
+    first tokens — both valid greedy streams, a numerics fact that predates
+    speculation. The engine-vs-reference anchor lives in
+    test_single_request_matches_reference at its stable seed/horizon; what
+    speculation must guarantee is that it never changes ITS engine's
+    stream."""
+    for seed, n in ((1, 10), (2, 7), (3, 12)):
+        prompt = _prompt(seed, n)
+        plain = _solo(params, _spec_cfg(spec_tokens=0), prompt, 16)
+        spec = _solo(params, _spec_cfg(), prompt, 16)
+        assert spec == plain
+
+
+def test_spec_decode_repetitive_prompt_fewer_ticks(params):
+    """A repetitive stream is where prompt-lookup pays: the engine emits the
+    same tokens in FEWER verify/decode dispatches than plain decode would
+    take (the accepted-drafts win), and still matches greedy exactly."""
+    # a prompt whose greedy continuation settles into repetition (random
+    # tiny models do this readily; the reference oracle keeps us honest)
+    prompt = [5, 6, 7, 8, 5, 6, 7, 8, 5, 6]
+    steps = 24
+    eng = ServingEngine(params, CFG, _spec_cfg(max_new_tokens=steps))
+    calls = {"spec": 0, "decode": 0}
+    spec_fn, decode_fn = eng._spec, eng._decode
+
+    def counting_spec(*a, **kw):
+        calls["spec"] += 1
+        return spec_fn(*a, **kw)
+
+    def counting_decode(*a, **kw):
+        calls["decode"] += 1
+        return decode_fn(*a, **kw)
+
+    eng._spec, eng._decode = counting_spec, counting_decode
+    eng.start()
+    try:
+        got = list(eng.submit(prompt, max_new_tokens=steps).stream())
+    finally:
+        eng.stop()
+    assert got == _reference(params, prompt, steps)
+    # warm-up compiles per bucket don't count: subtract them
+    warm = len(eng._kv_buckets)
+    ticks = calls["spec"] + calls["decode"] - 2 * warm
+    # plain decode would take steps-1 ticks (first token comes from prefill)
+    assert ticks < steps - 1, (calls, warm)
+
+
+def test_spec_decode_staggered_slots_isolated(params):
+    """Speculation over a staggered pool (different lengths, ragged
+    acceptance) must not leak between slots. Oracle: each prompt SOLO
+    through a fresh engine with identical slot geometry — engine-vs-engine,
+    full streams, so a dropped or shifted token can never slip through an
+    accidental realignment (the lockstep reference disagrees with the
+    engine on the padded-prefill first token at some seeds)."""
+    serving = _spec_cfg(max_new_tokens=12)
+    eng = ServingEngine(params, CFG, serving)
+    eng.start()
+    try:
+        p1, p2 = _prompt(4, 9), [5, 6, 7, 8, 5, 6, 7, 8]
+        r1 = eng.submit(p1, max_new_tokens=12)
+        it1 = iter(r1.stream())
+        first1 = next(it1)  # slot 0 mid-flight before slot 1 joins
+        r2 = eng.submit(p2, max_new_tokens=12)
+        got2 = list(r2.stream())
+        got1 = [first1] + [t for t in it1 if t is not None]
+    finally:
+        eng.stop()
+    assert got1 == _solo(params, serving, p1, 12)
+    assert got2 == _solo(params, serving, p2, 12)
+
+
+def test_spec_decode_with_int8_kv(params):
+    """Speculation composes with the int8 KV cache: the quantized verify
+    path must emit the same stream as the quantized plain path."""
+    import dataclasses
+
+    qcfg = dataclasses.replace(CFG, kv_int8=True)
+    qparams = init_params(jax.random.key(0), qcfg)
+    prompt = [5, 6, 7, 8, 5, 6, 7, 8, 5, 6]
+
+    def run(spec):
+        eng = ServingEngine(qparams, qcfg, _spec_cfg(
+            spec_tokens=spec, max_new_tokens=16))
+        eng.start()
+        try:
+            return list(eng.submit(prompt, max_new_tokens=16).stream())
+        finally:
+            eng.stop()
+
+    assert run(4) == run(0)
+
+
+def test_spec_disabled_for_custom_sampler(params):
+    """A non-greedy sampler makes argmax verification unsound; the engine
+    must fall back to plain decode rather than emit a diverged stream."""
+    eng = ServingEngine(params, CFG, _spec_cfg(),
+                        sample=lambda logits: int(jnp.argmax(logits)))
+    assert eng._spec_tokens == 0 and eng._spec is None
+    eng2 = ServingEngine(params, CFG, _spec_cfg())
+    assert eng2._spec_tokens == 4 and eng2._spec is not None
+
+
+def test_lookup_draft_prefers_longest_recent_match():
+    from vtpu.serving.engine import lookup_draft
+
+    #          0  1  2  3  4  5  6  7
+    history = [1, 2, 3, 9, 1, 2, 3, 4, 1, 2, 3]
+    # trigram [1,2,3] matched at its most recent earlier occurrence (idx 4)
+    assert lookup_draft(history, 3, 3) == [4, 1, 2]
+    # continuation shorter than k: zero-padded
+    assert lookup_draft([7, 8, 7, 8, 7], 4, 2)[:1] == [8]
+    # no match at any n-gram size
+    assert lookup_draft([1, 2, 3], 4, 3) is None
+    assert lookup_draft([], 4, 3) is None

@@ -248,19 +248,72 @@ def decode_layer_loop(
     bounded window inline, so the attention reads stream half the bytes.
     ``ffn_fn(lp, x)`` swaps the post-attention block (dense MLP here; routed
     experts for the MoE family — both share this attention trunk).
-    ``unroll`` trades compile time for a STATIC layer index: inside fori_loop
-    the bounded read is dynamic_index_in_dim(ks, l)[:, :bucket] with a
-    loop-carried l, which XLA materializes as a slice copy before attention;
-    unrolled, ks[l][:, :bucket] is a static view that fuses into the
-    attention reads. Returns (logits, new kv dict)."""
-    b = token.shape[0]
+    ``unroll`` trades compile time for a STATIC layer index (see
+    spec_verify_loop, which owns the single implementation — one decode
+    token is a T=1 verify chunk, so plain-decode and speculative-verify
+    numerics can never drift apart). Returns (logits [B, vocab], new kv)."""
+    logits, new_kv = spec_verify_loop(
+        params, cfg, cache, token[:, None], kv_bucket, write_kv,
+        ffn_fn=ffn_fn, unroll=unroll,
+    )
+    return logits[:, 0], new_kv
+
+
+def spec_verify_loop(
+    params: Params,
+    cfg: ModelConfig,
+    cache: dict[str, jax.Array],
+    draft: jax.Array,
+    kv_bucket: int,
+    write_kv,
+    ffn_fn=None,
+    unroll: bool = False,
+) -> tuple[jax.Array, dict[str, jax.Array]]:
+    """Verify pass for speculative decoding: one forward over a [B, T] draft
+    chunk whose row-i query sits at cache position len[b] + i.
+
+    The economics: decode is HBM-bandwidth-bound, and the KV window is read
+    ONCE here for T candidate positions instead of once per token — so a
+    verify tick costs roughly one decode tick in bytes, and every accepted
+    draft token is a decode tick never paid. The chunk's own KV is scattered
+    first (caller's ``write_kv(l, kv, k, v) -> kv`` handles per-slot offsets
+    and bounds), then attention reads the bounded window under the RAGGED
+    mask (ops/attention.py kv_len=[B,T]): query i sees k_pos < len + i + 1,
+    which is exactly intra-chunk causality because row i IS cache position
+    len + i. Rejected positions hold garbage KV above the advanced length;
+    the next chunk write (T entries from the new length, which advanced by
+    at least 1) overwrites every stale entry before any query can attend to
+    it. Returns (logits [B, T, vocab], new kv dict).
+
+    No reference counterpart (HAMi has no model runtime); the TPU-shaped
+    twist on standard speculative verification is static chunk shapes +
+    scatter-at-offset + ragged masking, so one compiled executable serves
+    every acceptance pattern.
+
+    This is THE decode trunk: decode_layer_loop delegates here with T=1, so
+    a fix to the attention/write/view logic lands in both paths at once.
+    ``unroll`` trades compile time for a STATIC layer index: inside
+    fori_loop the bounded read dynamic_index_in_dim(ks, l)[:, :bucket] has
+    a loop-carried l, which XLA materializes as a slice copy before
+    attention; unrolled, ks[l][:, :bucket] is a static view that fuses into
+    the attention reads (the r2 decode-inversion exhibit in mfu_bench).
+    """
+    b, t = draft.shape
     bucket = kv_bucket or cfg.max_seq
     quant = "k_scale" in cache
     ffn = ffn_fn or _mlp_block
     cos, sin = rope_angles(cfg.max_seq, cfg.head_dim)
-    positions = cache["len"][:, None]  # [B, 1]
-    x = params["embed"][token[:, None]].astype(cfg.dtype)
-    kv_len = cache["len"] + 1
+    lens = cache["len"]
+    # clip: a slot near the context wall still computes (static shapes) but
+    # its out-of-range rows are never written (write_kv masks) nor emitted
+    # (the engine caps acceptance); clipping only keeps the rope gather legal
+    positions = jnp.minimum(
+        lens[:, None] + jnp.arange(t)[None, :], cfg.max_seq - 1
+    )
+    ragged_len = jnp.minimum(
+        lens[:, None] + 1 + jnp.arange(t)[None, :], cfg.max_seq
+    )
+    x = params["embed"][draft].astype(cfg.dtype)
     kv_keys = ("k", "v", "k_scale", "v_scale") if quant else ("k", "v")
 
     def layer(l, carry, lp=None):
@@ -278,16 +331,12 @@ def decode_layer_loop(
                 for key in kv_keys
             }
         if quant:
-            # post-scale formulation: int8 values feed the MXU directly and
-            # the scales ride the score tensor (causal_attention_int8kv) —
-            # dequantize-then-attend materialized the bf16 window and LOST
-            # to the unquantized path on r4 hardware
             attn = causal_attention_int8kv(
                 q, view["k"], view["k_scale"], view["v"], view["v_scale"],
-                kv_len=kv_len)
+                kv_len=ragged_len)
         else:
-            attn = causal_attention(q, view["k"], view["v"], kv_len=kv_len)
-        x = x + attn.reshape(b, 1, cfg.qkv_dim) @ lp["wo"]
+            attn = causal_attention(q, view["k"], view["v"], kv_len=ragged_len)
+        x = x + attn.reshape(b, t, cfg.qkv_dim) @ lp["wo"]
         x = x + ffn(lp, x)
         return x, kv
 
@@ -301,7 +350,7 @@ def decode_layer_loop(
     else:
         x, new_kv = jax.lax.fori_loop(0, cfg.n_layers, layer, (x, kv0))
     x = rms_norm(x, params["final_norm"])
-    logits = (x[:, 0] @ params["embed"].T).astype(jnp.float32)
+    logits = (x @ params["embed"].T).astype(jnp.float32)
     return logits, new_kv
 
 

@@ -21,6 +21,23 @@ from jax.experimental import pallas as pl
 _NEG_INF = -1e30
 
 
+def _causal_mask(sq: int, sk: int, kv_len: jax.Array | None) -> jax.Array:
+    """Broadcastable [*, *, Sq, Sk] attention mask shared by the bf16 and
+    int8 paths. kv_len None: plain causal (prefill). [B]: causal suffix +
+    per-row validity (lockstep decode). [B, Sq]: ragged per-query validity
+    (speculative verify) — the ONLY mask, since the chunk's scatter offsets
+    make k_pos < kv_len[b, q] exactly intra-chunk causality."""
+    k_pos = jnp.arange(sk)[None, :]
+    if kv_len is not None and kv_len.ndim == 2:
+        return (jnp.arange(sk)[None, None, :] < kv_len[:, :, None])[:, None, :, :]
+    q_pos = jnp.arange(sq)[:, None] + (sk - sq)
+    mask = k_pos <= q_pos  # [Sq, Sk] causal
+    if kv_len is not None:
+        valid = k_pos < kv_len[:, None]  # [B, Sk]
+        return (mask[None, :, :] & valid[:, None, :])[:, None, :, :]
+    return mask[None, None, :, :]
+
+
 def causal_attention(
     q: jax.Array,
     k: jax.Array,
@@ -31,22 +48,19 @@ def causal_attention(
 
     q: [B, Sq, H, Dh]; k, v: [B, Sk, H, Dh] with Sk >= Sq (decode passes the
     full static cache and masks with kv_len, keeping shapes static under jit).
-    kv_len: optional [B] int32 count of valid cache entries (decode path).
+    kv_len: optional valid-entry count per cache row. [B] int32 places the
+    queries at the cache SUFFIX (lockstep decode). [B, Sq] int32 is the
+    ragged form (speculative verify): query i of row b may read k_pos <
+    kv_len[b, i], which alone encodes intra-chunk causality when the chunk
+    was scattered at per-row offsets (kv_len[b, i] = len[b] + i + 1) — no
+    suffix-position mask applies because the chunk does not sit at the
+    window's end.
     """
     b, sq, h, dh = q.shape
     sk = k.shape[1]
     scale = 1.0 / math.sqrt(dh)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
-    q_pos = jnp.arange(sq)[:, None] + (sk - sq)
-    k_pos = jnp.arange(sk)[None, :]
-    mask = k_pos <= q_pos  # [Sq, Sk] causal
-    if kv_len is not None:
-        valid = k_pos < kv_len[:, None]  # [B, Sk]
-        mask = mask[None, :, :] & valid[:, None, :]
-        mask = mask[:, None, :, :]  # [B, 1, Sq, Sk]
-    else:
-        mask = mask[None, None, :, :]
-    scores = jnp.where(mask, scores, _NEG_INF)
+    scores = jnp.where(_causal_mask(sq, sk, kv_len), scores, _NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
     return out.astype(q.dtype)
@@ -72,7 +86,8 @@ def causal_attention_int8kv(
     bytes the quantization was supposed to save.
 
     q: [B,Sq,H,Dh]; kq, vq: [B,Sk,H,Dh] int8; k_scale, v_scale: [B,Sk,H]
-    f32 (absmax/127 per token per head); kv_len as in causal_attention.
+    f32 (absmax/127 per token per head); kv_len as in causal_attention
+    (including the ragged [B, Sq] form for speculative verify).
     """
     b, sq, h, dh = q.shape
     sk = kq.shape[1]
@@ -81,15 +96,7 @@ def causal_attention_int8kv(
         "bqhd,bkhd->bhqk", q, kq.astype(q.dtype),
         preferred_element_type=jnp.float32) * scale
     scores = scores * k_scale.transpose(0, 2, 1)[:, :, None, :]  # [B,H,1,Sk]
-    q_pos = jnp.arange(sq)[:, None] + (sk - sq)
-    k_pos = jnp.arange(sk)[None, :]
-    mask = k_pos <= q_pos
-    if kv_len is not None:
-        valid = k_pos < kv_len[:, None]
-        mask = (mask[None, :, :] & valid[:, None, :])[:, None, :, :]
-    else:
-        mask = mask[None, None, :, :]
-    scores = jnp.where(mask, scores, _NEG_INF)
+    scores = jnp.where(_causal_mask(sq, sk, kv_len), scores, _NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
     probs = probs * v_scale.transpose(0, 2, 1)[:, :, None, :]
     out = jnp.einsum(

@@ -85,6 +85,15 @@ class TransformerSlotModel:
             active=active, kv_bucket=kv_bucket, unroll=unroll,
         )
 
+    def spec_step(self, params, state, draft, active, cap, kv_bucket,
+                  unroll=False):
+        from vtpu.serving.engine import batched_spec_step
+
+        return batched_spec_step(
+            cfg=self.cfg, params=params, cache=state, draft=draft,
+            active=active, cap=cap, kv_bucket=kv_bucket, unroll=unroll,
+        )
+
 
 class MoeSlotModel:
     """Expert-parallel MoE (vtpu/models/moe): the transformer attention

@@ -34,6 +34,7 @@ from vtpu.models.transformer import (
     decode_layer_loop,
     prefill,
     quantize_kv,
+    spec_verify_loop,
 )
 
 log = logging.getLogger(__name__)
@@ -57,6 +58,16 @@ class ServingConfig:
     # dominate). Forced False restores the fori_loop body, and the bounded-
     # window auto-heuristic then falls back to small pools only.
     decode_unroll: Optional[bool] = None
+    # Speculative decoding: draft length K (0 = off). Drafts come from
+    # prompt-lookup (continue the most recent earlier occurrence of the last
+    # spec_ngram tokens — no draft model, pays off on repetitive/structured
+    # text); the model verifies K+1 positions in ONE bandwidth-bound tick
+    # (batched_spec_step), emitting 1..K+1 tokens. Greedy sampling only: the
+    # engine silently ignores spec_tokens when a custom sampler or a model
+    # without spec_step is configured. A tick where no slot found any match
+    # falls back to the plain decode step (same bytes, fewer FLOPs).
+    spec_tokens: int = 0
+    spec_ngram: int = 3
 
 
 @dataclasses.dataclass
@@ -138,6 +149,91 @@ def batched_decode_step(
     return logits, {**new_kv, "len": jnp.where(active, lens + 1, lens)}
 
 
+def batched_spec_step(
+    params: Params,
+    cfg: ModelConfig,
+    cache: dict[str, jax.Array],
+    draft: jax.Array,
+    active: jax.Array,
+    cap: jax.Array,
+    kv_bucket: int = 0,
+    ffn_fn=None,
+    unroll: bool = False,
+) -> tuple[jax.Array, jax.Array, dict[str, jax.Array]]:
+    """One speculative tick for the slot pool: verify a [B, T] draft chunk
+    (column 0 is each slot's pending next token, columns 1..T-1 the
+    guessed continuation) and accept greedily.
+
+    Returns (pred [B, T], count [B], cache): pred[b, :count[b]] are the
+    tokens slot b emits this tick — the verified draft prefix IS the model's
+    own argmax at those positions, so emitting pred needs no re-gather of
+    draft. count = accepted + 1 (the first disagreeing argmax is the bonus
+    token every tick emits; a tick can never emit less than plain decode),
+    capped by ``cap`` (the slot's remaining token budget). The cache length
+    advances by count; rejected positions hold stale KV above the new
+    length, overwritten by the next chunk write before any query can attend
+    to them (see spec_verify_loop).
+
+    Greedy only: acceptance compares argmax — a custom sampler would make
+    the emitted stream diverge from its own non-speculative distribution,
+    so the engine disables speculation when one is configured.
+    """
+    b, t = draft.shape
+    lens = cache["len"]
+    rows = jnp.arange(b)[:, None]  # [B, 1], broadcasts against [B, T] indices
+    pos = lens[:, None] + jnp.arange(t)[None, :]
+    # masked/overflow writes get a deliberately out-of-range index and
+    # mode="drop": no gather-and-where, and no duplicate-index scatter race
+    # between a genuine write at max_seq-1 and a clipped one
+    pos_w = jnp.where(active[:, None] & (pos < cfg.max_seq), pos, cfg.max_seq + 7)
+
+    def write_kv(l, kv, k, v):
+        # k, v: [B, T, H, Dh]; scatter row i at (l, slot, len[slot]+i)
+        out = dict(kv)
+        if "k_scale" in kv:
+            kq, ksc = quantize_kv(k)
+            vq, vsc = quantize_kv(v)
+            out["k"] = kv["k"].at[l, rows, pos_w].set(kq, mode="drop")
+            out["v"] = kv["v"].at[l, rows, pos_w].set(vq, mode="drop")
+            out["k_scale"] = kv["k_scale"].at[l, rows, pos_w].set(ksc, mode="drop")
+            out["v_scale"] = kv["v_scale"].at[l, rows, pos_w].set(vsc, mode="drop")
+            return out
+        out["k"] = kv["k"].at[l, rows, pos_w].set(k, mode="drop")
+        out["v"] = kv["v"].at[l, rows, pos_w].set(v, mode="drop")
+        return out
+
+    logits, new_kv = spec_verify_loop(
+        params, cfg, cache, draft, kv_bucket, write_kv, ffn_fn=ffn_fn,
+        unroll=unroll,
+    )
+    pred = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, T]
+    match = (draft[:, 1:] == pred[:, :-1]).astype(jnp.int32)
+    accepted = jnp.sum(jnp.cumprod(match, axis=1), axis=1)  # leading matches
+    count = jnp.where(active, jnp.minimum(accepted + 1, cap), 0)
+    return pred, count, {**new_kv, "len": jnp.minimum(lens + count, cfg.max_seq)}
+
+
+def lookup_draft(history: list, k: int, max_ngram: int) -> Optional[list]:
+    """Prompt-lookup drafting: continue the most recent earlier occurrence
+    of the longest tail n-gram (<= max_ngram) found in the history. Returns
+    k tokens (zero-padded past the match) or None when nothing matches —
+    the caller's tick then has nothing to verify for this slot.
+
+    Host-side linear scan per tick: fine at serving context lengths (the
+    scan is over python ints while the device runs the previous tick); a
+    production tokenizer-aware index would replace this lookup, not the
+    verify machinery.
+    """
+    for n in range(min(max_ngram, len(history) - 1), 0, -1):
+        tail = history[-n:]
+        for i in range(len(history) - n - 1, -1, -1):
+            if history[i:i + n] == tail:
+                cont = history[i + n:i + n + k]
+                if cont:
+                    return cont + [0] * (k - len(cont))
+    return None
+
+
 def prefill_into_slot(
     params: Params,
     cfg: ModelConfig,
@@ -200,6 +296,13 @@ class ServingEngine:
         self.params = model.params
         self.cfg = getattr(model, "cfg", cfg)
         self.serving = serving
+        # speculation verifies against argmax, so it is only sound under the
+        # default greedy sampler; a model without spec_step can't speculate
+        self._spec_tokens = (
+            serving.spec_tokens
+            if sample is None and hasattr(model, "spec_step")
+            else 0
+        )
         self.sample = sample or (lambda logits: int(jnp.argmax(logits)))
         b = serving.slots
         self.state = model.init_state(b)
@@ -210,6 +313,10 @@ class ServingEngine:
             model.decode_step, static_argnames=("kv_bucket", "unroll"),
             donate_argnums=(1,),
         )
+        self._spec = jax.jit(
+            model.spec_step, static_argnames=("kv_bucket", "unroll"),
+            donate_argnums=(1,),
+        ) if self._spec_tokens else None
         self._prefill = jax.jit(model.prefill_into_slot, donate_argnums=(1,))
         # decode read-buckets: one compiled executable per size, chosen per
         # tick from the longest LIVE sequence (decode bandwidth scales with
@@ -244,6 +351,9 @@ class ServingEngine:
         self._slot_budget = [0] * b
         self._tokens = [0] * b  # next token per slot (host-side)
         self._slot_len = [0] * b  # host mirror of cache["len"] per LIVE slot
+        # per-slot token history (prompt + emitted) feeding prompt-lookup
+        # drafts; only maintained while speculation is on
+        self._history: list[list[int]] = [[] for _ in range(b)]
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -324,6 +434,8 @@ class ServingEngine:
         self._slot_budget[slot] = budget - 1
         self._tokens[slot] = first
         self._slot_len[slot] = n
+        if self._spec_tokens:
+            self._history[slot] = [int(x) for x in prompt.tolist()] + [first]
         req.out.put(first)
         if self._slot_budget[slot] <= 0 or first == self.serving.eos_token:
             self._retire(slot)
@@ -335,6 +447,7 @@ class ServingEngine:
         self._slot_req[slot] = None
         self._slot_budget[slot] = 0
         self._slot_len[slot] = 0
+        self._history[slot] = []
 
     def _warm_executables(self) -> None:
         """Compile every decode and prefill bucket before serving: a
@@ -351,6 +464,13 @@ class ServingEngine:
                 self.params, self.state, tokens, inactive, bucket,
                 unroll=self._unroll,
             )
+            if self._spec is not None:
+                _, _, self.state = self._spec(
+                    self.params, self.state,
+                    jnp.zeros((b, self._spec_tokens + 1), jnp.int32),
+                    inactive, jnp.zeros((b,), jnp.int32), bucket,
+                    unroll=self._unroll,
+                )
         for bucket in self._prefill_buckets:
             _, self.state = self._prefill(
                 self.params, self.state, jnp.zeros((1, bucket), jnp.int32),
@@ -408,19 +528,71 @@ class ServingEngine:
                 continue
             # 2. one decode tick for the whole pool; the read window is the
             # smallest bucket past the longest LIVE sequence (this tick
-            # writes at len, so the view must cover len+1)
+            # writes chunk tokens starting at len, so the view must cover
+            # len + chunk)
             tokens = jnp.asarray(self._tokens, jnp.int32)
             active = jnp.asarray(
                 [self._slot_req[i] is not None for i in range(b)], bool
             )
+            # speculative tick when any slot found a draft; else the plain
+            # step (same KV bytes, fewer FLOPs)
+            drafts = None
+            if self._spec_tokens:
+                k = self._spec_tokens
+                drafts = [
+                    lookup_draft(self._history[i], k, self.serving.spec_ngram)
+                    if i in active_slots else None
+                    for i in range(b)
+                ]
+                if not any(d is not None for d in drafts):
+                    drafts = None
+            chunk = (self._spec_tokens + 1) if drafts is not None else 1
             if self._use_kv_buckets:
-                need = 1 + max(self._slot_len[i] for i in active_slots)
+                need = chunk + max(self._slot_len[i] for i in active_slots)
                 kv_bucket = next(
                     (bkt for bkt in self._kv_buckets if bkt >= need),
                     self.model.max_context,
                 )
             else:
                 kv_bucket = 0
+            if drafts is not None:
+                draft = jnp.asarray(
+                    [
+                        [self._tokens[i]] + (drafts[i] or [0] * k)
+                        for i in range(b)
+                    ],
+                    jnp.int32,
+                )
+                cap = jnp.asarray(
+                    [max(self._slot_budget[i], 0) for i in range(b)], jnp.int32
+                )
+                pred, count, self.state = self._spec(
+                    self.params, self.state, draft, active, cap, kv_bucket,
+                    unroll=self._unroll,
+                )
+                pred, count = jax.device_get((pred, count))
+                for slot in active_slots:
+                    emitted = [int(x) for x in pred[slot, : int(count[slot])]]
+                    # the device advanced this slot's cache length by
+                    # count[slot]; mirror it BEFORE any eos truncation so
+                    # host and device lengths can never diverge
+                    self._slot_len[slot] += int(count[slot])
+                    eos = self.serving.eos_token
+                    if eos in emitted:
+                        emitted = emitted[: emitted.index(eos) + 1]
+                    req = self._slot_req[slot]
+                    for tok in emitted:
+                        req.out.put(tok)
+                    self._slot_budget[slot] -= len(emitted)
+                    self._history[slot].extend(emitted)
+                    if emitted:
+                        self._tokens[slot] = emitted[-1]
+                    if (
+                        self._slot_budget[slot] <= 0
+                        or (emitted and emitted[-1] == eos)
+                    ):
+                        self._retire(slot)
+                continue
             logits, self.state = self._decode(
                 self.params, self.state, tokens, active, kv_bucket,
                 unroll=self._unroll,
@@ -432,5 +604,7 @@ class ServingEngine:
                 req = self._slot_req[slot]
                 req.out.put(tok)
                 self._slot_budget[slot] -= 1
+                if self._spec_tokens:
+                    self._history[slot].append(tok)
                 if self._slot_budget[slot] <= 0 or tok == self.serving.eos_token:
                     self._retire(slot)
