@@ -514,3 +514,164 @@ def test_lookup_draft_prefers_longest_recent_match():
     # no match at any n-gram size
     assert lookup_draft([1, 2, 3], 4, 3) is None
     assert lookup_draft([], 4, 3) is None
+
+
+def test_spec_decode_moe_family(params):
+    """Speculation rides the shared trunk for the MoE family too: the spec
+    engine's stream equals the plain MoE engine's stream."""
+    from vtpu.models.moe import MoEConfig, init_moe_params
+    from vtpu.serving.adapters import MoeSlotModel
+
+    mcfg = MoEConfig(
+        vocab=128, d_model=64, n_heads=2, n_layers=2, d_ff=128,
+        max_seq=64, head_dim=32, dtype=jnp.float32,
+        n_experts=4, top_k=2,
+    )
+    mparams = init_moe_params(jax.random.key(0), mcfg)
+    prompt = [5, 6, 7, 8, 5, 6, 7, 8, 5, 6]
+
+    def run(spec):
+        eng = ServingEngine(
+            model=MoeSlotModel(mparams, mcfg),
+            serving=_spec_cfg(spec_tokens=spec, max_new_tokens=12),
+        )
+        eng.start()
+        try:
+            return list(eng.submit(prompt, max_new_tokens=12).stream())
+        finally:
+            eng.stop()
+
+    assert run(4) == run(0)
+
+
+# --------------------------------------------------------- chunked prefill
+
+
+def test_chunked_prefill_matches_oneshot_cache_and_logits(params):
+    """ceil(n/C) chunk forwards must leave the same KV and final logits as
+    the one-shot bucketed prefill (tolerances: different executables)."""
+    from vtpu.models.transformer import init_kv_cache
+    from vtpu.serving.engine import chunked_prefill_into_slot, prefill_into_slot
+
+    n, c = 21, 8
+    prompt = jnp.asarray(_prompt(9, n), jnp.int32)
+    cache_a = init_kv_cache(CFG, 3)
+    padded = jnp.zeros((1, 32), jnp.int32).at[0, :n].set(prompt)
+    logits_a, cache_a = prefill_into_slot(
+        params, CFG, cache_a, padded, jnp.int32(1), jnp.int32(n))
+
+    cache_b = init_kv_cache(CFG, 3)
+    pad = -(-n // c) * c
+    pb = jnp.zeros((1, pad), jnp.int32).at[0, :n].set(prompt)
+    fn = jax.jit(chunked_prefill_into_slot, static_argnums=(1,))
+    for i in range(pad // c):
+        off = i * c
+        logits_b, cache_b = fn(params, CFG, cache_b, pb[:, off:off + c],
+                               jnp.int32(1), jnp.int32(off),
+                               jnp.int32(min(off + c, n)))
+    assert int(cache_b["len"][1]) == n
+    for key in ("k", "v"):
+        np.testing.assert_allclose(
+            np.asarray(cache_a[key][:, 1, :n]), np.asarray(cache_b[key][:, 1, :n]),
+            rtol=1e-4, atol=1e-5)
+    last = logits_b[0, (n - 1) - (pad - c)]
+    np.testing.assert_allclose(
+        np.asarray(logits_a), np.asarray(last), rtol=1e-4, atol=1e-4)
+
+
+def test_chunked_prefill_admits_beyond_largest_bucket(params):
+    """A prompt longer than every bucket admits through chunks, generates
+    its budget, and leaves neighbors untouched (solo oracle with identical
+    geometry — same executables both runs)."""
+    serving = ServingConfig(slots=2, prefill_buckets=(16,),
+                            max_new_tokens=6, prefill_chunk=16)
+    long_p = _prompt(11, 40)  # > bucket 16, needs 3 chunks
+    short_p = _prompt(12, 9)
+    want_long = _solo(params, serving, long_p, 6)
+    want_short = _solo(params, serving, short_p, 6)
+    assert len(want_long) == 6
+    eng = ServingEngine(params, CFG, serving)
+    eng.start()
+    try:
+        r1 = eng.submit(long_p, max_new_tokens=6)
+        r2 = eng.submit(short_p, max_new_tokens=6)
+        assert list(r1.stream()) == want_long
+        assert list(r2.stream()) == want_short
+    finally:
+        eng.stop()
+    # beyond max_context still refuses, with the chunked cap in the message
+    eng2 = ServingEngine(params, CFG, serving)
+    try:
+        with pytest.raises(ValueError, match="max_context"):
+            eng2.submit(list(range(CFG.max_seq + 1)))
+    finally:
+        eng2.stop()
+
+
+def test_chunked_prefill_config_validation(params):
+    """A chunk size that does not divide max_context would let the last
+    chunk's scatter clamp into earlier positions — rejected at build."""
+    with pytest.raises(ValueError, match="must divide"):
+        ServingEngine(params, CFG, ServingConfig(
+            slots=1, prefill_buckets=(16,), prefill_chunk=24))
+    # SSM has no chunkable KV trunk: chunking silently stays off
+    from vtpu.models.ssm import SSMConfig, init_ssm_params
+    from vtpu.serving.adapters import SsmSlotModel
+
+    scfg = SSMConfig(vocab=64, d_model=32, d_state=8, n_layers=2)
+    eng = ServingEngine(
+        model=SsmSlotModel(init_ssm_params(jax.random.key(0), scfg), scfg),
+        serving=ServingConfig(slots=1, prefill_buckets=(16,), prefill_chunk=8),
+    )
+    assert eng._prefill_chunk is None
+
+
+def test_chunked_prefill_composes_with_speculation(params):
+    """Chunk-admitted requests speculate like any other: stream equals the
+    plain chunked engine's stream."""
+    long_p = ([5, 6, 7, 8] * 12)[:44]
+
+    def run(spec):
+        serving = ServingConfig(slots=2, prefill_buckets=(16,),
+                                max_new_tokens=10, prefill_chunk=16,
+                                spec_tokens=spec)
+        return _solo(params, serving, long_p, 10)
+
+    assert run(4) == run(0)
+
+
+def test_chunked_admission_interleaves_with_decode(params):
+    """The head-of-line bound is real: while a long prompt admits chunk by
+    chunk, the live slot gets a decode tick between chunks (call order
+    chunk,decode,chunk,decode,... — never all chunks back-to-back)."""
+    serving = ServingConfig(slots=2, prefill_buckets=(16,),
+                            max_new_tokens=20, prefill_chunk=16)
+    eng = ServingEngine(params, CFG, serving)
+    order = []
+    chunk_fn, dec_fn = eng._prefill_chunk, eng._decode
+
+    def chunk_w(*a, **kw):
+        order.append("chunk")
+        return chunk_fn(*a, **kw)
+
+    def dec_w(*a, **kw):
+        order.append("decode")
+        return dec_fn(*a, **kw)
+
+    eng._prefill_chunk, eng._decode = chunk_w, dec_w
+    # both submitted BEFORE the loop starts: the first sweep admits the
+    # short prompt into slot 0 (bucketed) and parks the long one (chunked),
+    # so decode ticks and admission chunks deterministically coexist
+    live = eng.submit(_prompt(1, 8), max_new_tokens=20)
+    long_req = eng.submit(_prompt(11, 48), max_new_tokens=4)  # 3 chunks
+    eng.start()
+    try:
+        assert len(list(long_req.stream())) == 4
+        assert len(list(live.stream())) == 20
+    finally:
+        eng.stop()
+    # strip warm-up entries (they precede any admission)
+    chunks = [i for i, o in enumerate(order) if o == "chunk"]
+    serving_chunks = chunks[-3:]  # the admission's three chunks
+    between = order[serving_chunks[0]:serving_chunks[-1]]
+    assert "decode" in between, order[-12:]

@@ -213,9 +213,11 @@ def moe_decode_ffn(cfg: MoEConfig):
         # capacity = the full token count: decode routes every slot's token
         # jointly (including retired slots' stale ones), and a capacity
         # drop triggered by garbage would zero a LIVE slot's expert output —
-        # with capacity >= tokens, routing can never drop anyone
+        # with capacity >= tokens, routing can never drop anyone. x is
+        # [B, T, D]: T=1 for plain decode, K+1 for a speculative verify
+        # chunk (the same trunk serves both).
         out, _aux = moe_ffn(lp, rms_norm(x, lp["mlp_norm"]), cfg,
-                            capacity=x.shape[0])
+                            capacity=x.shape[0] * x.shape[1])
         return out
 
     return ffn

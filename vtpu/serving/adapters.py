@@ -94,6 +94,15 @@ class TransformerSlotModel:
             active=active, cap=cap, kv_bucket=kv_bucket, unroll=unroll,
         )
 
+    def prefill_chunk_into_slot(self, params, state, chunk, slot, offset,
+                                new_len, kv_bucket=0, unroll=False):
+        from vtpu.serving.engine import chunked_prefill_into_slot
+
+        return chunked_prefill_into_slot(
+            params, self.cfg, state, chunk, slot, offset, new_len,
+            kv_bucket=kv_bucket, unroll=unroll,
+        )
+
 
 class MoeSlotModel:
     """Expert-parallel MoE (vtpu/models/moe): the transformer attention
@@ -133,6 +142,29 @@ class MoeSlotModel:
             cfg=self.cfg, params=params, cache=state, tokens=tokens,
             active=active, kv_bucket=kv_bucket,
             ffn_fn=moe_decode_ffn(self.cfg), unroll=unroll,
+        )
+
+    def spec_step(self, params, state, draft, active, cap, kv_bucket,
+                  unroll=False):
+        from vtpu.models.moe import moe_decode_ffn
+        from vtpu.serving.engine import batched_spec_step
+
+        return batched_spec_step(
+            cfg=self.cfg, params=params, cache=state, draft=draft,
+            active=active, cap=cap, kv_bucket=kv_bucket,
+            ffn_fn=moe_decode_ffn(self.cfg), unroll=unroll,
+        )
+
+    def prefill_chunk_into_slot(self, params, state, chunk, slot, offset,
+                                new_len, kv_bucket=0, unroll=False):
+        from vtpu.models.moe import moe_decode_ffn
+        from vtpu.serving.engine import chunked_prefill_into_slot
+
+        # moe_decode_ffn's capacity >= tokens guarantee covers chunk pads
+        # the same way it covers retired slots' garbage: nothing can drop
+        return chunked_prefill_into_slot(
+            params, self.cfg, state, chunk, slot, offset, new_len,
+            kv_bucket=kv_bucket, unroll=unroll, ffn_fn=moe_decode_ffn(self.cfg),
         )
 
 

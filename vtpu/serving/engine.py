@@ -32,6 +32,7 @@ from vtpu.models.transformer import (
     ModelConfig,
     Params,
     decode_layer_loop,
+    kv_quantized,
     prefill,
     quantize_kv,
     spec_verify_loop,
@@ -68,6 +69,13 @@ class ServingConfig:
     # falls back to the plain decode step (same bytes, fewer FLOPs).
     spec_tokens: int = 0
     spec_ngram: int = 3
+    # Chunked prefill: admit prompts LONGER than the largest bucket by
+    # streaming fixed-size [1, C] chunks through the decode/verify trunk
+    # (chunked_prefill_into_slot). One executable per chunk size serves any
+    # prompt length up to the model context, and each admission dispatch is
+    # bounded at C tokens of work. None = off (bucketed prompts only).
+    # Short prompts keep using buckets (one dispatch beats ceil(n/C)).
+    prefill_chunk: Optional[int] = None
 
 
 @dataclasses.dataclass
@@ -213,6 +221,89 @@ def batched_spec_step(
     return pred, count, {**new_kv, "len": jnp.minimum(lens + count, cfg.max_seq)}
 
 
+def chunked_prefill_into_slot(
+    params: Params,
+    cfg: ModelConfig,
+    cache: dict[str, jax.Array],
+    chunk: jax.Array,
+    slot: jax.Array,
+    offset: jax.Array,
+    new_len: jax.Array,
+    kv_bucket: int = 0,
+    ffn_fn=None,
+    unroll: bool = False,
+) -> tuple[jax.Array, dict[str, jax.Array]]:
+    """One [1, C] prompt chunk written into *slot* at positions
+    offset..offset+C-1: prefill as a sequence of fixed-size chunk forwards
+    through the SAME trunk as decode and speculative verify
+    (spec_verify_loop) — a chunk is just a T=C verify pass whose "draft" is
+    known-correct prompt.
+
+    Why chunks: one compiled executable per chunk size C serves ANY prompt
+    length (the bucketed path compiles per bucket and caps prompts at the
+    largest), and a C-token chunk bounds how long one admission dispatch
+    can stall the decode loop's live streams. The trunk runs on a
+    single-row VIEW of the pool cache ([L, 1, S] slices), so chunk FLOPs
+    are per-prompt, not per-pool-slot; the written window is scattered back
+    afterwards. Pads in the final chunk write junk KV above new_len — same
+    staleness contract as rejected speculation: masked by length now,
+    overwritten before any query can attend to them.
+
+    ``new_len`` is the slot's length after this chunk (min(offset+C,
+    true_len) — the engine passes the running value so the LAST chunk
+    leaves the true length with no extra dispatch). ``kv_bucket`` (static;
+    0 = max_seq) bounds BOTH the slot-view copy and the attention reads:
+    the engine passes the smallest bucket covering offset+C, so early
+    chunks of a long-context model never stream the whole empty cache.
+    Returns (logits [1, C, vocab], updated pool cache); only the last
+    chunk's logits (at the prompt's final position) are consumed.
+    """
+    c = chunk.shape[1]
+    bucket = kv_bucket or cfg.max_seq
+    quant = kv_quantized(cfg)
+    kv_keys = ("k", "v", "k_scale", "v_scale") if quant else ("k", "v")
+    view = {
+        key: jax.lax.dynamic_slice(
+            cache[key],
+            (0, slot) + (0,) * (cache[key].ndim - 2),
+            (cache[key].shape[0], 1, bucket) + cache[key].shape[3:],
+        )
+        for key in kv_keys
+    }
+    view["len"] = jnp.full((1,), offset, jnp.int32)
+
+    def write_kv(l, kv, k, v):
+        out = dict(kv)
+        if quant:
+            kq, ksc = quantize_kv(k)
+            vq, vsc = quantize_kv(v)
+            out["k"] = jax.lax.dynamic_update_slice(kv["k"], kq[None], (l, 0, offset, 0, 0))
+            out["v"] = jax.lax.dynamic_update_slice(kv["v"], vq[None], (l, 0, offset, 0, 0))
+            out["k_scale"] = jax.lax.dynamic_update_slice(
+                kv["k_scale"], ksc[None], (l, 0, offset, 0))
+            out["v_scale"] = jax.lax.dynamic_update_slice(
+                kv["v_scale"], vsc[None], (l, 0, offset, 0))
+            return out
+        out["k"] = jax.lax.dynamic_update_slice(kv["k"], k[None], (l, 0, offset, 0, 0))
+        out["v"] = jax.lax.dynamic_update_slice(kv["v"], v[None], (l, 0, offset, 0, 0))
+        return out
+
+    logits, new_view = spec_verify_loop(
+        params, cfg, view, chunk, bucket, write_kv, ffn_fn=ffn_fn,
+        unroll=unroll,
+    )
+    out = dict(cache)
+    for key in kv_keys:
+        shape = new_view[key].shape  # [L, 1, S, H(, Dh)]
+        sizes = (shape[0], 1, c) + shape[3:]
+        written = jax.lax.dynamic_slice(
+            new_view[key], (0, 0, offset) + (0,) * (len(shape) - 3), sizes)
+        out[key] = jax.lax.dynamic_update_slice(
+            cache[key], written, (0, slot, offset) + (0,) * (len(shape) - 3))
+    out["len"] = cache["len"].at[slot].set(new_len)
+    return logits, out
+
+
 def lookup_draft(history: list, k: int, max_ngram: int) -> Optional[list]:
     """Prompt-lookup drafting: continue the most recent earlier occurrence
     of the longest tail n-gram (<= max_ngram) found in the history. Returns
@@ -318,6 +409,22 @@ class ServingEngine:
             donate_argnums=(1,),
         ) if self._spec_tokens else None
         self._prefill = jax.jit(model.prefill_into_slot, donate_argnums=(1,))
+        chunk = serving.prefill_chunk
+        if chunk and not hasattr(model, "prefill_chunk_into_slot"):
+            chunk = None  # model family without a chunkable trunk (SSM)
+        if chunk:
+            ctx = model.max_context
+            if ctx and ctx % chunk:
+                # a final chunk straddling the context wall would clamp its
+                # scatter start and corrupt earlier positions
+                raise ValueError(
+                    f"prefill_chunk {chunk} must divide max_context {ctx}")
+            self._prefill_chunk = jax.jit(
+                model.prefill_chunk_into_slot,
+                static_argnames=("kv_bucket", "unroll"), donate_argnums=(1,))
+        else:
+            self._prefill_chunk = None
+        self._chunk = chunk
         # decode read-buckets: one compiled executable per size, chosen per
         # tick from the longest LIVE sequence (decode bandwidth scales with
         # the read window, not the context cap)
@@ -354,6 +461,9 @@ class ServingEngine:
         # per-slot token history (prompt + emitted) feeding prompt-lookup
         # drafts; only maintained while speculation is on
         self._history: list[list[int]] = [[] for _ in range(b)]
+        # slots mid-chunked-admission: slot -> {req, padded, n, off}; the
+        # loop advances one chunk per iteration between decode ticks
+        self._admitting: dict[int, dict] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -400,6 +510,9 @@ class ServingEngine:
         observe the None sentinel, not hang on a dead engine."""
         for slot in range(len(self._slot_req)):
             self._retire(slot)
+        for adm in self._admitting.values():
+            adm["req"].out.put(None)
+        self._admitting.clear()
         while True:
             try:
                 req = self._pending.get_nowait()
@@ -409,24 +522,76 @@ class ServingEngine:
 
     # ----------------------------------------------------------------- loop
 
-    def _bucket(self, n: int) -> int:
+    def _bucket(self, n: int) -> Optional[int]:
+        """Smallest prefill bucket covering *n*, or None when the prompt
+        goes through chunked prefill instead (longer than every bucket,
+        chunking configured). Raises for prompts nothing can admit."""
         for b in self._prefill_buckets:
             if n <= b:
                 return b
+        ctx = self.model.max_context
+        if self._chunk and (not ctx or n <= ctx):
+            return None
         raise ValueError(
             f"prompt length {n} exceeds the largest usable bucket "
             f"{self._prefill_buckets[-1]}"
+            + (f" (chunked prefill caps at max_context {ctx})"
+               if self._chunk else "")
         )
 
     def _admit(self, slot: int, req: Request) -> None:
         prompt = req.tokens
         n = int(prompt.shape[0])
         bucket = self._bucket(n)
+        if bucket is None:
+            # Chunked prefill is INCREMENTAL: park the request and let the
+            # serving loop advance one [1, C] chunk per iteration, so live
+            # streams decode between chunks — that interleaving is what
+            # makes "head-of-line work bounded at C tokens" true (a
+            # back-to-back chunk loop here would stall exactly like one
+            # monolithic dispatch).
+            c = self._chunk
+            pad = -(-n // c) * c
+            padded = jnp.zeros((1, pad), jnp.int32).at[0, :n].set(prompt)
+            self._admitting[slot] = {"req": req, "padded": padded, "n": n,
+                                     "off": 0}
+            return
         padded = jnp.zeros((1, bucket), jnp.int32).at[0, :n].set(prompt)
         logits, self.state = self._prefill(
             self.params, self.state, padded, jnp.int32(slot), jnp.int32(n)
         )
-        first = self.sample(logits)
+        self._finish_admit(slot, req, self.sample(logits), n)
+
+    def _advance_admissions(self) -> None:
+        """One prefill chunk for every mid-admission slot (then back to the
+        decode tick). The final chunk completes admission."""
+        for slot in sorted(self._admitting):
+            adm = self._admitting[slot]
+            req, n, off = adm["req"], adm["n"], adm["off"]
+            if req.cancelled:
+                del self._admitting[slot]
+                req.out.put(None)
+                continue
+            c = self._chunk
+            need = off + c
+            kv_bucket = next(
+                (bkt for bkt in self._kv_buckets if bkt >= need),
+                self.model.max_context,
+            )
+            logits, self.state = self._prefill_chunk(
+                self.params, self.state, adm["padded"][:, off:off + c],
+                jnp.int32(slot), jnp.int32(off), jnp.int32(min(off + c, n)),
+                kv_bucket=kv_bucket, unroll=self._unroll,
+            )
+            adm["off"] = off + c
+            if adm["off"] >= adm["padded"].shape[1]:  # final chunk
+                del self._admitting[slot]
+                pad = adm["padded"].shape[1]
+                self._finish_admit(
+                    slot, req, self.sample(logits[0, (n - 1) - (pad - c)]), n
+                )
+
+    def _finish_admit(self, slot: int, req: Request, first: int, n: int) -> None:
         self._slot_req[slot] = req
         # the KV cache is a hard wall: never decode past max_seq
         ctx = self.model.max_context
@@ -435,7 +600,7 @@ class ServingEngine:
         self._tokens[slot] = first
         self._slot_len[slot] = n
         if self._spec_tokens:
-            self._history[slot] = [int(x) for x in prompt.tolist()] + [first]
+            self._history[slot] = [int(x) for x in req.tokens.tolist()] + [first]
         req.out.put(first)
         if self._slot_budget[slot] <= 0 or first == self.serving.eos_token:
             self._retire(slot)
@@ -476,6 +641,20 @@ class ServingEngine:
                 self.params, self.state, jnp.zeros((1, bucket), jnp.int32),
                 jnp.int32(0), jnp.int32(1),
             )
+        if self._prefill_chunk is not None:
+            # one executable per (chunk, read-bucket) pair actually reachable
+            for bkt in {
+                next((x for x in self._kv_buckets if x >= need),
+                     self.model.max_context)
+                for need in range(self._chunk, (self.model.max_context or
+                                                self._chunk) + 1, self._chunk)
+            }:
+                _, self.state = self._prefill_chunk(
+                    self.params, self.state,
+                    jnp.zeros((1, self._chunk), jnp.int32),
+                    jnp.int32(0), jnp.int32(0), jnp.int32(1),
+                    kv_bucket=bkt, unroll=self._unroll,
+                )
 
     def _loop(self) -> None:
         try:
@@ -498,7 +677,7 @@ class ServingEngine:
             for slot in range(b):
                 if drained:
                     break
-                while self._slot_req[slot] is None:
+                while self._slot_req[slot] is None and slot not in self._admitting:
                     try:
                         req = self._pending.get_nowait()
                     except queue.Empty:
@@ -509,6 +688,8 @@ class ServingEngine:
                         continue
                     self._admit(slot, req)
                     admitted = True
+            # one prefill chunk per mid-admission slot, between decode ticks
+            self._advance_admissions()
             # retire slots whose client walked away before decoding for them
             for slot in range(b):
                 req = self._slot_req[slot]
@@ -516,6 +697,8 @@ class ServingEngine:
                     self._retire(slot)
             active_slots = [i for i in range(b) if self._slot_req[i] is not None]
             if not active_slots:
+                if self._admitting:
+                    continue  # keep advancing chunks; never block on the queue
                 if not admitted:
                     try:
                         req = self._pending.get(timeout=0.05)
