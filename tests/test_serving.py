@@ -675,3 +675,76 @@ def test_chunked_admission_interleaves_with_decode(params):
     serving_chunks = chunks[-3:]  # the admission's three chunks
     between = order[serving_chunks[0]:serving_chunks[-1]]
     assert "decode" in between, order[-12:]
+
+
+# ---------------------------------------------------------- prefix caching
+
+
+def test_prefix_cache_stream_matches_full_prompt(params):
+    """register_prefix + suffix submit must generate the same stream as the
+    full prompt through the same chunked engine (attractor prompt: stable
+    across chunk-boundary executables)."""
+    serving = ServingConfig(slots=2, prefill_buckets=(16,),
+                            max_new_tokens=8, prefill_chunk=16)
+    pre = ([5, 6, 7, 8] * 6)[:20]  # off-grid prefix (20 % 16 != 0)
+    suf = [5, 6, 7, 8, 5, 6]
+    want = _solo(params, serving, pre + suf, 8)
+
+    eng = ServingEngine(params, CFG, serving)
+    eng.start()
+    try:
+        pid = eng.register_prefix(pre)
+        got = list(eng.submit(suf, max_new_tokens=8, prefix=pid).stream())
+        # two requests sharing the prefix: the install path is reusable
+        got2 = list(eng.submit(suf, max_new_tokens=8, prefix=pid).stream())
+    finally:
+        eng.stop()
+    assert got == want == got2
+
+
+def test_prefix_cache_empty_suffix_and_validation(params):
+    serving = ServingConfig(slots=1, prefill_buckets=(16,),
+                            max_new_tokens=4, prefill_chunk=16)
+    eng = ServingEngine(params, CFG, serving)
+    eng.start()
+    try:
+        pid = eng.register_prefix([5, 6, 7, 8] * 4)
+        # empty suffix: first token comes from the prefix's stored logits
+        got = list(eng.submit([], max_new_tokens=4, prefix=pid).stream())
+        assert len(got) == 4
+        with pytest.raises(ValueError, match="unknown prefix"):
+            eng.submit([1], prefix=999)
+        with pytest.raises(ValueError, match="exceeds"):
+            eng.submit(list(range(CFG.max_seq)), prefix=pid)
+        with pytest.raises(ValueError, match="no room"):
+            eng.register_prefix(list(range(CFG.max_seq)))
+    finally:
+        eng.stop()
+    # chunking off: registration refuses up front
+    eng2 = ServingEngine(params, CFG, ServingConfig(
+        slots=1, prefill_buckets=(16,)))
+    try:
+        with pytest.raises(ValueError, match="requires prefill_chunk"):
+            eng2.register_prefix([1, 2, 3])
+    finally:
+        eng2.stop()
+
+
+def test_prefix_cache_composes_with_speculation(params):
+    """Prefix-admitted requests speculate with the prefix in their lookup
+    history: stream equality vs the plain prefix engine."""
+    pre = ([5, 6, 7, 8] * 5)[:18]
+    suf = [5, 6, 7, 8]
+
+    def run(spec):
+        eng = ServingEngine(params, CFG, ServingConfig(
+            slots=2, prefill_buckets=(16,), max_new_tokens=10,
+            prefill_chunk=16, spec_tokens=spec))
+        eng.start()
+        try:
+            pid = eng.register_prefix(pre)
+            return list(eng.submit(suf, max_new_tokens=10, prefix=pid).stream())
+        finally:
+            eng.stop()
+
+    assert run(4) == run(0)

@@ -80,8 +80,9 @@ class ServingConfig:
 
 @dataclasses.dataclass
 class Request:
-    tokens: Any  # [S] int32 prompt
+    tokens: Any  # [S] int32 prompt (the SUFFIX when prefix is set)
     max_new_tokens: int = 0  # 0: serving config default
+    prefix: Optional[int] = None  # id from ServingEngine.register_prefix
     out: "queue.Queue[Optional[int]]" = dataclasses.field(default_factory=queue.Queue)
     cancelled: bool = False
 
@@ -304,6 +305,14 @@ def chunked_prefill_into_slot(
     return logits, out
 
 
+def pad_to_chunks(tokens: jax.Array, n: int, c: int) -> jax.Array:
+    """Right-pad an [n] prompt with zeros to a [1, ceil(n/c)*c] chunk grid
+    (the one padding contract every chunked path shares; pads above the true
+    length are masked by the ragged reads and overwritten before use)."""
+    pad = -(-n // c) * c
+    return jnp.zeros((1, pad), jnp.int32).at[0, :n].set(tokens)
+
+
 def lookup_draft(history: list, k: int, max_ngram: int) -> Optional[list]:
     """Prompt-lookup drafting: continue the most recent earlier occurrence
     of the longest tail n-gram (<= max_ngram) found in the history. Returns
@@ -461,23 +470,130 @@ class ServingEngine:
         # per-slot token history (prompt + emitted) feeding prompt-lookup
         # drafts; only maintained while speculation is on
         self._history: list[list[int]] = [[] for _ in range(b)]
-        # slots mid-chunked-admission: slot -> {req, padded, n, off}; the
-        # loop advances one chunk per iteration between decode ticks
+        # slots mid-chunked-admission: slot -> {req, padded, n, off, base};
+        # the loop advances one chunk per iteration between decode ticks
         self._admitting: dict[int, dict] = {}
+        # registered prompt prefixes: id -> {tokens, buffers, len, pad,
+        # last_logits}; install is a device copy, suffixes chunk from the
+        # prefix offset
+        self._prefixes: dict[int, dict] = {}
+        self._prefix_lock = threading.Lock()
+        self._next_prefix_id = 0
+        # per padded-prefix-length COMPILED install executables, built at
+        # register_prefix time on the caller's thread — a first-use compile
+        # inside the serving loop would stall every live stream (the
+        # _warm_executables invariant)
+        self._install_jits: dict[int, Any] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
     # ------------------------------------------------------------------ API
 
-    def submit(self, tokens, max_new_tokens: int = 0) -> Request:
+    def register_prefix(self, tokens) -> int:
+        """Prefill a shared prompt prefix ONCE and return its id; submits
+        passing ``prefix=id`` provide only the suffix, admitted by a device
+        copy of the cached KV plus suffix chunks from the prefix offset —
+        the system-prompt TTFT cost is paid at registration, not per
+        request. Requires chunked prefill (ServingConfig.prefill_chunk).
+
+        The prefix KV lives in host-of-engine device memory sliced to the
+        padded prefix length ([L, 1, ceil(n/C)*C, H, Dh] per k/v plane).
+        Thread-safe: builds into its OWN single-slot cache, never touching
+        the serving loop's pool state.
+        """
+        if not self._chunk:
+            raise ValueError("register_prefix requires prefill_chunk")
+        tokens = jnp.asarray(tokens, jnp.int32)
+        n = int(tokens.shape[0])
+        c = self._chunk
+        ctx = self.model.max_context
+        if n < 1 or (ctx and n > ctx - c):
+            # at least one suffix chunk must fit after the prefix
+            raise ValueError(f"prefix length {n} leaves no room for a suffix")
+        padded = pad_to_chunks(tokens, n, c)
+        pad = padded.shape[1]
+        scratch = self.model.init_state(1)
+        for i in range(pad // c):
+            off = i * c
+            kv_bucket = next(
+                (bkt for bkt in self._kv_buckets if bkt >= off + c), ctx)
+            logits, scratch = self._prefill_chunk(
+                self.params, scratch, padded[:, off:off + c],
+                jnp.int32(0), jnp.int32(off), jnp.int32(min(off + c, n)),
+                kv_bucket=kv_bucket, unroll=self._unroll,
+            )
+        kv_keys = (
+            ("k", "v", "k_scale", "v_scale") if "k_scale" in scratch
+            else ("k", "v"))
+        buffers = {key: scratch[key][:, 0, :pad] for key in kv_keys}
+        last_logits = logits[0, (n - 1) - (pad - c)]
+        self._compile_install(pad, buffers)
+        with self._prefix_lock:
+            pid = self._next_prefix_id
+            self._next_prefix_id += 1
+            self._prefixes[pid] = {
+                "tokens": [int(x) for x in tokens.tolist()],
+                "buffers": buffers, "len": n, "pad": pad,
+                "last_logits": last_logits,
+            }
+        return pid
+
+    def _compile_install(self, pad: int, buffers: dict) -> None:
+        """AOT-compile the per-padded-length install executable HERE, on the
+        registering caller's thread (jax.jit's own shape-keyed cache would
+        compile lazily inside the serving loop instead, stalling live
+        streams mid-serving)."""
+        if pad in self._install_jits:
+            return
+
+        def install(state, buffers, slot, new_len):
+            out = dict(state)
+            for key, buf in buffers.items():
+                out[key] = state[key].at[:, slot, :buf.shape[1]].set(buf)
+            out["len"] = state["len"].at[slot].set(new_len)
+            return out
+
+        shape_of = lambda t: jax.tree_util.tree_map(  # noqa: E731
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), t)
+        self._install_jits[pad] = (
+            jax.jit(install, donate_argnums=(0,))
+            .lower(shape_of(self.state), shape_of(buffers),
+                   jax.ShapeDtypeStruct((), jnp.int32),
+                   jax.ShapeDtypeStruct((), jnp.int32))
+            .compile()
+        )
+
+    def _install_prefix(self, slot: int, pid: int) -> None:
+        """Copy a registered prefix's KV into *slot* (one fused device op,
+        pre-compiled at registration)."""
+        entry = self._prefixes[pid]
+        self.state = self._install_jits[entry["pad"]](
+            self.state, entry["buffers"], jnp.int32(slot),
+            jnp.int32(entry["len"]))
+
+    def submit(self, tokens, max_new_tokens: int = 0,
+               prefix: Optional[int] = None) -> Request:
         if self._stop.is_set():
             raise RuntimeError("ServingEngine is stopped")
         tokens = jnp.asarray(tokens, jnp.int32)
         # validate HERE, on the caller's thread: an oversized prompt must
         # raise to its submitter, not kill the serving loop (which would
         # hang every other client forever)
-        self._bucket(int(tokens.shape[0]))
-        req = Request(tokens=tokens,
+        if prefix is not None:
+            entry = self._prefixes.get(prefix)
+            if entry is None:
+                raise ValueError(f"unknown prefix id {prefix}")
+            ns = int(tokens.shape[0])
+            c = self._chunk
+            end = entry["len"] + (-(-ns // c) * c if ns else 0)
+            ctx = self.model.max_context
+            if ctx and end > ctx:
+                raise ValueError(
+                    f"prefix {entry['len']} + padded suffix exceeds "
+                    f"max_context {ctx}")
+        else:
+            self._bucket(int(tokens.shape[0]))
+        req = Request(tokens=tokens, prefix=prefix,
                       max_new_tokens=max_new_tokens or self.serving.max_new_tokens)
         self._pending.put(req)
         if self._stop.is_set():
@@ -542,6 +658,20 @@ class ServingEngine:
     def _admit(self, slot: int, req: Request) -> None:
         prompt = req.tokens
         n = int(prompt.shape[0])
+        if req.prefix is not None:
+            entry = self._prefixes[req.prefix]
+            self._install_prefix(slot, req.prefix)
+            base = entry["len"]
+            if n == 0:
+                # no suffix: the first token comes straight from the
+                # prefix's stored final logits
+                self._finish_admit(
+                    slot, req, self.sample(entry["last_logits"]), base)
+                return
+            self._admitting[slot] = {
+                "req": req, "padded": pad_to_chunks(prompt, n, self._chunk),
+                "n": base + n, "off": 0, "base": base}
+            return
         bucket = self._bucket(n)
         if bucket is None:
             # Chunked prefill is INCREMENTAL: park the request and let the
@@ -550,11 +680,9 @@ class ServingEngine:
             # makes "head-of-line work bounded at C tokens" true (a
             # back-to-back chunk loop here would stall exactly like one
             # monolithic dispatch).
-            c = self._chunk
-            pad = -(-n // c) * c
-            padded = jnp.zeros((1, pad), jnp.int32).at[0, :n].set(prompt)
-            self._admitting[slot] = {"req": req, "padded": padded, "n": n,
-                                     "off": 0}
+            self._admitting[slot] = {
+                "req": req, "padded": pad_to_chunks(prompt, n, self._chunk),
+                "n": n, "off": 0, "base": 0}
             return
         padded = jnp.zeros((1, bucket), jnp.int32).at[0, :n].set(prompt)
         logits, self.state = self._prefill(
@@ -567,20 +695,23 @@ class ServingEngine:
         decode tick). The final chunk completes admission."""
         for slot in sorted(self._admitting):
             adm = self._admitting[slot]
-            req, n, off = adm["req"], adm["n"], adm["off"]
+            req, n, off, base = adm["req"], adm["n"], adm["off"], adm["base"]
             if req.cancelled:
                 del self._admitting[slot]
                 req.out.put(None)
                 continue
             c = self._chunk
-            need = off + c
+            # off indexes the (suffix-)padded array; base is the installed
+            # prefix length, so the device offset is base + off
+            need = base + off + c
             kv_bucket = next(
                 (bkt for bkt in self._kv_buckets if bkt >= need),
                 self.model.max_context,
             )
             logits, self.state = self._prefill_chunk(
                 self.params, self.state, adm["padded"][:, off:off + c],
-                jnp.int32(slot), jnp.int32(off), jnp.int32(min(off + c, n)),
+                jnp.int32(slot), jnp.int32(base + off),
+                jnp.int32(min(base + off + c, n)),
                 kv_bucket=kv_bucket, unroll=self._unroll,
             )
             adm["off"] = off + c
@@ -588,7 +719,8 @@ class ServingEngine:
                 del self._admitting[slot]
                 pad = adm["padded"].shape[1]
                 self._finish_admit(
-                    slot, req, self.sample(logits[0, (n - 1) - (pad - c)]), n
+                    slot, req,
+                    self.sample(logits[0, (n - base - 1) - (pad - c)]), n,
                 )
 
     def _finish_admit(self, slot: int, req: Request, first: int, n: int) -> None:
@@ -600,7 +732,10 @@ class ServingEngine:
         self._tokens[slot] = first
         self._slot_len[slot] = n
         if self._spec_tokens:
-            self._history[slot] = [int(x) for x in req.tokens.tolist()] + [first]
+            pre = (self._prefixes[req.prefix]["tokens"]
+                   if req.prefix is not None else [])
+            self._history[slot] = (
+                pre + [int(x) for x in req.tokens.tolist()] + [first])
         req.out.put(first)
         if self._slot_budget[slot] <= 0 or first == self.serving.eos_token:
             self._retire(slot)
@@ -642,13 +777,11 @@ class ServingEngine:
                 jnp.int32(0), jnp.int32(1),
             )
         if self._prefill_chunk is not None:
-            # one executable per (chunk, read-bucket) pair actually reachable
-            for bkt in {
-                next((x for x in self._kv_buckets if x >= need),
-                     self.model.max_context)
-                for need in range(self._chunk, (self.model.max_context or
-                                                self._chunk) + 1, self._chunk)
-            }:
+            # one executable per (chunk, read-bucket) pair. EVERY bucket
+            # >= chunk is reachable: prefix-cached admissions chunk from
+            # unaligned offsets (need = base + off + C), so needs are not
+            # just multiples of C
+            for bkt in [x for x in self._kv_buckets if x >= self._chunk]:
                 _, self.state = self._prefill_chunk(
                     self.params, self.state,
                     jnp.zeros((1, self._chunk), jnp.int32),
