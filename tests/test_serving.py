@@ -748,3 +748,46 @@ def test_prefix_cache_composes_with_speculation(params):
             eng.stop()
 
     assert run(4) == run(0)
+
+
+def test_spec_adaptive_gate_and_stats(params):
+    """Below-breakeven acceptance pauses drafting (cooloff), the cooloff
+    expiry re-probes with an optimistic EMA, and stats() reports the
+    counters. An unattainable threshold must never change the stream."""
+    eng = ServingEngine(params, CFG, _spec_cfg())
+    assert eng._spec_allowed()
+    eng._spec_cooloff = 3
+    assert not eng._spec_allowed()
+    assert not eng._spec_allowed()
+    assert not eng._spec_allowed()  # hits 0: next call re-probes
+    assert eng._spec_allowed()
+    # re-probe starts slightly above breakeven, not at the optimistic
+    # maximum: a losing probe must shut back off within a few ticks
+    assert eng._spec_ema == eng.serving.spec_min_mean + 0.25
+
+    prompt = [5, 6, 7, 8, 5, 6, 7, 8, 5, 6]
+
+    def run(**kw):
+        serving = _spec_cfg(max_new_tokens=16, **kw)
+        eng = ServingEngine(params, CFG, serving)
+        eng.start()
+        try:
+            out = list(eng.submit(prompt, max_new_tokens=16).stream())
+        finally:
+            eng.stop()
+        return out, eng.stats()
+
+    plain, _ = run(spec_tokens=0)
+    # threshold no speculation can meet: the gate must only cost ticks,
+    # never tokens
+    got, stats = run(spec_min_mean=99.0, spec_cooloff_ticks=4)
+    assert got == plain
+    assert stats["spec_ticks"] >= 1  # probed at least once
+    assert stats["decode_ticks"] >= 1  # then cooled off to plain ticks
+    assert stats["generated_tokens"] == 16
+    assert stats["admissions"] == 1
+    # healthy acceptance keeps speculating (the repetitive stream)
+    got2, stats2 = run()
+    assert got2 == plain
+    assert stats2["spec_ema"] > 1.25
+    assert stats2["mean_emitted_per_spec_tick"] > 1.25

@@ -69,6 +69,14 @@ class ServingConfig:
     # falls back to the plain decode step (same bytes, fewer FLOPs).
     spec_tokens: int = 0
     spec_ngram: int = 3
+    # Adaptive speculation: a verify tick costs ~1.06-1.35x a decode tick
+    # (MFU_r04 spec), so speculation LOSES on traffic whose drafts rarely
+    # verify. The engine tracks an EMA of mean emitted tokens per spec tick
+    # and stops drafting while it sits below this threshold, re-probing
+    # after spec_cooloff_ticks plain ticks (workloads change). 0 = always
+    # speculate.
+    spec_min_mean: float = 1.25
+    spec_cooloff_ticks: int = 64
     # Chunked prefill: admit prompts LONGER than the largest bucket by
     # streaming fixed-size [1, C] chunks through the decode/verify trunk
     # (chunked_prefill_into_slot). One executable per chunk size serves any
@@ -473,6 +481,18 @@ class ServingEngine:
         # slots mid-chunked-admission: slot -> {req, padded, n, off, base};
         # the loop advances one chunk per iteration between decode ticks
         self._admitting: dict[int, dict] = {}
+        # adaptive-speculation state: the probe EMA starts a LITTLE above
+        # breakeven — a fresh engine (or a re-probe) gets a handful of
+        # ticks to prove itself, then shuts back off; resetting to the
+        # optimistic maximum would spend ~30% of ticks speculating at a
+        # loss forever on persistently low-acceptance traffic
+        self._spec_ema = self._spec_probe_ema()
+        self._spec_cooloff = 0
+        # observability counters (read via stats())
+        self._stats = {"generated_tokens": 0, "decode_ticks": 0,
+                       "spec_ticks": 0, "spec_slot_ticks": 0,
+                       "spec_emitted": 0,
+                       "prefill_chunks": 0, "admissions": 0}
         # registered prompt prefixes: id -> {tokens, buffers, len, pad,
         # last_logits}; install is a device copy, suffixes chunk from the
         # prefix offset
@@ -715,6 +735,7 @@ class ServingEngine:
                 kv_bucket=kv_bucket, unroll=self._unroll,
             )
             adm["off"] = off + c
+            self._stats["prefill_chunks"] += 1
             if adm["off"] >= adm["padded"].shape[1]:  # final chunk
                 del self._admitting[slot]
                 pad = adm["padded"].shape[1]
@@ -736,9 +757,47 @@ class ServingEngine:
                    if req.prefix is not None else [])
             self._history[slot] = (
                 pre + [int(x) for x in req.tokens.tolist()] + [first])
+        self._stats["admissions"] += 1
+        self._stats["generated_tokens"] += 1
         req.out.put(first)
         if self._slot_budget[slot] <= 0 or first == self.serving.eos_token:
             self._retire(slot)
+
+    def _spec_probe_ema(self) -> float:
+        """EMA value for a fresh probe: slightly above breakeven, so a
+        losing probe decays below the gate within a few ticks (~6% spec
+        duty cycle at the default cooloff, vs ~30% if reset to the
+        optimistic maximum)."""
+        return (self.serving.spec_min_mean or 1.0) + 0.25
+
+    def _spec_allowed(self) -> bool:
+        """Adaptive gate: drafting pauses while the per-slot emitted EMA
+        sits below breakeven, and re-probes after the cooloff elapses."""
+        if not self.serving.spec_min_mean:
+            return True
+        if self._spec_cooloff > 0:
+            self._spec_cooloff -= 1
+            if self._spec_cooloff == 0:
+                self._spec_ema = self._spec_probe_ema()
+            return False
+        return True
+
+    def stats(self) -> dict:
+        """Serving counters snapshot (thread-safe reads of monotonic
+        counters): token/tick totals, speculation acceptance, occupancy.
+        Acceptance numbers are PER SLOT-TICK (delivered tokens / slot
+        participations) — directly comparable to spec_min_mean."""
+        s = dict(self._stats)
+        s["mean_emitted_per_spec_tick"] = round(
+            s["spec_emitted"] / s["spec_slot_ticks"], 3
+        ) if s["spec_slot_ticks"] else None
+        s["spec_ema"] = round(self._spec_ema, 3)
+        s["spec_cooling_off"] = self._spec_cooloff > 0
+        s["active_slots"] = sum(r is not None for r in self._slot_req)
+        s["admitting_slots"] = len(self._admitting)
+        s["queued"] = self._pending.qsize()
+        s["registered_prefixes"] = len(self._prefixes)
+        return s
 
     def _retire(self, slot: int) -> None:
         req = self._slot_req[slot]
@@ -853,7 +912,7 @@ class ServingEngine:
             # speculative tick when any slot found a draft; else the plain
             # step (same KV bytes, fewer FLOPs)
             drafts = None
-            if self._spec_tokens:
+            if self._spec_tokens and self._spec_allowed():
                 k = self._spec_tokens
                 drafts = [
                     lookup_draft(self._history[i], k, self.serving.spec_ngram)
@@ -887,6 +946,7 @@ class ServingEngine:
                     unroll=self._unroll,
                 )
                 pred, count = jax.device_get((pred, count))
+                emitted_total = 0
                 for slot in active_slots:
                     emitted = [int(x) for x in pred[slot, : int(count[slot])]]
                     # the device advanced this slot's cache length by
@@ -899,6 +959,11 @@ class ServingEngine:
                     req = self._slot_req[slot]
                     for tok in emitted:
                         req.out.put(tok)
+                    # acceptance accounting uses DELIVERED tokens (post-eos
+                    # truncation): the device's raw count includes tokens
+                    # past eos nobody receives
+                    emitted_total += len(emitted)
+                    self._stats["generated_tokens"] += len(emitted)
                     self._slot_budget[slot] -= len(emitted)
                     self._history[slot].extend(emitted)
                     if emitted:
@@ -908,17 +973,31 @@ class ServingEngine:
                         or (emitted and emitted[-1] == eos)
                     ):
                         self._retire(slot)
+                self._stats["spec_ticks"] += 1
+                self._stats["spec_slot_ticks"] += len(active_slots)
+                self._stats["spec_emitted"] += emitted_total
+                # per-slot EMA drives the adaptive gate: below breakeven,
+                # stop paying for verification
+                self._spec_ema = (
+                    0.9 * self._spec_ema
+                    + 0.1 * emitted_total / max(len(active_slots), 1)
+                )
+                if (self.serving.spec_min_mean
+                        and self._spec_ema < self.serving.spec_min_mean):
+                    self._spec_cooloff = self.serving.spec_cooloff_ticks
                 continue
             logits, self.state = self._decode(
                 self.params, self.state, tokens, active, kv_bucket,
                 unroll=self._unroll,
             )
+            self._stats["decode_ticks"] += 1
             for slot in active_slots:
                 tok = self.sample(logits[slot])
                 self._tokens[slot] = tok
                 self._slot_len[slot] += 1
                 req = self._slot_req[slot]
                 req.out.put(tok)
+                self._stats["generated_tokens"] += 1
                 self._slot_budget[slot] -= 1
                 if self._spec_tokens:
                     self._history[slot].append(tok)
