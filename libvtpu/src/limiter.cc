@@ -44,7 +44,8 @@ uint64_t DutyCycleLimiter::admit(uint64_t now_ns, uint64_t* precharge_ns) {
     // window's budget, so an estimate above the cap (e.g. queue latency on
     // a deep pipeline leaking into the EMA) would otherwise spin forever.
     int64_t burst_cap = (int64_t)(window_ns_ * limit_percent_ / 100);
-    int64_t need = (int64_t)est_ns_ < burst_cap ? (int64_t)est_ns_ : burst_cap;
+    int64_t est = (int64_t)est_ns_.load(std::memory_order_relaxed);
+    int64_t need = est < burst_cap ? est : burst_cap;
     // Floor at 1 ns: a zero pre-charge reads as "unenforced" to settle(),
     // which would let an enforced execution whose EMA decayed to 0 skip its
     // busy-time debit entirely.
@@ -79,7 +80,8 @@ void DutyCycleLimiter::settle(uint64_t busy_ns, uint64_t now_ns,
     tokens_ns_ += (int64_t)precharge_ns;
     tokens_ns_ -= (int64_t)busy_ns;
   }
-  est_ns_ = (est_ns_ * 7 + busy_ns) / 8;  // EMA, 1/8 weight
+  est_ns_.store((est_ns_.load(std::memory_order_relaxed) * 7 + busy_ns) / 8,
+                std::memory_order_relaxed);  // EMA, 1/8 weight
   accum_busy(busy_ns, now_ns);
 }
 
@@ -158,7 +160,8 @@ void DutyCycleLimiter::settle_interval(uint64_t start_ns, uint64_t end_ns,
   // The EMA tracks the union-charged (device-attributed) cost, NOT the raw
   // submit->ready latency: on a deep pipeline raw includes the whole queue
   // wait and would ratchet the estimate far past the admit burst budget.
-  est_ns_ = (est_ns_ * 7 + charged) / 8;
+  est_ns_.store((est_ns_.load(std::memory_order_relaxed) * 7 + charged) / 8,
+                std::memory_order_relaxed);
   accum_busy(charged, end_ns);
 }
 

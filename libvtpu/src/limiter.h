@@ -12,6 +12,7 @@
 #ifndef VTPU_LIMITER_H_
 #define VTPU_LIMITER_H_
 
+#include <atomic>
 #include <cstdint>
 #include <mutex>
 
@@ -56,7 +57,11 @@ class DutyCycleLimiter {
 
   int current_util_percent(uint64_t now_ns);
 
-  uint64_t estimate_ns() const { return est_ns_; }
+  uint64_t estimate_ns() const {
+    // stats reads race the locked writers by design; atomic keeps the
+    // unlocked read defined (torn 64-bit reads are UB, not just stale)
+    return est_ns_.load(std::memory_order_relaxed);
+  }
 
  private:
   void refill(uint64_t now_ns);
@@ -83,7 +88,9 @@ class DutyCycleLimiter {
   std::mutex mu_;
   int64_t tokens_ns_ = 0;     // accrued busy allowance (may go negative)
   uint64_t last_refill_ns_ = 0;
-  uint64_t est_ns_ = 1'000'000ull;  // 1ms initial per-execute estimate
+  // 1ms initial per-execute estimate; atomic for the lock-free stats read
+  // (writers all hold mu_, so relaxed ordering suffices)
+  std::atomic<uint64_t> est_ns_{1'000'000ull};
   // recent-busy tracking for util reporting
   uint64_t busy_accum_ns_ = 0;
   uint64_t busy_epoch_ns_ = 0;

@@ -37,6 +37,27 @@ static std::atomic<uint64_t>* as_atomic(uint64_t* p) {
 static std::atomic<int32_t>* as_atomic(int32_t* p) {
   return reinterpret_cast<std::atomic<int32_t>*>(p);
 }
+static std::atomic<uint32_t>* as_atomic(uint32_t* p) {
+  return reinterpret_cast<std::atomic<uint32_t>*>(p);
+}
+// Every post-init access to a region field shared with other threads (settle
+// callbacks arrive on detached threads) or the monitor process goes through
+// relaxed atomics: the values are monotonic counters / latest-wins stamps, so
+// relaxed ordering is enough, but plain mixed-thread accesses would be data
+// races (UB the tsan tier rejects), not merely stale reads.
+static uint64_t ld(const uint64_t& f) {
+  return as_atomic(const_cast<uint64_t*>(&f))->load(std::memory_order_relaxed);
+}
+static int32_t ld(const int32_t& f) {
+  return as_atomic(const_cast<int32_t*>(&f))->load(std::memory_order_relaxed);
+}
+static uint32_t ld(const uint32_t& f) {
+  return as_atomic(const_cast<uint32_t*>(&f))->load(std::memory_order_relaxed);
+}
+template <typename T, typename V>
+static void st(T& f, V v) {
+  as_atomic(&f)->store((T)v, std::memory_order_relaxed);
+}
 
 Region* Region::open(const std::string& path, int priority) {
   if (path.empty()) return nullptr;
@@ -132,8 +153,13 @@ void Region::add_used(size_t index, int64_t delta) {
   if (!region_ || index >= VTPU_MAX_DEVICES) return;
   auto& slot = region_->devices[index];
   uint64_t now = as_atomic(&slot.hbm_used_bytes)->fetch_add(delta) + delta;
-  uint64_t peak = slot.hbm_peak_bytes;
-  if (now > peak) slot.hbm_peak_bytes = now;
+  // CAS max: concurrent settle threads must not let a lower peak overwrite a
+  // higher one (plain read-then-write lost that race)
+  auto* peak = as_atomic(&slot.hbm_peak_bytes);
+  uint64_t seen = peak->load(std::memory_order_relaxed);
+  while (now > seen &&
+         !peak->compare_exchange_weak(seen, now, std::memory_order_relaxed)) {
+  }
   if (pid_slot_ >= 0) {
     as_atomic(&region_->procs[pid_slot_].hbm_used_bytes[index])->fetch_add(delta);
   }
@@ -142,30 +168,32 @@ void Region::add_used(size_t index, int64_t delta) {
 void Region::record_kernel(size_t index, uint64_t wait_ns) {
   if (!region_ || index >= VTPU_MAX_DEVICES) return;
   auto& slot = region_->devices[index];
-  slot.last_kernel_ns = now_ns();
+  uint64_t now = now_ns();
+  st(slot.last_kernel_ns, now);
   as_atomic(&slot.kernel_count)->fetch_add(1);
   as_atomic(&slot.throttle_wait_ns)->fetch_add(wait_ns);
   // consume one unit of monitor credit (priority scheme: monitor refills)
-  int32_t rk = region_->recent_kernel;
+  int32_t rk = ld(region_->recent_kernel);
   if (rk > 0) as_atomic(&region_->recent_kernel)->fetch_sub(1);
-  region_->heartbeat_ns = slot.last_kernel_ns;
+  st(region_->heartbeat_ns, now);
 }
 
 void Region::set_core_util(size_t index, int percent) {
   if (!region_ || index >= VTPU_MAX_DEVICES) return;
-  region_->devices[index].core_util_percent = percent;
+  st(region_->devices[index].core_util_percent, percent);
 }
 
 void Region::heartbeat() {
-  if (region_) region_->heartbeat_ns = now_ns();
+  if (region_) st(region_->heartbeat_ns, now_ns());
 }
 
 bool Region::blocked() const {
-  return region_ && region_->recent_kernel < 0 && region_->priority <= 0;
+  return region_ && ld(region_->recent_kernel) < 0 &&
+         ld(region_->priority) <= 0;
 }
 
 bool Region::utilization_enforced() const {
-  return !region_ || region_->utilization_switch != 0;
+  return !region_ || ld(region_->utilization_switch) != 0;
 }
 
 // A monitor that has not touched its heartbeat for this long is presumed
@@ -198,14 +226,14 @@ uint64_t Region::gate_wait(bool* forced) {
   for (;;) {
     if (!blocked()) break;
     uint64_t elapsed = mono_ns() - start_mono;
-    uint32_t timeout_ms = region_->gate_timeout_ms;
+    uint32_t timeout_ms = ld(region_->gate_timeout_ms);
     if (timeout_ms != 0 && elapsed >= (uint64_t)timeout_ms * 1000000ull) {
       *forced = true;
       break;
     }
     // Liveness: a monitor that ever heartbeat must keep doing so; pre-v2
     // monitors never write one, so fall back to time-blocked-so-far.
-    uint64_t hb = region_->monitor_heartbeat_ns;
+    uint64_t hb = ld(region_->monitor_heartbeat_ns);
     uint64_t now_rt = now_ns();
     bool stale = hb != 0 ? (now_rt > hb && now_rt - hb > gate_stale_ns())
                          : elapsed > gate_stale_ns();
@@ -220,19 +248,19 @@ uint64_t Region::gate_wait(bool* forced) {
   as_atomic(&region_->gate_blocked_ns)->fetch_add(blocked_ns);
   if (*forced) {
     as_atomic(&region_->gate_forced_releases)->fetch_add(1);
-    uint64_t hb = region_->monitor_heartbeat_ns;
+    uint64_t hb = ld(region_->monitor_heartbeat_ns);
     uint64_t now_rt = now_ns();
     if (hb != 0 && now_rt > hb) {
       VTPU_WARN("priority gate released without unblock after %llu ms "
                 "(timeout_ms=%u, monitor heartbeat age %llu ms)",
                 (unsigned long long)(blocked_ns / 1000000ull),
-                region_->gate_timeout_ms,
+                ld(region_->gate_timeout_ms),
                 (unsigned long long)((now_rt - hb) / 1000000ull));
     } else {
       VTPU_WARN("priority gate released without unblock after %llu ms "
                 "(timeout_ms=%u, monitor never heartbeat)",
                 (unsigned long long)(blocked_ns / 1000000ull),
-                region_->gate_timeout_ms);
+                ld(region_->gate_timeout_ms));
     }
   }
   return blocked_ns;

@@ -863,7 +863,6 @@ PJRT_Error* timed_real_upload(PJRT_Client_BufferFromHostBuffer_Args* args) {
 }
 
 PJRT_Error* wrapped_buffer_from_host(PJRT_Client_BufferFromHostBuffer_Args* args) {
-  auto& s = S();
   stats().uploads.fetch_add(1, std::memory_order_relaxed);
   ScopedNs total_timer(stats().upload_ns);
   uint64_t est = estimate_bytes(args->type, args->dims, args->num_dims);
@@ -1173,7 +1172,16 @@ PJRT_Error* wrapped_buffer_destroy(PJRT_Buffer_Destroy_Args* args) {
     if (it != s.buffers.end()) {
       dev_idx = it->second.first;
       bytes = it->second.second;
+#ifdef VTPU_SEEDED_UAF
+      // Sanitizer-tier control build ONLY (`make asan-seeded`): read the
+      // map entry after erase() frees its node — the exact use-after-free a
+      // racing Buffer_Destroy would produce. The tier must flag this.
+      auto* entry = &it->second;
       s.buffers.erase(it);
+      bytes = entry->second;
+#else
+      s.buffers.erase(it);
+#endif
       auto& dev = s.dev(dev_idx);
       dev.used_bytes = dev.used_bytes >= bytes ? dev.used_bytes - bytes : 0;
     }
@@ -1599,30 +1607,53 @@ static const PJRT_Api* trampoline_get_pjrt_api() {
 
 typedef void* (*DlsymFn)(void*, const char*);
 
+// The interposed dlsym (and everything it calls before the real symbol is
+// resolved) can run EARLIER than any runtime in the process is ready for:
+// sanitizer runtimes in particular call dlsym during their own init, before
+// shadow memory exists, and bind to THIS definition. So the whole path is
+// (a) uninstrumented (no_sanitize) and (b) libc-interceptor-free — no
+// strcmp, no C++ static-guard lambda, only dlvsym + __atomic builtins.
+__attribute__((no_sanitize("address", "undefined")))
 static DlsymFn real_dlsym_resolver() {
-  static DlsymFn real = []() -> DlsymFn {
-    // dlvsym is itself safe to call; glibc symbol versions vary by arch.
-    for (const char* ver :
-         {"GLIBC_2.2.5", "GLIBC_2.17", "GLIBC_2.27", "GLIBC_2.34",
-          "GLIBC_2.4", "GLIBC_2.0"}) {
-      if (void* p = dlvsym(RTLD_NEXT, "dlsym", ver)) return (DlsymFn)p;
+  static DlsymFn real = nullptr;  // idempotent resolution; relaxed atomics
+  DlsymFn cached = __atomic_load_n(&real, __ATOMIC_RELAXED);
+  if (cached != nullptr) return cached;
+  // dlvsym is itself safe to call; glibc symbol versions vary by arch.
+  static const char* const kVers[] = {"GLIBC_2.2.5", "GLIBC_2.17",
+                                      "GLIBC_2.27",  "GLIBC_2.34",
+                                      "GLIBC_2.4",   "GLIBC_2.0"};
+  for (const char* ver : kVers) {
+    if (void* p = dlvsym(RTLD_NEXT, "dlsym", ver)) {
+      __atomic_store_n(&real, (DlsymFn)p, __ATOMIC_RELAXED);
+      return (DlsymFn)p;
     }
-    // Silently breaking every dlsym in the process would be far worse than
-    // crashing loudly: bail with an actionable message (use the plugin-
-    // shadowing delivery instead of LD_PRELOAD on this libc).
-    std::fprintf(stderr,
-                 "[libvtpu] FATAL: cannot resolve the real dlsym on this libc; "
-                 "remove libvtpu from LD_PRELOAD and use TPU_LIBRARY_PATH="
-                 "libvtpu.so with VTPU_REAL_LIBTPU instead\n");
-    std::abort();
-  }();
-  return real;
+  }
+  // Silently breaking every dlsym in the process would be far worse than
+  // crashing loudly: bail with an actionable message (use the plugin-
+  // shadowing delivery instead of LD_PRELOAD on this libc).
+  std::fprintf(stderr,
+               "[libvtpu] FATAL: cannot resolve the real dlsym on this libc; "
+               "remove libvtpu from LD_PRELOAD and use TPU_LIBRARY_PATH="
+               "libvtpu.so with VTPU_REAL_LIBTPU instead\n");
+  std::abort();
 }
 
+__attribute__((no_sanitize("address", "undefined")))
+static bool is_get_pjrt_api(const char* name) {
+  // manual compare: libc strcmp may be sanitizer-intercepted and this can
+  // run before that runtime is initialized
+  static const char kTarget[] = "GetPjrtApi";
+  if (name == nullptr) return false;
+  size_t i = 0;
+  while (kTarget[i] != '\0' && name[i] == kTarget[i]) i++;
+  return kTarget[i] == '\0' && name[i] == '\0';
+}
+
+__attribute__((no_sanitize("address", "undefined")))
 void* dlsym(void* handle, const char* name) {
   DlsymFn real = real_dlsym_resolver();
   void* sym = real(handle, name);
-  if (name != nullptr && std::strcmp(name, "GetPjrtApi") == 0 && sym != nullptr) {
+  if (is_get_pjrt_api(name) && sym != nullptr) {
     // Do not re-wrap our own export (delivery B handles itself).
     if (sym == (void*)&GetPjrtApi) return sym;
     g_real_get_pjrt_api = (GetPjrtApiFn)sym;

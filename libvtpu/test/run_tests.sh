@@ -4,7 +4,11 @@
 # shadowing via VTPU_REAL_LIBTPU) plus cap, release, throttle and region.
 set -euo pipefail
 cd "$(dirname "$0")/.."
-B=build
+# B selects the artifact dir (build, build/asan, ...). ASAN_PRELOAD, when the
+# asan tier sets it, preloads the sanitizer runtime ahead of libvtpu.so in the
+# LD_PRELOAD delivery test (the runtime must come first in the initial
+# library list; the plugin-shadowing delivery needs nothing special).
+B=${B:-build}
 TMP=$(mktemp -d)
 trap 'rm -rf "$TMP"' EXIT
 
@@ -30,7 +34,8 @@ result_field "$TMP/capb.out" alloc_error | grep -q "HBM limit exceeded" || fail 
 [ "$(result_field "$TMP/capb.out" realloc_ok)" = 1 ] || fail "cap B realloc after free"
 
 echo "== 3. delivery A (LD_PRELOAD): same caps via dlsym interposition =="
-env LD_PRELOAD=$PWD/$B/libvtpu.so TPU_DEVICE_MEMORY_LIMIT_0=256m \
+env LD_PRELOAD="${ASAN_PRELOAD:+$ASAN_PRELOAD:}$PWD/$B/libvtpu.so" \
+    TPU_DEVICE_MEMORY_LIMIT_0=256m \
     $B/pjrt_smoke $B/fake_pjrt.so 64 10 0 > "$TMP/capa.out"
 [ "$(result_field "$TMP/capa.out" allocated)" = 4 ] || fail "cap A alloc count"
 result_field "$TMP/capa.out" alloc_error | grep -q "code=8" || fail "cap A code"

@@ -149,3 +149,43 @@ def test_registrar_withdraws_stale_scores_when_probing_disabled(monkeypatch):
     annos = annotations(client.get_node("n1"))
     assert t.NODE_DCN_ANNO not in annos
     assert t.NODE_DCN_ENDPOINT_ANNO not in annos
+
+
+def test_fresh_prober_clears_predecessors_stale_annotation():
+    """A prober that starts and measures ZERO peers must still clear a
+    stale vtpu.io/node-dcn left by a crashed predecessor — its very first
+    publish writes unconditionally (stale-good is worse than unknown).
+    Subsequent empty publishes are then no-ops as before."""
+    client = FakeKubeClient()
+    client.put_node({"metadata": {"name": "n1", "annotations": {
+        t.NODE_DCN_ANNO: "ghost-peer,9000,100"}}})
+    prober = DcnProber(client, "n1", samples=1)
+    assert prober.publish({}) is True  # first publish: withdraw stale scores
+    assert annotations(client.get_node("n1")).get(t.NODE_DCN_ANNO) is None
+    assert prober.publish({}) is False  # steady-state: no repeated patching
+
+
+def test_scheduler_logs_bad_dcn_annotation_once(caplog):
+    """A malformed vtpu.io/node-dcn is parsed (and exception-logged) once
+    per distinct value, not on every register pass."""
+    import logging
+
+    from tests.helpers import fake_cluster, register_tpu_backend, v5e_devices
+    from vtpu.scheduler.scheduler import Scheduler
+
+    register_tpu_backend()
+    client = fake_cluster({"nodeA": v5e_devices(4)})
+    client.patch_node_annotations("nodeA", {t.NODE_DCN_ANNO: "not,valid"})
+    sched = Scheduler(client)
+    with caplog.at_level(logging.ERROR):
+        sched.register_from_node_annotations()
+        sched.register_from_node_annotations()
+    bad = [r for r in caplog.records if "bad dcn annotation" in r.message]
+    assert len(bad) == 1
+    # a NEW distinct bad value is logged again (once)
+    client.patch_node_annotations("nodeA", {t.NODE_DCN_ANNO: "also,bad,x,y"})
+    with caplog.at_level(logging.ERROR):
+        sched.register_from_node_annotations()
+        sched.register_from_node_annotations()
+    bad = [r for r in caplog.records if "bad dcn annotation" in r.message]
+    assert len(bad) == 2

@@ -750,6 +750,42 @@ def test_prefix_cache_composes_with_speculation(params):
     assert run(4) == run(0)
 
 
+def test_unregister_prefix_releases_and_raced_submit_fails_softly(params):
+    """unregister_prefix drops the pinned KV entry (long-lived engines with
+    rotating system prompts must not leak device memory); a submit that
+    raced past validation before the unregister retires with end-of-stream
+    instead of killing the serving loop; the per-pad install executables
+    survive so re-registration at the same pad does not recompile."""
+    serving = ServingConfig(slots=2, prefill_buckets=(16,),
+                            max_new_tokens=6, prefill_chunk=16)
+    pre = [5, 6, 7, 8] * 4
+    eng = ServingEngine(params, CFG, serving)
+    try:
+        pid = eng.register_prefix(pre)
+        jits_before = dict(eng._install_jits)
+        # race shape: submitted (validated) while registered, admitted after
+        # unregister — the engine loop has not started yet, so the request
+        # is still queued when the prefix disappears
+        raced = eng.submit([5, 6], max_new_tokens=6, prefix=pid)
+        eng.unregister_prefix(pid)
+        assert eng._prefixes == {}
+        with pytest.raises(ValueError, match="unknown prefix"):
+            eng.unregister_prefix(pid)
+        with pytest.raises(ValueError, match="unknown prefix"):
+            eng.submit([1], prefix=pid)
+        eng.start()
+        assert list(raced.stream()) == []  # unserved, not a hang or a crash
+        # the loop survived: re-register at the same pad (no recompile) and
+        # serve a normal prefix request end-to-end
+        pid2 = eng.register_prefix(pre)
+        assert all(eng._install_jits[pad] is exe
+                   for pad, exe in jits_before.items())
+        got = list(eng.submit([5, 6], max_new_tokens=6, prefix=pid2).stream())
+        assert len(got) == 6
+    finally:
+        eng.stop()
+
+
 def test_spec_adaptive_gate_and_stats(params):
     """Below-breakeven acceptance pauses drafting (cooloff), the cooloff
     expiry re-probes with an optimistic EMA, and stats() reports the

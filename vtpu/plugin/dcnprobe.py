@@ -53,6 +53,9 @@ MAX_PAYLOAD = 64 << 20
 # an apiserver write on every node.
 TOLERANCE = 0.25
 
+# "Never published by this process" marker — see Prober.__init__.
+_NEVER_PUBLISHED = object()
+
 
 def _recv_exact(conn: socket.socket, n: int) -> bytes:
     buf = bytearray()
@@ -153,7 +156,11 @@ class DcnProber:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._published: dict[str, DcnScore] = {}
-        self._published_raw: str | None = None
+        # Distinct from None: None means "we published a withdrawal"; the
+        # sentinel means "this process has never patched at all", so the
+        # first publish always writes — clearing any stale-good scores a
+        # crashed predecessor left behind (stale-good is worse than unknown).
+        self._published_raw: str | None | object = _NEVER_PUBLISHED
 
     # ----------------------------------------------------------- discovery
 
@@ -239,10 +246,11 @@ class DcnProber:
     def publish(self, scores: dict[str, DcnScore]) -> bool:
         """Patch the annotation unless the fresh sample is just jitter around
         what is already published. Returns whether a patch was written."""
-        if self._published_raw is not None and self._within_tolerance(scores):
+        first = self._published_raw is _NEVER_PUBLISHED
+        if not first and self._published_raw is not None and self._within_tolerance(scores):
             return False
         raw = encode_dcn_scores([scores[p] for p in sorted(scores)]) or None
-        if raw == self._published_raw:
+        if not first and raw == self._published_raw:
             return False
         self.client.patch_node_annotations(self.node_name, {t.NODE_DCN_ANNO: raw})
         self._published = dict(scores)

@@ -286,9 +286,12 @@ class Scheduler:
                         self.node_manager.set_node_dcn(
                             name, decode_dcn_scores(dcn_anno) if dcn_anno else {}
                         )
-                        self._dcn_seen[name] = dcn_anno
                     except ValueError:
                         log.exception("bad dcn annotation on %s", name)
+                    # Record the raw string either way: a malformed value
+                    # should be logged once per distinct value, not re-parsed
+                    # and re-logged on every register pass.
+                    self._dcn_seen[name] = dcn_anno
 
     # ----------------------------------------------------------------- usage
 

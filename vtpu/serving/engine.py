@@ -558,6 +558,19 @@ class ServingEngine:
             }
         return pid
 
+    def unregister_prefix(self, pid: int) -> None:
+        """Drop a registered prefix, releasing its pinned device KV buffers
+        ([L,1,pad,H,Dh] per plane). Long-lived engines serving rotating
+        system prompts would otherwise leak device memory one prefix at a
+        time. The per-pad install executables are deliberately kept: they
+        are keyed by padded length (bounded set), not by prefix, and the
+        next registration at the same pad reuses them. A request submitted
+        against *pid* but not yet admitted when this runs retires with an
+        end-of-stream instead of killing the serving loop."""
+        with self._prefix_lock:
+            if self._prefixes.pop(pid, None) is None:
+                raise ValueError(f"unknown prefix id {pid}")
+
     def _compile_install(self, pad: int, buffers: dict) -> None:
         """AOT-compile the per-padded-length install executable HERE, on the
         registering caller's thread (jax.jit's own shape-keyed cache would
@@ -583,10 +596,11 @@ class ServingEngine:
             .compile()
         )
 
-    def _install_prefix(self, slot: int, pid: int) -> None:
+    def _install_prefix(self, slot: int, entry: dict) -> None:
         """Copy a registered prefix's KV into *slot* (one fused device op,
-        pre-compiled at registration)."""
-        entry = self._prefixes[pid]
+        pre-compiled at registration). Takes the caller's captured entry —
+        re-looking it up by id here would reopen the unregister_prefix race
+        the caller's .get() guard just closed."""
         self.state = self._install_jits[entry["pad"]](
             self.state, entry["buffers"], jnp.int32(slot),
             jnp.int32(entry["len"]))
@@ -679,8 +693,15 @@ class ServingEngine:
         prompt = req.tokens
         n = int(prompt.shape[0])
         if req.prefix is not None:
-            entry = self._prefixes[req.prefix]
-            self._install_prefix(slot, req.prefix)
+            entry = self._prefixes.get(req.prefix)
+            if entry is None:
+                # unregister_prefix raced with this submit: fail just this
+                # request (end-of-stream), never the loop serving everyone
+                log.warning("request references unregistered prefix %s; "
+                            "retiring it unserved", req.prefix)
+                req.out.put(None)
+                return
+            self._install_prefix(slot, entry)
             base = entry["len"]
             if n == 0:
                 # no suffix: the first token comes straight from the
@@ -753,8 +774,12 @@ class ServingEngine:
         self._tokens[slot] = first
         self._slot_len[slot] = n
         if self._spec_tokens:
-            pre = (self._prefixes[req.prefix]["tokens"]
-                   if req.prefix is not None else [])
+            # .get: the prefix may have been unregistered after this
+            # request's KV was installed — its copied cache stays valid,
+            # only the draft history loses the (optional) prefix tokens
+            entry = (self._prefixes.get(req.prefix)
+                     if req.prefix is not None else None)
+            pre = entry["tokens"] if entry else []
             self._history[slot] = (
                 pre + [int(x) for x in req.tokens.tolist()] + [first])
         self._stats["admissions"] += 1
