@@ -54,3 +54,63 @@ def test_causal_attention_respects_kv_len():
     got = causal_attention(q, k, v, kv_len=jnp.array([4, 4]))
     want = causal_attention(q, k[:, :4], v[:, :4], kv_len=jnp.array([4, 4]))
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-6)
+
+
+def test_decode_attention_matches_xla_paths():
+    """Pallas decode/verify kernel == the XLA reference on the same
+    operands: bf16 ragged, [B] T=1, and int8 with scale planes (the scales
+    post-matmul semantics must match causal_attention_int8kv exactly)."""
+    from vtpu.ops.attention import causal_attention_int8kv, decode_attention
+
+    rng = np.random.RandomState(3)
+    b, t, h, dh, s = 2, 4, 2, 128, 256
+    q = jnp.asarray(rng.randn(b, t, h, dh), jnp.float32)
+    k = jnp.asarray(rng.randn(b, s, h, dh), jnp.float32)
+    v = jnp.asarray(rng.randn(b, s, h, dh), jnp.float32)
+    lens = jnp.asarray([[5, 6, 7, 8], [200, 201, 202, 203]], jnp.int32)
+    want = causal_attention(q, k, v, kv_len=lens)
+    got = decode_attention(q, k, v, lens, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+    # [B] kv_len with T=1 (plain decode tick)
+    q1 = q[:, :1]
+    l1 = jnp.asarray([5, 200], jnp.int32)
+    want1 = causal_attention(q1, k, v, kv_len=l1)
+    got1 = decode_attention(q1, k, v, l1, interpret=True)
+    np.testing.assert_allclose(np.asarray(got1), np.asarray(want1), atol=2e-5)
+
+    # int8 KV + f32 scale planes
+    kq = jnp.asarray(rng.randint(-127, 128, (b, s, h, dh)), jnp.int8)
+    vq = jnp.asarray(rng.randint(-127, 128, (b, s, h, dh)), jnp.int8)
+    ks = jnp.asarray(rng.rand(b, s, h).astype(np.float32) * 0.02 + 1e-3)
+    vs = jnp.asarray(rng.rand(b, s, h).astype(np.float32) * 0.02 + 1e-3)
+    want8 = causal_attention_int8kv(q, kq, ks, vq, vs, kv_len=lens)
+    got8 = decode_attention(q, kq, vq, lens, ks, vs, interpret=True)
+    np.testing.assert_allclose(np.asarray(got8), np.asarray(want8), atol=2e-5)
+
+
+def test_decode_attention_multiblock_online_softmax():
+    """Windows larger than one S-block exercise the online accumulation
+    (runs at S=1024 -> two 512 blocks); equality with the single-shot XLA
+    softmax proves the rescaling bookkeeping."""
+    from vtpu.ops.attention import decode_attention
+
+    rng = np.random.RandomState(4)
+    b, t, h, dh, s = 2, 1, 2, 128, 1024
+    q = jnp.asarray(rng.randn(b, t, h, dh), jnp.float32)
+    k = jnp.asarray(rng.randn(b, s, h, dh), jnp.float32)
+    v = jnp.asarray(rng.randn(b, s, h, dh), jnp.float32)
+    lens = jnp.asarray([[700], [1024]], jnp.int32)
+    want = causal_attention(q, k, v, kv_len=lens)
+    got = decode_attention(q, k, v, lens, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+def test_decode_attention_rejects_multi_t_flat_lens():
+    from vtpu.ops.attention import decode_attention
+    import pytest
+
+    q = jnp.zeros((1, 2, 1, 128), jnp.float32)
+    k = jnp.zeros((1, 8, 1, 128), jnp.float32)
+    with pytest.raises(ValueError, match="ragged"):
+        decode_attention(q, k, k, jnp.asarray([4], jnp.int32), interpret=True)

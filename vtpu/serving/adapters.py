@@ -45,6 +45,16 @@ class TransformerSlotModel:
         else:
             from vtpu.parallel.sharding import shard_params
 
+            # tp serving runs the trunk under GSPMD auto-partitioning; a
+            # pallas_call there cannot be partitioned over the head-sharded
+            # cache (it would gather the full window per chip). Until the
+            # kernel is wrapped in shard_map, mesh serving pins the XLA
+            # decode attention — the single-chip engine keeps the kernel.
+            import dataclasses as _dc
+
+            if getattr(cfg, "decode_attn", None) == "auto":
+                self.cfg = cfg = _dc.replace(cfg, decode_attn="xla")
+
             extra = {a: n for a, n in mesh.shape.items() if a != "tp" and n != 1}
             if extra:
                 # decode ticks would replicate across every non-tp axis
