@@ -13,23 +13,39 @@ streaming client, HAMi stack vs native plugin — reference
 benchmarks/README.md:1-100).
 
 Because the tunneled platform's request latency drifts on the scale of
-minutes (measured 80->220 ms p50 across one session), phases are NOT run
-sequentially: all tenants boot and warm once, then measurement windows
-alternate in time —
+minutes (measured 80->220 ms p50 across one session; r4 driver run saw the
+exclusive baseline wander 113->159 ms ACROSS rounds), measurements are
+interleaved at the finest grain the process model allows (r5 methodology,
+VERDICT r4 weak #1/#2):
 
-  overhead windows:  native-exclusive block <-> stack-exclusive block
-                     (order alternated per round), so the with/without-
-                     libvtpu delta is drift-cancelled;
-  sharing windows:   the SAME four stacked tenants solo (one at a time) <->
-                     all four at once on open-loop arrival clocks (~1/8 duty
-                     each): per-session latency character (+-10% between
-                     tunnel sessions) cancels because every tenant is its
-                     own exclusive control.
+  overhead rounds:   micro-pairs of [native burst] <-> [stack burst], order
+                     alternated per pair, each burst followed by the
+                     process's OWN dispatch-RTT probes. The probe rides the
+                     same tunnel session as its TTFTs, so the per-session
+                     latency character (+-10% between sessions — the r4 A/B
+                     read uniformly "negative overhead" because the stack
+                     process had drawn a faster session) is subtracted out
+                     in the rtt-corrected estimator; drift within a round is
+                     bounded by the micro-pair span (~3 s, not ~15 s).
+  sharing rounds:    sub-cycles of [each stacked tenant solo] <-> [all four
+                     at once on open-loop arrival clocks (~1/8 duty each)]
+                     interleaved INSIDE the round, so the exclusive baseline
+                     is sampled across the same wall-clock window as the
+                     shared traffic it normalizes.
+  drift rejection:   a round whose exclusive-baseline samples disagree with
+                     each other (intra-round spread) or with the session
+                     median (inter-round drift) is discarded AND re-measured
+                     (bounded budget). The criteria read ONLY baseline data,
+                     never the degradation, so rejection cannot bias the
+                     sharing signal — it only refuses to blame the tunnel's
+                     weather on the product stack. Rejected rounds are
+                     published alongside the accepted ones.
 
 Prints exactly ONE JSON line on stdout:
-  {"metric": ..., "value": <p90 of per-round shared-vs-native degradations %
-   over >=10 sandwiched rounds — a robust "every round passes" bar, not a
-   median-lucky one>, "unit": "percent", "vs_baseline": <value / 5.0>,
+  {"metric": ..., "value": <p90 of accepted per-round shared-vs-exclusive
+   degradations % — a robust "every round passes" bar, not a median-lucky
+   one>, "unit": "percent", "vs_baseline": <value / 5.0>,
+   "degradation_p90_ci95": <bootstrap 95% CI on that p90>,
    "libvtpu_attribution": <per-execute wrapper-cost breakdown>, ...}
 """
 
@@ -152,6 +168,28 @@ def tenant_main(a: argparse.Namespace) -> None:
             pass
         return ttft, time.perf_counter() - t0
 
+    # Own-session dispatch-RTT probe: a trivial jitted matmul + D2H fetch
+    # through THIS process's PJRT client, i.e. the same tunnel session its
+    # TTFTs ride. The parent subtracts each arm's own probe median from its
+    # TTFT median so the per-session latency character (+-10% between
+    # sessions) cancels out of the native-vs-stack overhead estimate — the
+    # r4 A/B compared two different sessions and measured session luck, not
+    # wrapper cost (uniformly negative "overhead").
+    import jax.numpy as jnp
+
+    probe_x = jax.device_put(jnp.ones((256, 256), jnp.bfloat16
+                                      if backend == "tpu" else jnp.float32))
+    probe_f = jax.jit(lambda t: (t @ t).sum())
+    np.asarray(probe_f(probe_x))  # compile + warm
+
+    def probe_block(n: int) -> list[float]:
+        out = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            np.asarray(probe_f(probe_x))
+            out.append((time.perf_counter() - t0) * 1e3)
+        return out
+
     for _ in range(warmup):
         one_request()
     if os.environ.get("VTPU_BENCH_REGISTER") == "1":
@@ -167,6 +205,7 @@ def tenant_main(a: argparse.Namespace) -> None:
 
     # Block protocol: "RUN <n> <interval_ms> <stagger_ms>" -> n requests
     # (open-loop arrival clock when interval_ms > 0) -> "BLOCK {json}";
+    # "PROBE <n>" -> n dispatch-RTT probes -> "BLOCK {json}";
     # "BYE" -> drain and exit.
     import threading
 
@@ -174,6 +213,11 @@ def tenant_main(a: argparse.Namespace) -> None:
         parts = line.split()
         if not parts or parts[0] == "BYE":
             break
+        if parts[0] == "PROBE":
+            print("BLOCK " + json.dumps(
+                {"rank": a.rank, "probe_ms": probe_block(int(parts[1]))}),
+                flush=True)
+            continue
         _, n_s, interval_s, stagger_s = parts
         n, interval_ms, stagger_ms = int(n_s), float(interval_s), float(stagger_s)
         ttfts: list[float] = []
@@ -342,6 +386,12 @@ class Tenant:
         self.start_block(n, interval_ms, stagger_ms)
         return self.read_block()
 
+    def probe(self, n: int) -> list[float]:
+        """n dispatch-RTT samples (ms) through this tenant's own session."""
+        self.proc.stdin.write(f"PROBE {n}\n")
+        self.proc.stdin.flush()
+        return self.read_block()["probe_ms"]
+
     def close(self) -> None:
         self.stats: dict | None = None
         try:
@@ -371,32 +421,50 @@ class Tenant:
             self.errfile.close()
 
 
+def bootstrap_p90_ci(rounds: list[float], n_boot: int = 10000,
+                     seed: int = 20260731) -> tuple[float, float]:
+    """Percentile-bootstrap 95% CI on the p90-of-rounds statistic (resample
+    rounds with replacement, recompute the same order-statistic estimator).
+    Deterministic seed: the CI must be a property of the data, not the run."""
+    import random
+
+    rng = random.Random(seed)
+    n = len(rounds)
+    stats_: list[float] = []
+    for _ in range(n_boot):
+        sample = sorted(rng.choice(rounds) for _ in range(n))
+        stats_.append(sample[max(0, min(n - 1, round(0.9 * n) - 1))])
+    stats_.sort()
+    return (stats_[int(0.025 * n_boot)], stats_[min(n_boot - 1, int(0.975 * n_boot))])
+
+
 def main() -> None:
     wrap = wrap_available()
     log(f"stack-in-the-loop: wrap={'libvtpu' if wrap else 'UNAVAILABLE (plain)'}")
     rtt_before_ms = probe_dispatch_rtt_ms()
     log(f"dispatch RTT probe (start): {rtt_before_ms:.1f} ms")
-    # r3 robustness bar (VERDICT r2 weak #2): >=10 sandwiched sharing rounds
-    # and the headline is the p90 of per-round degradations (max also
-    # published) — a pass means essentially EVERY round under 5%, not a
-    # median-lucky one. p90 rather than max because single-round transport
-    # spikes (tunnel drift, see dispatch_rtt probes) are not chip contention.
-    # The A/B overhead estimator fights the same tunnel fluctuation as the
-    # sharing windows (observed -17..+8pp across identical runs with
-    # 8-sample blocks; per-round sigma ~8pp even at 16): 16-sample blocks
-    # over 11 ORDER-ALTERNATED rounds put the median's sigma at ~2.4pp.
-    # The steady-state truth is the attribution block (0 size RPCs,
-    # wrap_cost_per_execute_ms) — the A/B delta is its transport-noisy check.
-    overhead_rounds, block = (11, 16) if wrap else (2, 3)
-    sharing_rounds = 12 if wrap else 2
-    # Per-round degradation noise is dominated by the tunnel's TTFT
-    # fluctuation (sigma ~15 ms on a ~115 ms TTFT) divided by sqrt(samples):
-    # 8-sample base blocks gave per-round swings of +-10pp in BOTH directions
-    # on choppy nights. 16 base + 8-per-tenant shared samples cut the
-    # per-round sigma to ~3pp so a p90-of-rounds headline reflects sharing,
-    # not transport.
-    shared_block = 8 if wrap else 2
-    share_base_block = 16 if wrap else 3
+    # r3 robustness bar (VERDICT r2 weak #2): the headline is the p90 of
+    # per-round degradations (max also published) — a pass means essentially
+    # EVERY round under 5%, not a median-lucky one. p90 rather than max
+    # because single-round transport spikes are not chip contention.
+    # r5 (VERDICT r4 weak #1): rounds that fail the BASELINE-only drift
+    # checks are rejected and re-measured, and the headline carries a
+    # bootstrap CI, so one run's verdict is reproducible across tunnel
+    # weather instead of a coin flip (r4: driver 10.98% vs validation 2.91%
+    # from the same code).
+    if wrap:
+        overhead_target, overhead_extra = 10, 4
+        micro_pairs, micro_block, micro_probes = 4, 4, 5
+        share_target, share_extra = 14, 8
+        subcycles, solo_per_tenant, shared_per_tenant = 3, 2, 2
+    else:
+        overhead_target, overhead_extra = 2, 1
+        micro_pairs, micro_block, micro_probes = 2, 2, 2
+        share_target, share_extra = 2, 1
+        subcycles, solo_per_tenant, shared_per_tenant = 2, 1, 2
+    # Baseline-drift acceptance thresholds (see sharing_round below).
+    INTRA_SPREAD_MAX = 1.25
+    INTER_DRIFT_MAX = 0.20
 
     native = Tenant(rank=0, wrap=False, tag="native")
     # overhead windows use the exclusive-contract tenant (core=100); the
@@ -409,60 +477,118 @@ def main() -> None:
         for t in tenants:  # compile + warm everywhere before any window
             t.wait_ready()
 
-        # Overhead windows: native <-> stack-exclusive, drift-cancelled.
+        # ---- Overhead rounds: interleaved native<->stack micro-pairs. ----
+        # Each micro-pair runs a small burst on one arm then the other
+        # (order alternating per pair AND per round), each burst followed by
+        # that arm's OWN dispatch-RTT probes. Two estimators per pair:
+        #   raw:            (stk - nat) / nat on burst medians — includes
+        #                   whatever session luck separates the two
+        #                   processes' tunnel sessions;
+        #   rtt-corrected:  subtract each arm's own probe median from its
+        #                   burst median first, cancelling the per-session
+        #                   transport character to first order. This is the
+        #                   wrapper-cost estimate; raw is published so the
+        #                   correction is auditable.
         nat_ttfts: list[float] = []
         nat_totals: list[float] = []
         stk_ttfts: list[float] = []
+        # every measured round, accepted or not — the storm fallback below
+        # publishes these rather than placeholders
+        all_nat_ttfts: list[float] = []
+        all_nat_totals: list[float] = []
+        all_stk_ttfts: list[float] = []
         round_overheads: list[float] = []
-        for r in range(overhead_rounds):
-            # ALTERNATE block order per round: monotone drift inside a round
-            # then biases half the deltas up and half down, cancelling in
-            # the median (a fixed order turns steady drift into fake
-            # overhead — a full run measured +10% with 6/7 rounds positive)
-            if r % 2 == 0:
-                b = native.run_block(block)
-                stk = stack_x.run_block(block)["ttfts"]
-            else:
-                stk = stack_x.run_block(block)["ttfts"]
-                b = native.run_block(block)
-            nat_ttfts += b["ttfts"]
-            nat_totals += b["totals"]
-            stk_ttfts += stk
-            round_overheads.append(
-                (statistics.median(stk) - statistics.median(b["ttfts"]))
-                / statistics.median(b["ttfts"]) * 100.0
-            )
+        round_overheads_corrected: list[float] = []
+        overhead_rejected: list[dict] = []
+        measured = 0
+        while (len(round_overheads) < overhead_target
+               and measured < overhead_target + overhead_extra):
+            measured += 1
+            pair_raw: list[float] = []
+            pair_cor: list[float] = []
+            pair_nat_meds: list[float] = []
+            round_nat_ttfts: list[float] = []
+            round_nat_totals: list[float] = []
+            round_stk_ttfts: list[float] = []
+            for p in range(micro_pairs):
+                first_native = (p + measured) % 2 == 0
+                arms = []
+                for arm_native in ([True, False] if first_native else [False, True]):
+                    ten = native if arm_native else stack_x
+                    b = ten.run_block(micro_block)
+                    pr = ten.probe(micro_probes)
+                    arms.append((arm_native, b, statistics.median(pr)))
+                for arm_native, b, probe_med in arms:
+                    if arm_native:
+                        nat_med = statistics.median(b["ttfts"])
+                        nat_probe = probe_med
+                        round_nat_ttfts += b["ttfts"]
+                        round_nat_totals += b["totals"]
+                        backend = b["backend"]
+                    else:
+                        stk_med = statistics.median(b["ttfts"])
+                        stk_probe = probe_med
+                        round_stk_ttfts += b["ttfts"]
+                pair_nat_meds.append(nat_med)
+                pair_raw.append((stk_med - nat_med) / nat_med * 100.0)
+                pair_cor.append(
+                    ((stk_med - stk_probe / 1e3) - (nat_med - nat_probe / 1e3))
+                    / nat_med * 100.0)
+            all_nat_ttfts += round_nat_ttfts
+            all_nat_totals += round_nat_totals
+            all_stk_ttfts += round_stk_ttfts
+            spread = max(pair_nat_meds) / max(min(pair_nat_meds), 1e-9)
+            if spread > INTRA_SPREAD_MAX:
+                # the native arm's own medians disagree across the round —
+                # transport drift mid-round; re-measure (criterion reads
+                # only native data, never the A/B delta). The round's
+                # samples stay OUT of the published pools so the pooled
+                # p50s describe exactly the rounds the estimator used.
+                overhead_rejected.append({
+                    "native_medians_ms": [round(m * 1e3, 2) for m in pair_nat_meds],
+                    "spread": round(spread, 3),
+                    "raw_median": round(statistics.median(pair_raw), 2),
+                    "corrected_median": round(statistics.median(pair_cor), 2),
+                })
+                log(f"overhead round rejected (native spread {spread:.2f}x)")
+                continue
+            nat_ttfts += round_nat_ttfts
+            nat_totals += round_nat_totals
+            stk_ttfts += round_stk_ttfts
+            round_overheads.append(statistics.median(pair_raw))
+            round_overheads_corrected.append(statistics.median(pair_cor))
+        overhead_rejection_exhausted = False
+        if not round_overheads:
+            # same storm-fallback as the sharing phase: publish the rejected
+            # rounds' estimates, flagged, rather than crash with no artifact
+            log("overhead drift rejection exhausted; publishing all rounds")
+            overhead_rejection_exhausted = True
+            round_overheads = [r["raw_median"] for r in overhead_rejected]
+            round_overheads_corrected = [
+                r["corrected_median"] for r in overhead_rejected]
+            nat_ttfts, nat_totals = all_nat_ttfts, all_nat_totals
+            stk_ttfts = all_stk_ttfts
         p50_nat = statistics.median(nat_ttfts)
         p50_stk = statistics.median(stk_ttfts)
         overhead = statistics.median(round_overheads)
-        backend = b["backend"]
+        overhead_corrected = statistics.median(round_overheads_corrected)
         log(f"[{backend}] exclusive p50 TTFT: native {p50_nat * 1e3:.2f} ms, "
-            f"through-libvtpu {p50_stk * 1e3:.2f} ms (overhead {overhead:+.2f}%, "
-            f"per-round {[round(o, 2) for o in round_overheads]})")
+            f"through-libvtpu {p50_stk * 1e3:.2f} ms (overhead raw "
+            f"{overhead:+.2f}% / rtt-corrected {overhead_corrected:+.2f}%, "
+            f"per-round raw {[round(o, 2) for o in round_overheads]}, "
+            f"corrected {[round(o, 2) for o in round_overheads_corrected]})")
 
-        # Sharing windows: native-exclusive <-> 4 stacked tenants, SANDWICHED.
-        # Because drift WITHIN a round would otherwise land entirely on
-        # whichever block runs second, each shared block is compared to the
-        # mean of the exclusive blocks on BOTH sides of it (B0 S0 B1 S1 ...
-        # Bn); the headline aggregates the per-round paired degradations.
-        #
+        # ---- Sharing rounds: solo<->shared interleaved INSIDE the round. --
         # The exclusive baseline comes from the SAME four stack tenants
         # running SOLO (one at a time), not from the native tenant: every
-        # process gets its own tunnel session with its own latency character
-        # (±10% between sessions — an 11-round alternated A/B measured one
-        # session consistently 9% faster), so only a same-session baseline
-        # isolates SHARING from session pairing luck. The native tenant
-        # remains the overhead phase's unwrapped control only.
+        # process gets its own tunnel session with its own latency character,
+        # so only a same-session baseline isolates SHARING from session
+        # pairing luck. Each round is S sub-cycles of [4 tenants solo] then
+        # [all 4 shared, open-loop staggered arrivals], so baseline and
+        # shared samples cover the same wall-clock window — drift between
+        # them is bounded by a sub-cycle (~4 s), not a whole flanking block.
         interval_ms = DUTY_FACTOR * statistics.fmean(nat_totals) * 1000.0
-        solo_block = max(4, share_base_block // TENANTS)
 
-        def stacks_solo_block() -> list[float]:
-            # each tenant alone on the chip, back to back: the per-session
-            # exclusive baseline for exactly the sessions that then share
-            out: list[float] = []
-            for s in stacks:
-                out += s.run_block(solo_block)["ttfts"]
-            return out
         # One UNMEASURED warm-up shared window: the first concurrent window
         # pays one-off costs no later round sees (four processes' first
         # simultaneous dispatches re-priming the transport; observed as a
@@ -472,33 +598,100 @@ def main() -> None:
             s.start_block(2, interval_ms, i * interval_ms / TENANTS)
         for s in stacks:
             s.read_block()
-        base_ttfts: list[float] = []
-        shared_ttfts: list[float] = []
-        first_base = stacks_solo_block()
-        base_ttfts += first_base
-        base_medians: list[float] = [statistics.median(first_base)]
-        shared_medians: list[float] = []
-        for _ in range(sharing_rounds):
-            shared_r: list[float] = []
-            for i, s in enumerate(stacks):  # all 4 at once, staggered arrivals
-                s.start_block(shared_block, interval_ms, i * interval_ms / TENANTS)
-            for s in stacks:
-                shared_r += s.read_block()["ttfts"]
-            shared_ttfts += shared_r
-            shared_medians.append(statistics.median(shared_r))
-            base_r = stacks_solo_block()
-            base_ttfts += base_r
-            base_medians.append(statistics.median(base_r))
-        round_degradations = [
-            (sm - (base_medians[i] + base_medians[i + 1]) / 2.0)
-            / ((base_medians[i] + base_medians[i + 1]) / 2.0) * 100.0
-            for i, sm in enumerate(shared_medians)
-        ]
+
+        def sharing_round() -> dict:
+            solo: list[float] = []
+            shared: list[float] = []
+            sub_solo_medians: list[float] = []
+            for _ in range(subcycles):
+                sub: list[float] = []
+                for s in stacks:  # each tenant alone on the chip
+                    sub += s.run_block(solo_per_tenant)["ttfts"]
+                solo += sub
+                sub_solo_medians.append(statistics.median(sub))
+                for i, s in enumerate(stacks):  # all 4 at once, staggered
+                    s.start_block(shared_per_tenant, interval_ms,
+                                  i * interval_ms / TENANTS)
+                for s in stacks:
+                    shared += s.read_block()["ttfts"]
+            base_med = statistics.median(solo)
+            shared_med = statistics.median(shared)
+            return {
+                "solo": solo, "shared": shared,
+                "base_median": base_med, "shared_median": shared_med,
+                "sub_solo_medians": sub_solo_medians,
+                "degradation": (shared_med - base_med) / base_med * 100.0,
+            }
+
+        accepted: list[dict] = []
+        rejected: list[dict] = []
+        measured = 0
+        while (len(accepted) < share_target
+               and measured < share_target + share_extra):
+            measured += 1
+            r = sharing_round()
+            # Acceptance reads ONLY exclusive-baseline data (rejecting on
+            # the degradation itself would be cherry-picking):
+            #  (a) intra-round: the solo sub-cycle medians must agree within
+            #      INTRA_SPREAD_MAX (drift mid-round pollutes the pairing);
+            #  (b) inter-round: the round baseline must sit within
+            #      INTER_DRIFT_MAX of the running median of every baseline
+            #      measured so far (r4's 113->159 ms wander produced the
+            #      -14%/+12% phantom rounds).
+            spread = (max(r["sub_solo_medians"])
+                      / max(min(r["sub_solo_medians"]), 1e-9))
+            all_bases = [x["base_median"] for x in accepted + rejected] \
+                + [r["base_median"]]
+            session_base = statistics.median(all_bases)
+            drift = abs(r["base_median"] - session_base) / session_base
+            reason = None
+            if spread > INTRA_SPREAD_MAX:
+                reason = f"intra-round solo spread {spread:.2f}x"
+            elif len(all_bases) >= 4 and drift > INTER_DRIFT_MAX:
+                reason = (f"baseline {r['base_median'] * 1e3:.1f} ms drifted "
+                          f"{drift * 100:.0f}% off session median "
+                          f"{session_base * 1e3:.1f} ms")
+            if reason:
+                rejected.append({**r, "reason": reason})
+                log(f"sharing round rejected: {reason}")
+            else:
+                accepted.append(r)
+                log(f"sharing round {len(accepted)}: degradation "
+                    f"{r['degradation']:+.2f}% (base "
+                    f"{r['base_median'] * 1e3:.1f} ms)")
+        # Final pass of criterion (b) against the COMPLETE session: early
+        # rounds were judged against a partial median. Still baseline-only.
+        final_base = statistics.median(
+            [x["base_median"] for x in accepted + rejected])
+        kept: list[dict] = []
+        for r in accepted:
+            drift = abs(r["base_median"] - final_base) / final_base
+            if drift > INTER_DRIFT_MAX:
+                rejected.append({**r, "reason":
+                                 f"final-pass baseline drift {drift * 100:.0f}%"})
+                log(f"sharing round dropped in final pass (drift {drift * 100:.0f}%)")
+            else:
+                kept.append(r)
+        accepted = kept
+        rejection_exhausted = False
+        if not accepted:
+            # A session so stormy that every round failed the baseline
+            # checks: publish ALL rounds rather than nothing, flagged — a
+            # missing artifact hides the weather, a flagged one reports it.
+            log("drift rejection exhausted its budget; publishing all rounds")
+            rejection_exhausted = True
+            accepted = [dict(r) for r in rejected]
+
+        round_degradations = [r["degradation"] for r in accepted]
+        base_ttfts = [t for r in accepted for t in r["solo"]]
+        shared_ttfts = [t for r in accepted for t in r["shared"]]
+        base_medians = [r["base_median"] for r in accepted]
         p50_base = statistics.median(base_ttfts)
         p50_shared = statistics.median(shared_ttfts)
         log(f"sharing windows: exclusive p50 {p50_base * 1e3:.2f} ms, "
             f"{TENANTS}-way shared p50 {p50_shared * 1e3:.2f} ms over "
             f"{len(shared_ttfts)} requests at {interval_ms:.0f} ms arrival interval; "
+            f"accepted {len(accepted)} rounds, rejected {len(rejected)}; "
             f"per-round degradation {[round(d, 2) for d in round_degradations]}")
     finally:
         for t in tenants:
@@ -552,22 +745,33 @@ def main() -> None:
 
     srt = sorted(round_degradations)
     degradation = srt[max(0, min(len(srt) - 1, round(0.9 * len(srt)) - 1))]  # p90
+    ci_lo, ci_hi = bootstrap_p90_ci(round_degradations)
     print(json.dumps({
         "metric": "p90_round_ttft_degradation_4way_share_stack",
         "value": round(degradation, 2),
         "unit": "percent",
         "vs_baseline": round(degradation / 5.0, 3),
+        # bootstrap 95% CI on the p90-of-rounds statistic itself: the SLO
+        # claim is only as good as this interval's upper edge vs 5%
+        "degradation_p90_ci95": [round(ci_lo, 2), round(ci_hi, 2)],
+        "ci95_excludes_5pct": bool(ci_hi < 5.0),
         "stack_in_loop": wrap,
         "p50_ttft_exclusive_native_ms": round(p50_nat * 1e3, 2),
         "p50_ttft_exclusive_stack_ms": round(p50_stk * 1e3, 2),
         "p50_ttft_exclusive_in_sharing_windows_ms": round(p50_base * 1e3, 2),
         "p50_ttft_shared_ms": round(p50_shared * 1e3, 2),
+        # raw A/B straddles two tunnel sessions (its sign alone is not
+        # meaningful — r4 measured the shim uniformly "faster than native");
+        # the rtt-corrected estimator subtracts each arm's own probed
+        # session RTT and is the wrapper-cost claim
         "libvtpu_overhead_percent": round(overhead, 2),
-        # NOT (p50_stk-p50_nat)/p50_nat over the pooled fields below: pooled
-        # p50s straddle tunnel drift; the headline pairs each stack block
-        # with its adjacent native block and takes the median round delta
-        "overhead_estimator": "median_of_round_deltas",
+        "libvtpu_overhead_rtt_corrected_percent": round(overhead_corrected, 2),
+        "overhead_estimator": "median_of_interleaved_micropair_deltas",
         "libvtpu_overhead_per_round": [round(o, 2) for o in round_overheads],
+        "libvtpu_overhead_corrected_per_round": [
+            round(o, 2) for o in round_overheads_corrected],
+        "overhead_rounds_rejected": overhead_rejected or None,
+        "overhead_rejection_exhausted": overhead_rejection_exhausted,
         "libvtpu_attribution": attribution,
         "shared_tenant_throttle": shared_throttle,
         "tenants": TENANTS,
@@ -589,6 +793,16 @@ def main() -> None:
         # here are tunnel drift, not sharing (a spike round whose neighbors'
         # baselines also move is transport, not contention)
         "per_round_base_p50_ms": [round(m * 1e3, 2) for m in base_medians],
+        # drift-rejected rounds, published for audit: the criteria read only
+        # exclusive-baseline data (sub-cycle solo spread, session-median
+        # drift), never the degradation, so rejection refuses tunnel weather
+        # without being able to cherry-pick the sharing signal
+        "sharing_rounds_rejected": [
+            {"reason": r["reason"],
+             "base_p50_ms": round(r["base_median"] * 1e3, 2),
+             "degradation": round(r["degradation"], 2)}
+            for r in rejected] or None,
+        "drift_rejection_exhausted": rejection_exhausted,
         "max_round_degradation": round(max(round_degradations), 2),
         "median_round_degradation": round(statistics.median(round_degradations), 2),
         # sampled before tenants boot AND after the sharing windows: the
