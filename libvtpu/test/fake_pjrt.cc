@@ -8,8 +8,12 @@
 //   FAKE_PJRT_BUSY_FILE    while this path exists, ClientCreate fails
 //                          UNAVAILABLE — simulates an exclusive-attach
 //                          runtime whose chip another tenant holds
+//   FAKE_PJRT_SHARED_QUEUE mmap this file as the busy-until so separate
+//                          PROCESSES serialize on one emulated chip
 
+#include <fcntl.h>
 #include <string.h>
+#include <sys/mman.h>
 #include <time.h>
 #include <unistd.h>
 
@@ -97,6 +101,43 @@ void sleep_until(uint64_t deadline_ns) {
 // one exec_ns and wall-interval duty accounting would see a 2 ms device
 // for 100 ms of work.
 std::atomic<uint64_t> g_busy_until{0};
+
+// FAKE_PJRT_SHARED_QUEUE=<path>: back the busy-until with an mmap'd file so
+// SEPARATE PROCESSES serialize on the same emulated chip. This is the one
+// place same-chip co-tenancy is constructible on the dev rig (the session
+// pool schedules real-chip sessions onto disjoint chips —
+// CHIP_ISOLATION_r05.json), so the QoS-benefit experiment contends here.
+// CLOCK_MONOTONIC is comparable across processes on one host.
+static std::atomic<uint64_t>* busy_until() {
+  static std::atomic<uint64_t>* p = []() -> std::atomic<uint64_t>* {
+    const char* path = std::getenv("FAKE_PJRT_SHARED_QUEUE");
+    if (path == nullptr || *path == '\0') return &g_busy_until;
+    // failures fall back to the per-process queue, which would silently
+    // void any cross-process contention experiment — say so loudly
+    int fd = open(path, O_RDWR | O_CREAT, 0666);
+    if (fd < 0) {
+      fprintf(stderr, "[fake_pjrt] FAKE_PJRT_SHARED_QUEUE open(%s) failed; "
+                      "falling back to per-process queue\n", path);
+      return &g_busy_until;
+    }
+    if (ftruncate(fd, sizeof(uint64_t)) != 0) {
+      fprintf(stderr, "[fake_pjrt] FAKE_PJRT_SHARED_QUEUE ftruncate(%s) "
+                      "failed; falling back to per-process queue\n", path);
+      close(fd);
+      return &g_busy_until;
+    }
+    void* mem = mmap(nullptr, sizeof(uint64_t), PROT_READ | PROT_WRITE,
+                     MAP_SHARED, fd, 0);
+    close(fd);
+    if (mem == MAP_FAILED) {
+      fprintf(stderr, "[fake_pjrt] FAKE_PJRT_SHARED_QUEUE mmap(%s) failed; "
+                      "falling back to per-process queue\n", path);
+      return &g_busy_until;
+    }
+    return reinterpret_cast<std::atomic<uint64_t>*>(mem);
+  }();
+  return p;
+}
 
 [[maybe_unused]] static PJRT_Error* err(PJRT_Error_Code code, std::string msg) {
   return reinterpret_cast<PJRT_Error*>(new FakeError{code, std::move(msg)});
@@ -202,7 +243,7 @@ PJRT_Error* BufferToHost(PJRT_Buffer_ToHostBuffer_Args* args) {
   // bytes have to arrive). The shim charges duty off this event. Over an
   // emulated tunnel the client additionally pays the transport round trip
   // on top of the drain, exactly like the D2H walls observed in production.
-  uint64_t ready = g_busy_until.load();
+  uint64_t ready = busy_until()->load();
   uint64_t now = mono_ns();
   if (ready < now) ready = now;
   ready += transport_rtt_ns();  // drain first, then the bytes cross the wire
@@ -258,11 +299,11 @@ std::atomic<uint64_t> g_exec_count{0};
 PJRT_Error* Execute(PJRT_LoadedExecutable_Execute_Args* args) {
   g_exec_count.fetch_add(1);
   uint64_t now = mono_ns();
-  uint64_t start = g_busy_until.load();
+  uint64_t start = busy_until()->load();
   uint64_t done;
   do {
     done = (start > now ? start : now) + exec_ns();
-  } while (!g_busy_until.compare_exchange_weak(start, done));
+  } while (!busy_until()->compare_exchange_weak(start, done));
   if (args->device_complete_events != nullptr) {
     uint64_t ready = events_at_enqueue() ? now : done;
     for (size_t d = 0; d < args->num_devices; d++) {

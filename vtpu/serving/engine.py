@@ -492,6 +492,7 @@ class ServingEngine:
         self._stats = {"generated_tokens": 0, "decode_ticks": 0,
                        "spec_ticks": 0, "spec_slot_ticks": 0,
                        "spec_emitted": 0,
+                       "spec_emitted_hist": [0] * (serving.spec_tokens + 2),
                        "prefill_chunks": 0, "admissions": 0}
         # registered prompt prefixes: id -> {tokens, buffers, len, pad,
         # last_logits}; install is a device copy, suffixes chunk from the
@@ -813,6 +814,7 @@ class ServingEngine:
         Acceptance numbers are PER SLOT-TICK (delivered tokens / slot
         participations) — directly comparable to spec_min_mean."""
         s = dict(self._stats)
+        s["spec_emitted_hist"] = list(s["spec_emitted_hist"])
         s["mean_emitted_per_spec_tick"] = round(
             s["spec_emitted"] / s["spec_slot_ticks"], 3
         ) if s["spec_slot_ticks"] else None
@@ -988,6 +990,12 @@ class ServingEngine:
                     # truncation): the device's raw count includes tokens
                     # past eos nobody receives
                     emitted_total += len(emitted)
+                    # acceptance histogram: delivered tokens per (slot,
+                    # spec tick) — the measured distribution behind any
+                    # speedup claim (index 0 = slot emitted nothing usable)
+                    hist = self._stats["spec_emitted_hist"]
+                    bucket_i = min(len(emitted), len(hist) - 1)
+                    hist[bucket_i] += 1
                     self._stats["generated_tokens"] += len(emitted)
                     self._slot_budget[slot] -= len(emitted)
                     self._history[slot].extend(emitted)
