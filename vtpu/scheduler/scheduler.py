@@ -180,7 +180,16 @@ class Scheduler:
         onAddPod:138-168)."""
         annos = pod_annotations(pod)
         node = annos.get(t.ASSIGNED_NODE, "")
+        uid = pod.get("metadata", {}).get("uid", "")
         if not node:
+            # a tracked pod whose assignment was WITHDRAWN (stale-allocation
+            # cleanup patches the annotations away) must be evicted, not
+            # ignored: k8s watch order can deliver assign-then-withdraw
+            # MODIFIED events after our local cleanup already ran, and the
+            # assign event re-adds the entry — without this eviction the
+            # withdraw event would leave that reservation counted forever
+            if uid and self.pod_manager.has_pod(uid):
+                self.on_del_pod(pod)
             return
         if is_pod_deleted(pod):
             self.on_del_pod(pod)
@@ -189,10 +198,20 @@ class Scheduler:
             annos, {key: vendor for vendor, key in SUPPORT_DEVICES.items()}
         )
         if not devices:
+            if uid and self.pod_manager.has_pod(uid):
+                self.on_del_pod(pod)  # device annotations withdrawn: evict
             return
         uid = pod["metadata"]["uid"]
-        if not self.pod_manager.has_pod(uid):
-            self.pod_manager.add_pod(pod, node, devices)
+        # MODIFIED events re-ingest: add_pod overwrites the entry so
+        # annotation-derived fields (gang rank, slice id) track the cluster
+        # (reference onUpdatePod -> onAddPod, scheduler.go:170-172). A
+        # split-brain double-stamped rank arriving via the informer must be
+        # VISIBLE to _constrain_to_gang_slice's duplicate-rank refusal —
+        # the r5 churn fuzzer caught the stale-memory extension this
+        # prevents. Quota counts only on first sight.
+        is_new = not self.pod_manager.has_pod(uid)
+        self.pod_manager.add_pod(pod, node, devices)
+        if is_new:
             self.quota_manager.add_usage(pod, devices)
 
     def on_del_pod(self, pod: dict) -> None:
