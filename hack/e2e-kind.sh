@@ -7,6 +7,28 @@
 # .github/workflows/call-e2e.yaml (kind + mock plugin DaemonSet).
 set -euo pipefail
 
+ROOT="$(cd "$(dirname "$0")/.." && pwd)"
+
+# No kind/docker on this machine -> run the executable subset instead of
+# silently becoming dead code: the strict-apiserver stack drive
+# (webhook/filter/bind/Allocate/monitor over real HTTP + sockets) plus the
+# kubelet-protocol conformance harness (socket handshake, ListAndWatch
+# reconnect, Allocate ordering under restart) against the real plugin
+# binary. NEVER in CI: the cluster job (.github/workflows/e2e.yaml) exists
+# for the real thing, and a silent downgrade there would green-wash lost
+# coverage — fail loudly instead (VTPU_E2E_FALLBACK=1 overrides).
+if ! command -v kind >/dev/null 2>&1 || ! command -v docker >/dev/null 2>&1; then
+  if [ -n "${CI:-}" ] && [ "${VTPU_E2E_FALLBACK:-0}" != "1" ]; then
+    echo "FATAL: kind/docker missing on a CI runner; refusing the local" \
+         "fallback (set VTPU_E2E_FALLBACK=1 to override)" >&2
+    exit 1
+  fi
+  echo "kind/docker unavailable; running the vendored conformance phases" >&2
+  python3 "${ROOT}/hack/e2e_stack.py"
+  python3 "${ROOT}/hack/kubelet_conformance.py"
+  exit $?
+fi
+
 CLUSTER=${CLUSTER:-vtpu-e2e}
 IMAGE=${IMAGE:-vtpu:e2e}
 NS=${NS:-vtpu-system}

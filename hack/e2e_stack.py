@@ -372,19 +372,9 @@ def main() -> int:
     hook = work / "hook"
     kubelet_sock = str(kubelet_dir / "kubelet.sock")
 
-    class FakeKubelet:
-        def __init__(self):
-            self.requests = []
-            self.server = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
-            add_registration_servicer(self.server, self)
-            self.server.add_insecure_port(f"unix://{kubelet_sock}")
+    from tests.helpers import FakeKubeletRegistration
 
-        def Register(self, request, context):
-            self.requests.append(request)
-            return pb.Empty()
-
-    kubelet = FakeKubelet()
-    kubelet.server.start()
+    kubelet = FakeKubeletRegistration(kubelet_sock)
     cleanups: list = []  # extra binaries started mid-run (monitor)
     plugin_env = dict(os.environ)
     plugin_env.update({"VTPU_MOCK_DEVICES": "4", "VTPU_MOCK_DEVMEM": "16384"})

@@ -1,0 +1,246 @@
+"""Kubelet device-plugin conformance for the REAL plugin binary (VERDICT r4
+#7): the registration dance and allocation protocol a live kubelet drives,
+executed here against `python -m vtpu.plugin` because kind/docker are
+unavailable on this rig (hack/e2e-kind.sh falls back to this harness so its
+phases execute instead of sitting as dead code; the kind path remains the
+cluster job in .github/workflows/e2e.yaml).
+
+Conformance points (kubelet v1beta1 contract, reference
+pkg/device-plugin/nvidiadevice/nvinternal/plugin/server.go + register.go):
+  1. socket handshake — the plugin dials kubelet.sock and Registers
+     {version v1beta1, endpoint, resource} after creating its own socket
+  2. ListAndWatch — full device state on connect, and AGAIN on reconnect
+     (kubelet restarts drop the stream; the plugin must resend, not diff)
+  3. kubelet restart — kubelet.sock is recreated (new inode); the plugin's
+     socket watch must re-register without being restarted itself
+  4. Allocate ordering under plugin restart — kubelet issues ONE Allocate
+     per container; the node lock and bind-phase hold until every slot is
+     consumed, across a plugin crash+restart between the two calls
+
+Writes KUBELET_CONFORMANCE_r05.json.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import shutil
+import sys
+import time
+import grpc
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from vtpu.device import codec  # noqa: E402
+from vtpu.device.types import ContainerDevice  # noqa: E402
+from vtpu.plugin.api import deviceplugin_pb2 as pb  # noqa: E402
+from vtpu.plugin.api.grpc_api import DevicePluginStub  # noqa: E402
+from vtpu.util import nodelock  # noqa: E402
+from vtpu.util import types as t  # noqa: E402
+from vtpu.util.k8sclient import RealKubeClient  # noqa: E402
+
+from hack.e2e_stack import StrictApiserver  # noqa: E402
+
+NODE = "conformance-node"
+NS = "default"
+REGISTER_ANNO = "vtpu.io/node-tpu-register"
+IN_REQUEST_ANNO = "vtpu.io/tpu-devices-to-allocate"
+
+
+def wait_for(desc: str, fn, timeout: float = 60.0, alive=None):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if alive is not None:
+            alive()
+        if fn():
+            return
+        time.sleep(0.2)
+    raise AssertionError(f"timed out waiting for: {desc}")
+
+
+def main() -> int:
+    from tests.helpers import BinaryUnderTest, FakeKubeletRegistration
+
+    work = REPO / "build" / "kubelet_conformance"
+    if work.exists():
+        shutil.rmtree(work)
+    work.mkdir(parents=True)
+    phases: list[str] = []
+    checks: list[str] = []
+
+    def phase(name: str):
+        phases.append(name)
+        print(f"== {name} ==", file=sys.stderr, flush=True)
+
+    def check(desc: str, ok: bool):
+        assert ok, desc
+        checks.append(desc)
+
+    api = StrictApiserver()
+    api.put_node({"metadata": {"name": NODE, "annotations": {}, "labels": {}}})
+    client = RealKubeClient(base_url=f"http://127.0.0.1:{api.port}")
+    sock_dir = work / "dp"
+    sock_dir.mkdir()
+    hook = work / "hook"
+    kubelet_sock = str(sock_dir / "kubelet.sock")
+    kubelet = FakeKubeletRegistration(kubelet_sock)
+
+    env = dict(os.environ)
+    env.update({"VTPU_MOCK_DEVICES": "4", "VTPU_MOCK_DEVMEM": "16384"})
+    plugin_args = [
+        "--node-name", NODE, "--socket-dir", str(sock_dir),
+        "--kubelet-socket", kubelet_sock, "--hook-path", str(hook),
+        "--kube-api", f"http://127.0.0.1:{api.port}", "--register-interval", "1",
+    ]
+    plugin = BinaryUnderTest("vtpu.plugin", plugin_args, env=env)
+    try:
+        # ---- 1. socket handshake
+        wait_for("plugin registration", lambda: kubelet.requests,
+                 alive=plugin.alive)
+        reg = kubelet.requests[0]
+        check("handshake version is v1beta1", reg.version == "v1beta1")
+        check("handshake resource is google.com/tpu",
+              reg.resource_name == "google.com/tpu")
+        check("handshake endpoint names the plugin socket",
+              reg.endpoint == "vtpu.sock")
+        check("plugin socket exists before it registered",
+              os.path.exists(sock_dir / "vtpu.sock"))
+        phase("socket handshake (Register after plugin socket up)")
+
+        # ---- 2. ListAndWatch + reconnect
+        plugin_sock = f"unix://{sock_dir / 'vtpu.sock'}"
+        with grpc.insecure_channel(plugin_sock) as ch:
+            stream = DevicePluginStub(ch).ListAndWatch(pb.Empty(), timeout=20)
+            first = next(stream)
+            check("initial ListAndWatch carries the full device state",
+                  len(first.devices) == 16)  # 4 chips x split 4
+            check("all devices healthy",
+                  all(d.health == "Healthy" for d in first.devices))
+            ids = sorted(d.ID for d in first.devices)
+        # the channel close above IS the kubelet dropping the stream
+        with grpc.insecure_channel(plugin_sock) as ch:
+            again = next(DevicePluginStub(ch).ListAndWatch(pb.Empty(), timeout=20))
+            check("reconnect resends the complete state (not a diff)",
+                  sorted(d.ID for d in again.devices) == ids)
+        phase("ListAndWatch reconnect resends full state")
+
+        # ---- 3. kubelet restart: new socket inode -> plugin re-registers
+        seen = len(kubelet.requests)
+        kubelet.stop()
+        time.sleep(1.0)
+        kubelet = FakeKubeletRegistration(kubelet_sock)
+        wait_for("re-registration after kubelet restart",
+                 lambda: len(kubelet.requests) >= 1, alive=plugin.alive)
+        check("plugin re-registered with the restarted kubelet "
+              f"(had {seen} before)", kubelet.requests[0].endpoint == "vtpu.sock")
+        phase("kubelet restart detected (socket inode watch) -> re-register")
+
+        # ---- 4. Allocate ordering across a plugin restart
+        wait_for("register annotation present", lambda: api.nodes[NODE][
+            "metadata"]["annotations"].get(REGISTER_ANNO), alive=plugin.alive)
+        anno = api.nodes[NODE]["metadata"]["annotations"].get(REGISTER_ANNO, "")
+        chips = codec.decode_node_devices(anno)
+        check("register annotation decodes to the mock inventory",
+              len(chips) == 4)
+        rows = [
+            [ContainerDevice(idx=0, uuid=chips[0].id, type=chips[0].type,
+                             usedmem=1024, usedcores=25)],
+            [ContainerDevice(idx=1, uuid=chips[1].id, type=chips[1].type,
+                             usedmem=2048, usedcores=25)],
+        ]
+        pod = api.create_pod({
+            "metadata": {
+                "name": "two-ctr", "namespace": NS, "uid": "uid-two-ctr",
+                "annotations": {
+                    t.ASSIGNED_NODE: NODE,
+                    t.ASSIGNED_TIME: str(int(time.time())),
+                    t.BIND_PHASE: t.BIND_PHASE_ALLOCATING,
+                    IN_REQUEST_ANNO: codec.encode_pod_single_device(rows),
+                },
+            },
+            "spec": {"containers": [
+                {"name": "c0", "resources": {"limits": {"google.com/tpu": "1"}}},
+                {"name": "c1", "resources": {"limits": {"google.com/tpu": "1"}}},
+            ]},
+        })
+        nodelock.lock_node(client, NODE, pod)  # what bind would have taken
+
+        def lock_held() -> bool:
+            return t.NODE_LOCK_ANNO in api.nodes[NODE]["metadata"]["annotations"]
+
+        def bind_phase() -> str:
+            return api.pods[(NS, "two-ctr")]["metadata"]["annotations"].get(
+                t.BIND_PHASE, "")
+
+        with grpc.insecure_channel(plugin_sock) as ch:
+            r0 = DevicePluginStub(ch).Allocate(pb.AllocateRequest(
+                container_requests=[
+                    pb.ContainerAllocateRequest(devicesIDs=[ids[0]])]),
+                timeout=30)
+        env0 = dict(r0.container_responses[0].envs)
+        check("first Allocate served container c0's slot (1024m cap)",
+              env0.get("TPU_DEVICE_MEMORY_LIMIT_0") == "1024m")
+        check("node lock HELD after a partial allocation", lock_held())
+        check("bind-phase still allocating after a partial allocation",
+              bind_phase() == t.BIND_PHASE_ALLOCATING)
+
+        # the plugin crashes between kubelet's two Allocate calls
+        n_reg = len(kubelet.requests)
+        plugin.cleanup()
+        plugin = BinaryUnderTest("vtpu.plugin", plugin_args, env=env)
+        wait_for("restarted plugin re-registers",
+                 lambda: len(kubelet.requests) > n_reg, alive=plugin.alive)
+
+        def plugin_serving() -> bool:
+            # the stale socket FILE may outlive the old process; only a
+            # successful RPC proves the new server is behind it
+            try:
+                with grpc.insecure_channel(plugin_sock) as ch:
+                    next(DevicePluginStub(ch).ListAndWatch(
+                        pb.Empty(), timeout=2))
+                return True
+            except Exception:
+                return False
+
+        wait_for("restarted plugin socket serving", plugin_serving,
+                 alive=plugin.alive)
+        # the restart itself must not have leaked the partial allocation:
+        # a plugin that releases the lock or flips bind-phase on BOOT would
+        # let the scheduler bind a second pod mid-sequence
+        check("node lock still held across the plugin restart", lock_held())
+        check("bind-phase still allocating across the plugin restart",
+              bind_phase() == t.BIND_PHASE_ALLOCATING)
+        with grpc.insecure_channel(plugin_sock) as ch:
+            r1 = DevicePluginStub(ch).Allocate(pb.AllocateRequest(
+                container_requests=[
+                    pb.ContainerAllocateRequest(devicesIDs=[ids[4]])]),
+                timeout=30)
+        env1 = dict(r1.container_responses[0].envs)
+        check("second Allocate (after restart) served c1's slot, not c0's "
+              "(index stability)", env1.get("TPU_DEVICE_MEMORY_LIMIT_0") == "2048m")
+        wait_for("bind success after the final slot",
+                 lambda: bind_phase() == t.BIND_PHASE_SUCCESS,
+                 alive=plugin.alive)
+        wait_for("node lock released after the final slot",
+                 lambda: not lock_held(), alive=plugin.alive)
+        phase("Allocate ordering under plugin restart (lock + bind-phase)")
+
+        out = {"ok": True, "phases": phases, "checks": checks,
+               "why": "kind/docker unavailable on this rig; "
+                      "hack/e2e-kind.sh dispatches here (kubelet-protocol "
+                      "conformance against the real plugin binary)"}
+        (REPO / "KUBELET_CONFORMANCE_r05.json").write_text(
+            json.dumps(out, indent=2) + "\n")
+        print(json.dumps({"ok": True, "phases": phases,
+                          "checks": len(checks)}, indent=2))
+        return 0
+    finally:
+        plugin.cleanup()
+        kubelet.stop()
+        api.server.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -19,24 +19,10 @@ import grpc
 from vtpu.plugin.api import deviceplugin_pb2 as pb
 from vtpu.plugin.api.grpc_api import DevicePluginStub, add_registration_servicer
 
-from tests.helpers import BinaryUnderTest
+from tests.helpers import BinaryUnderTest, FakeKubeletRegistration
 
 REGISTER_ANNO = "vtpu.io/node-tpu-register"
 NODE = "bin-e2e-node"
-
-
-class _FakeKubelet:
-    """Records Register() calls the way kubelet's Registration service does."""
-
-    def __init__(self, sock_path: str):
-        self.requests: list = []
-        self.server = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
-        add_registration_servicer(self.server, self)
-        self.server.add_insecure_port(f"unix://{sock_path}")
-
-    def Register(self, request, context):
-        self.requests.append(request)
-        return pb.Empty()
 
 
 def _fake_apiserver():
@@ -83,8 +69,7 @@ def test_plugin_binary_end_to_end(tmp_path):
     sock_dir.mkdir()
     hook = tmp_path / "hook"
     kubelet_sock = str(sock_dir / "kubelet.sock")
-    kubelet = _FakeKubelet(kubelet_sock)
-    kubelet.server.start()
+    kubelet = FakeKubeletRegistration(kubelet_sock)
     apiserver, state, lock = _fake_apiserver()
     port = apiserver.server_address[1]
 

@@ -97,3 +97,38 @@ class BinaryUnderTest:
         if self.proc.poll() is None:
             self.proc.kill()
             self.proc.communicate()
+
+
+class FakeKubeletRegistration:
+    """The kubelet side of the device-plugin Registration service (unix
+    socket gRPC): records Register() calls; stop() also unlinks the socket
+    so a recreate presents a NEW inode, which is what the plugin's
+    kubelet-restart watch keys on. Shared by the binary e2e tests and the
+    hack/ conformance harnesses."""
+
+    def __init__(self, sock_path: str):
+        import os
+        from concurrent import futures
+
+        import grpc
+
+        from vtpu.plugin.api import deviceplugin_pb2 as pb
+        from vtpu.plugin.api.grpc_api import add_registration_servicer
+
+        self._os = os
+        self._pb = pb
+        self.sock_path = sock_path
+        self.requests: list = []
+        self.server = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
+        add_registration_servicer(self.server, self)
+        self.server.add_insecure_port(f"unix://{sock_path}")
+        self.server.start()
+
+    def Register(self, request, context):
+        self.requests.append(request)
+        return self._pb.Empty()
+
+    def stop(self):
+        self.server.stop(grace=0.2)
+        if self._os.path.exists(self.sock_path):
+            self._os.unlink(self.sock_path)
