@@ -60,33 +60,57 @@ def timed(fn, *args, iters: int = 5) -> float:
     return float(np.median(times))
 
 
+def timed_per_iter(make_chain, k_lo: int, k_hi: int, *args,
+                   iters: int = 5) -> float:
+    """Per-iteration seconds via the TWO-CHAIN-LENGTH DIFFERENCE:
+    (t(k_hi) - t(k_lo)) / (k_hi - k_lo). The tunneled platform charges a
+    ~100-400 ms dispatch RTT on every call; dividing one chain's wall by
+    its length smears RTT/k into every number (r4's 75 ms "prefill" held
+    ~13 ms of transport — MFU was understated by ~10 points at 16x1024).
+    The difference cancels the RTT exactly instead of amortizing it."""
+    t_lo = timed(make_chain(k_lo), *args, iters=iters)
+    t_hi = timed(make_chain(k_hi), *args, iters=iters)
+    if t_hi <= t_lo:
+        # transport noise swallowed the compute delta: retry once with more
+        # samples, then refuse rather than publish an absurd number
+        t_lo = timed(make_chain(k_lo), *args, iters=2 * iters + 1)
+        t_hi = timed(make_chain(k_hi), *args, iters=2 * iters + 1)
+        if t_hi <= t_lo:
+            raise RuntimeError(
+                f"two-chain difference unusable: t({k_hi})={t_hi:.4f}s <= "
+                f"t({k_lo})={t_lo:.4f}s (transport noise > compute delta)")
+    return (t_hi - t_lo) / (k_hi - k_lo)
+
+
 def bench_prefill(cfg: ModelConfig, b: int, s: int, k_chain: int) -> dict:
     params = jax.jit(lambda key: init_params(key, cfg))(jax.random.key(0))
     jax.block_until_ready(params)
     tokens = jnp.asarray(
         np.random.RandomState(0).randint(0, cfg.vocab, (b, s)), jnp.int32)
 
-    @jax.jit
-    def chained(params, tokens):
-        # xor-feed the summary back into the tokens so XLA cannot collapse
-        # the K iterations; the perturbation keeps token ids in range.
-        def body(carry, _):
-            logits, _cache = prefill(params, cfg, tokens ^ (carry & 1))
-            return jnp.sum(logits).astype(jnp.int32) & 1, None
+    def make_chain(length):
+        @jax.jit
+        def chained(params, tokens):
+            # xor-feed the summary back into the tokens so XLA cannot
+            # collapse the K iterations; the perturbation keeps ids in range
+            def body(carry, _):
+                logits, _cache = prefill(params, cfg, tokens ^ (carry & 1))
+                return jnp.sum(logits).astype(jnp.int32) & 1, None
 
-        out, _ = jax.lax.scan(body, jnp.int32(0), None, length=k_chain)
-        return out
+            out, _ = jax.lax.scan(body, jnp.int32(0), None, length=length)
+            return out
+        return chained
 
-    sec = timed(chained, params, tokens)
-    flops = prefill_flops(cfg, b, s) * k_chain
+    sec = timed_per_iter(make_chain, k_chain, 3 * k_chain, params, tokens)
+    flops = prefill_flops(cfg, b, s)
     mfu = flops / sec / PEAK_FLOPS
     return {
-        "batch": b, "seq": s, "k_chain": k_chain,
-        "wall_ms": round(sec * 1e3, 2),
-        "ms_per_prefill": round(sec / k_chain * 1e3, 2),
-        "tflops_per_prefill": round(prefill_flops(cfg, b, s) / 1e12, 3),
+        "batch": b, "seq": s, "chain": [k_chain, 3 * k_chain],
+        "timing": "two-chain-length difference (RTT-cancelled)",
+        "ms_per_prefill": round(sec * 1e3, 2),
+        "tflops_per_prefill": round(flops / 1e12, 3),
         "mfu_percent": round(100 * mfu, 2),
-        "tokens_per_sec": round(b * s * k_chain / sec),
+        "tokens_per_sec": round(b * s / sec),
     }
 
 
@@ -95,22 +119,28 @@ def bench_attention(b: int, s: int, h: int, dh: int, dtype, k_chain: int = 8) ->
     q, k, v = (jnp.asarray(rng.standard_normal((b, s, h, dh)), dtype) for _ in range(3))
 
     def chain(attn_fn):
-        @jax.jit
-        def run(q, k, v):
-            def body(carry, _):
-                o = attn_fn(q + carry, k, v)
-                return jnp.max(o).astype(q.dtype) * 0, None
+        def make(length):
+            @jax.jit
+            def run(q, k, v):
+                def body(carry, _):
+                    o = attn_fn(q + carry, k, v)
+                    return jnp.max(o).astype(q.dtype) * 0, None
 
-            out, _ = jax.lax.scan(body, q.dtype.type(0), None, length=k_chain)
-            return out
+                out, _ = jax.lax.scan(body, q.dtype.type(0), None,
+                                      length=length)
+                return out
 
-        return run
+            return run
+        return make
 
-    flash_s = timed(chain(flash_attention), q, k, v) / k_chain
-    xla_s = timed(chain(causal_attention), q, k, v) / k_chain
+    flash_s = timed_per_iter(chain(flash_attention), k_chain, 3 * k_chain,
+                             q, k, v)
+    xla_s = timed_per_iter(chain(causal_attention), k_chain, 3 * k_chain,
+                           q, k, v)
     flops = 2 * 2 * b * h * s * s * dh  # scores + out, full causal as computed
     return {
         "shape": [b, s, h, dh], "dtype": str(dtype.__name__ if hasattr(dtype, "__name__") else dtype),
+        "timing": "two-chain-length difference (RTT-cancelled)",
         "flash_ms": round(flash_s * 1e3, 3),
         "xla_ms": round(xla_s * 1e3, 3),
         "flash_tflops": round(flops / flash_s / 1e12, 1),
@@ -139,18 +169,24 @@ def bench_decode(cfg: ModelConfig, b: int, prompt_len: int, steps: int,
     _, cache = jax.jit(lambda p, t: prefill(p, cfg, t))(params, tokens)
     jax.block_until_ready(cache)
 
-    @jax.jit
-    def chained(params, cache, tok):
-        def body(carry, _):
-            cache, tok = carry
-            logits, cache = decode_step(params, cfg, cache, tok,
-                                        kv_bucket=kv_bucket, unroll=unroll)
-            return (cache, jnp.argmax(logits, -1).astype(jnp.int32)), None
+    def make_chain(length):
+        @jax.jit
+        def chained(params, cache, tok):
+            def body(carry, _):
+                cache, tok = carry
+                logits, cache = decode_step(params, cfg, cache, tok,
+                                            kv_bucket=kv_bucket, unroll=unroll)
+                return (cache, jnp.argmax(logits, -1).astype(jnp.int32)), None
 
-        (cache, tok), _ = jax.lax.scan(body, (cache, tok), None, length=steps)
-        return tok
+            (cache, tok), _ = jax.lax.scan(body, (cache, tok), None,
+                                           length=length)
+            return tok
+        return chained
 
-    sec = timed(chained, params, cache, tokens[:, -1])
+    # capacity guard above uses the LONG chain (steps is the hi length)
+    sec_per_step = timed_per_iter(
+        make_chain, max(steps // 4, 1), steps, params, cache, tokens[:, -1])
+    sec = sec_per_step * steps
     param_bytes = sum(
         x.size * x.dtype.itemsize for x in jax.tree_util.tree_leaves(params))
     read_len = kv_bucket or cfg.max_seq
@@ -167,7 +203,7 @@ def bench_decode(cfg: ModelConfig, b: int, prompt_len: int, steps: int,
         "kv_bucket": kv_bucket or cfg.max_seq, "unroll": unroll,
         "kv_int8": bool(getattr(cfg, "kv_int8", False)),
         "decode_attn": getattr(cfg, "decode_attn", "xla"),
-        "wall_ms": round(sec * 1e3, 2),
+        "timing": "two-chain-length difference (RTT-cancelled)",
         "ms_per_step": round(sec / steps * 1e3, 3),
         "tokens_per_sec": round(b * steps / sec),
         "param_bytes_mb": round(param_bytes / 1e6, 1),
@@ -206,20 +242,23 @@ def bench_spec_tick(cfg: ModelConfig, b: int, prompt_len: int, k: int,
     active = jnp.ones((b,), bool)
     cap = jnp.ones((b,), jnp.int32)
 
-    @jax.jit
-    def chained(params, cache, draft):
-        def body(carry, _):
-            cache, draft = carry
-            pred, _, cache = batched_spec_step(
-                params, cfg, cache, draft, active, cap,
-                kv_bucket=kv_bucket, unroll=unroll)
-            return (cache, pred), None
+    def make_chain(length):
+        @jax.jit
+        def chained(params, cache, draft):
+            def body(carry, _):
+                cache, draft = carry
+                pred, _, cache = batched_spec_step(
+                    params, cfg, cache, draft, active, cap,
+                    kv_bucket=kv_bucket, unroll=unroll)
+                return (cache, pred), None
 
-        (cache, _), _ = jax.lax.scan(body, (cache, draft), None, length=steps)
-        return cache["len"]
+            (cache, _), _ = jax.lax.scan(body, (cache, draft), None,
+                                         length=length)
+            return cache["len"]
+        return chained
 
-    sec = timed(chained, params, cache, draft)
-    spec_ms = sec / steps * 1e3
+    spec_ms = timed_per_iter(
+        make_chain, max(steps // 4, 1), steps, params, cache, draft) * 1e3
     plain = bench_decode(cfg, b, prompt_len, steps, kv_bucket=kv_bucket,
                          unroll=unroll)
     ratio = spec_ms / plain["ms_per_step"]
@@ -227,6 +266,7 @@ def bench_spec_tick(cfg: ModelConfig, b: int, prompt_len: int, k: int,
         "batch": b, "prompt_len": prompt_len, "spec_tokens": k,
         "kv_bucket": kv_bucket or cfg.max_seq,
         "decode_attn": getattr(cfg, "decode_attn", "xla"),
+        "timing": "two-chain-length difference (RTT-cancelled)",
         "ms_per_verify_tick": round(spec_ms, 3),
         "ms_per_decode_tick": plain["ms_per_step"],
         "verify_cost_ratio": round(ratio, 3),
@@ -259,24 +299,29 @@ def bench_ssm_decode(b: int, steps: int, on_tpu: bool) -> dict:
     state = init_ssm_state(cfg, b)
     tok0 = jnp.zeros((b,), jnp.int32)
 
-    @jax.jit
-    def chained(params, state, tok):
-        def body(carry, _):
-            state, tok = carry
-            logits, state = ssm_decode_step(params, cfg, state, tok)
-            return (state, jnp.argmax(logits, -1).astype(jnp.int32)), None
+    def make_chain(length):
+        @jax.jit
+        def chained(params, state, tok):
+            def body(carry, _):
+                state, tok = carry
+                logits, state = ssm_decode_step(params, cfg, state, tok)
+                return (state, jnp.argmax(logits, -1).astype(jnp.int32)), None
 
-        (state, tok), _ = jax.lax.scan(body, (state, tok), None, length=steps)
-        return tok
+            (state, tok), _ = jax.lax.scan(body, (state, tok), None,
+                                           length=length)
+            return tok
+        return chained
 
-    sec = timed(chained, params, state, tok0)
+    sec_per_step = timed_per_iter(
+        make_chain, max(steps // 4, 1), steps, params, state, tok0)
     param_bytes = sum(
         x.size * x.dtype.itemsize for x in jax.tree_util.tree_leaves(params))
     return {
         "batch": b, "steps": steps,
         "d_model": cfg.d_model, "n_layers": cfg.n_layers,
-        "ms_per_step": round(sec / steps * 1e3, 3),
-        "tokens_per_sec": round(b * steps / sec),
+        "timing": "two-chain-length difference (RTT-cancelled)",
+        "ms_per_step": round(sec_per_step * 1e3, 3),
+        "tokens_per_sec": round(b / sec_per_step),
         "param_bytes_mb": round(param_bytes / 1e6, 1),
     }
 
@@ -310,10 +355,19 @@ def main() -> None:
         k_chain = 2
         dtype = jnp.float32
 
+    def safe(fn, *a, **kw) -> dict:
+        # one unusable measurement (timed_per_iter refusing a noise-swamped
+        # delta) must cost its row, not the whole sweep
+        try:
+            return fn(*a, **kw)
+        except Exception as exc:  # noqa: BLE001
+            return {"error": str(exc)[:300], "bench": fn.__name__,
+                    "args": [repr(x)[:60] for x in a[1:]]}
+
     out = {"backend": jax.default_backend(), "peak_flops": PEAK_FLOPS,
            "prefill": [], "attention": [], "decode": []}
     for b, s in shapes:
-        r = bench_prefill(cfg, b, s, k_chain)
+        r = safe(bench_prefill, cfg, b, s, k_chain)
         out["prefill"].append(r)
         print("prefill", r, flush=True)
     for b, s, h, dh in attn_shapes:
@@ -353,12 +407,13 @@ def main() -> None:
     target = {(8, 1024), (8, 0), (32, 1024), (32, 0)}
     for b, p, steps, bkt in decode_shapes:
         for base in (cfg, cfg_q):
-            r = bench_decode(base, b, p, steps, kv_bucket=bkt)
+            r = safe(bench_decode, base, b, p, steps, kv_bucket=bkt)
             out["decode"].append(r)
             print("decode", r, flush=True)
             if on_tpu and (b, bkt) in target:
-                rx = bench_decode(dataclasses.replace(base, decode_attn="xla"),
-                                  b, p, steps, kv_bucket=bkt)
+                rx = safe(bench_decode,
+                          dataclasses.replace(base, decode_attn="xla"),
+                          b, p, steps, kv_bucket=bkt)
                 out["decode"].append(rx)
                 print("decode", rx, flush=True)
     if on_tpu:
@@ -367,7 +422,7 @@ def main() -> None:
         # [:, :bucket] has a loop-carried layer index, which XLA lowers to a
         # materialized slice copy — at batch 32 that copy costs more than
         # streaming the full cache. The serving engine now unrolls.
-        r = bench_decode(cfg, 32, 128, 64, kv_bucket=256, unroll=False)
+        r = safe(bench_decode, cfg, 32, 128, 64, kv_bucket=256, unroll=False)
         out["decode_fori_exhibit"] = r
         out["decode_note"] = (
             "r2's bucket-256-slower-than-2048 inversion at batch 32 was the "
@@ -393,19 +448,20 @@ def main() -> None:
                     (8, 1024, 4, 64, 2048), (32, 1024, 4, 64, 2048)] if on_tpu
                    else [(2, 32, 4, 4, 0)])
     for b, p, k, steps, bkt in spec_shapes:
-        r = bench_spec_tick(cfg, b, p, k, steps, kv_bucket=bkt)
+        r = safe(bench_spec_tick, cfg, b, p, k, steps, kv_bucket=bkt)
         out["spec"].append(r)
         print("spec", r, flush=True)
         if on_tpu and b == 32:
             # the r4 weak spot: the batch-32 verify tick cost 1.35x a decode
             # tick through XLA; the routed kernel's ratio is the r5 target
-            rx = bench_spec_tick(dataclasses.replace(cfg, decode_attn="xla"),
-                                 b, p, k, steps, kv_bucket=bkt)
+            rx = safe(bench_spec_tick,
+                      dataclasses.replace(cfg, decode_attn="xla"),
+                      b, p, k, steps, kv_bucket=bkt)
             out["spec"].append(rx)
             print("spec", rx, flush=True)
     out["ssm_decode"] = []
     for b, steps in ([(8, 64), (32, 64)] if on_tpu else [(2, 4)]):
-        r = bench_ssm_decode(b, steps, on_tpu)
+        r = safe(bench_ssm_decode, b, steps, on_tpu)
         out["ssm_decode"].append(r)
         print("ssm_decode", r, flush=True)
     if on_tpu:

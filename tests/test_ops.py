@@ -114,3 +114,35 @@ def test_decode_attention_rejects_multi_t_flat_lens():
     k = jnp.zeros((1, 8, 1, 128), jnp.float32)
     with pytest.raises(ValueError, match="ragged"):
         decode_attention(q, k, k, jnp.asarray([4], jnp.int32), interpret=True)
+
+
+def test_decode_attention_grid_bounded_bucket():
+    """bucket bounds the reads via the grid over a LONGER cache: equality
+    with XLA attention over the sliced window (the zero-copy integration
+    contract — the trunk passes full per-layer views, never slices)."""
+    from vtpu.ops.attention import decode_attention
+
+    rng = np.random.RandomState(6)
+    b, t, h, dh, s, bucket = 2, 1, 2, 128, 1024, 256
+    q = jnp.asarray(rng.randn(b, t, h, dh), jnp.float32)
+    k = jnp.asarray(rng.randn(b, s, h, dh), jnp.float32)
+    v = jnp.asarray(rng.randn(b, s, h, dh), jnp.float32)
+    lens = jnp.asarray([[100], [256]], jnp.int32)
+    want = causal_attention(q, k[:, :bucket], v[:, :bucket], kv_len=lens)
+    got = decode_attention(q, k, v, lens, bucket=bucket, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+    # int8 with scale planes: the wrapper slices scales to the bucket
+    # before its transpose — equality over the sliced window proves it
+    from vtpu.ops.attention import causal_attention_int8kv
+
+    kq = jnp.asarray(rng.randint(-127, 128, (b, s, h, dh)), jnp.int8)
+    vq = jnp.asarray(rng.randint(-127, 128, (b, s, h, dh)), jnp.int8)
+    ks = jnp.asarray(rng.rand(b, s, h).astype(np.float32) * 0.02 + 1e-3)
+    vs = jnp.asarray(rng.rand(b, s, h).astype(np.float32) * 0.02 + 1e-3)
+    want8 = causal_attention_int8kv(
+        q, kq[:, :bucket], ks[:, :bucket], vq[:, :bucket], vs[:, :bucket],
+        kv_len=lens)
+    got8 = decode_attention(q, kq, vq, lens, ks, vs, bucket=bucket,
+                            interpret=True)
+    np.testing.assert_allclose(np.asarray(got8), np.asarray(want8), atol=2e-5)

@@ -371,24 +371,36 @@ def spec_verify_loop(
             lp = jax.tree_util.tree_map(lambda a: a[l], params["layers"])
         q, k, v = _qkv(cfg, lp, x, cos, sin, positions)
         kv = write_kv(l, kv, k, v)
-        if unroll:
-            view = {key: kv[key][l, :, :bucket] for key in kv_keys}
-        else:
-            view = {
-                key: jax.lax.dynamic_index_in_dim(kv[key], l, 0, keepdims=False)[
-                    :, :bucket]
-                for key in kv_keys
-            }
-        if _decode_attn_pallas(cfg, bucket, quant, t):
+        # Pallas routing requires the UNROLLED loop: the kernel takes the
+        # full per-layer view kv[key][l] — with a STATIC l that is a
+        # contiguous leading-dim slice (no copy), and the grid bounds the
+        # reads to `bucket`; a [:, :bucket] slice would force XLA to
+        # materialize the whole window as the pallas operand every tick
+        # (see decode_attention's docstring for the measured cost). Under
+        # fori_loop the layer index is loop-carried, so the same expression
+        # materializes the FULL max_seq cache — strictly worse than the
+        # bucketed XLA path — hence fori stays XLA.
+        if unroll and _decode_attn_pallas(cfg, bucket, quant, t):
+            full = {key: kv[key][l] for key in kv_keys}
             attn = decode_attention(
-                q, view["k"], view["v"], ragged_len,
-                view.get("k_scale"), view.get("v_scale"))
-        elif quant:
-            attn = causal_attention_int8kv(
-                q, view["k"], view["k_scale"], view["v"], view["v_scale"],
-                kv_len=ragged_len)
+                q, full["k"], full["v"], ragged_len,
+                full.get("k_scale"), full.get("v_scale"), bucket=bucket)
         else:
-            attn = causal_attention(q, view["k"], view["v"], kv_len=ragged_len)
+            if unroll:
+                view = {key: kv[key][l, :, :bucket] for key in kv_keys}
+            else:
+                view = {
+                    key: jax.lax.dynamic_index_in_dim(
+                        kv[key], l, 0, keepdims=False)[:, :bucket]
+                    for key in kv_keys
+                }
+            if quant:
+                attn = causal_attention_int8kv(
+                    q, view["k"], view["k_scale"], view["v"], view["v_scale"],
+                    kv_len=ragged_len)
+            else:
+                attn = causal_attention(
+                    q, view["k"], view["v"], kv_len=ragged_len)
         x = x + attn.reshape(b, t, cfg.qkv_dim) @ lp["wo"]
         x = x + ffn(lp, x)
         return x, kv

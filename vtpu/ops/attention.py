@@ -184,7 +184,7 @@ def _decode_s_block(s: int) -> int:
     return s
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(jax.jit, static_argnames=("bucket", "interpret"))
 def decode_attention(
     q: jax.Array,
     k: jax.Array,
@@ -192,6 +192,7 @@ def decode_attention(
     kv_len: jax.Array,
     k_scale: jax.Array | None = None,
     v_scale: jax.Array | None = None,
+    bucket: int = 0,
     interpret: bool | None = None,
 ) -> jax.Array:
     """Pallas decode/verify attention over the serving cache's native
@@ -199,6 +200,14 @@ def decode_attention(
     k, v: [B, S, H, Dh] bf16, or int8 with k_scale/v_scale [B, S, H] f32;
     kv_len: ragged [B, T] (query i of row b reads k_pos < kv_len[b, i]) or
     [B] (T must be 1; the suffix-decode mask k_pos < len is identical).
+
+    ``bucket`` (static; 0 = S) bounds the attention READS via the GRID —
+    blocks past the bucket are simply never scheduled. Callers pass the
+    cache's FULL per-layer view (a contiguous leading-dim slice, zero
+    copy) instead of a ``[:, :bucket]`` slice: a pallas operand must be
+    materialized, so the sliced form forced XLA to copy the whole window
+    every tick — measured 27 ms vs XLA's 6.8 ms at batch 32 / 2048 before
+    this (MFU_r05 first pass), erasing the kernel's standalone win.
 
     Equals causal_attention / causal_attention_int8kv on the same operands
     (test_ops asserts both); exists because at decode shapes the fused
@@ -210,6 +219,9 @@ def decode_attention(
     """
     b, t, h, dh = q.shape
     s = k.shape[1]
+    bucket = bucket or s
+    if bucket > s:
+        raise ValueError(f"bucket {bucket} exceeds cache length {s}")
     if kv_len.ndim == 1:
         if t != 1:
             raise ValueError("[B] kv_len requires T=1 (ragged [B,T] otherwise)")
@@ -217,8 +229,8 @@ def decode_attention(
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     scale = 1.0 / math.sqrt(dh)
-    s_blk = _decode_s_block(s)
-    n_blocks = s // s_blk
+    s_blk = _decode_s_block(bucket)
+    n_blocks = bucket // s_blk
     # native [B, S, H, Dh] -> [B, S, H*Dh] is a free reshape (contiguous);
     # per-head tiles are static minor-dim slices in-kernel
     kf = k.reshape(b, s, h * dh)
@@ -257,11 +269,14 @@ def decode_attention(
                        scale=scale, nheads=h, dh=dh, s_blk=s_blk,
                        n_blocks=n_blocks, ks_ref=ks_ref, vs_ref=vs_ref)
 
-    # scales pre-transposed to [B, H, S]: contiguous (H, S_blk) tiles (the
-    # cache-native [B, S, H] would DMA 4-byte strided runs); at 4*S*H bytes
-    # the transpose materializes ~0.2% of the int8 window it accompanies
-    ks_t = k_scale.transpose(0, 2, 1)
-    vs_t = v_scale.transpose(0, 2, 1)
+    # scales sliced to the bucket THEN pre-transposed to [B, H, bucket]:
+    # contiguous (H, S_blk) tiles (the cache-native [B, S, H] would DMA
+    # 4-byte strided runs). Slicing first keeps the materialization
+    # proportional to the window actually read — a full-S transpose on a
+    # long cache with a small bucket would cost a significant fraction of
+    # the int8 bytes the grid-bounding saves.
+    ks_t = k_scale[:, :bucket].transpose(0, 2, 1)
+    vs_t = v_scale[:, :bucket].transpose(0, 2, 1)
     scale_spec = pl.BlockSpec((1, h, s_blk), lambda i, j: (i, 0, j))
     out = pl.pallas_call(
         kern8,
