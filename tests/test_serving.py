@@ -827,3 +827,35 @@ def test_spec_adaptive_gate_and_stats(params):
     assert got2 == plain
     assert stats2["spec_ema"] > 1.25
     assert stats2["mean_emitted_per_spec_tick"] > 1.25
+
+
+def test_choose_kv_int8_measured_edges():
+    """The router encodes INT8_AB_r05's measured cells: int8 wins at
+    batch >= 16 or windows <= 1024; the 8 x 2048 corner is the one
+    measured regression (-4.4%) and routes bf16."""
+    from vtpu.serving.engine import choose_kv_int8
+
+    assert choose_kv_int8(8, 1024) is True
+    assert choose_kv_int8(32, 1024) is True
+    assert choose_kv_int8(32, 2048) is True
+    assert choose_kv_int8(8, 2048) is False
+
+
+def test_kv_int8_auto_resolves_at_engine_construction(params):
+    """ModelConfig.kv_int8="auto" must resolve to a concrete bool via the
+    measured router BEFORE any cache is built ("auto" is truthy — leaking
+    it into init_kv_cache would quantize everywhere)."""
+    import dataclasses
+
+    cfg_auto = dataclasses.replace(CFG, kv_int8="auto")
+    # CFG.max_seq=64 <= 1024 -> router says int8 regardless of slots
+    eng = ServingEngine(params, cfg_auto, ServingConfig(
+        slots=2, prefill_buckets=(16,), max_new_tokens=2))
+    eng.start()
+    try:
+        assert eng.cfg.kv_int8 is True
+        assert "k_scale" in eng.state
+        out = list(eng.submit(_prompt(1, 8), max_new_tokens=2).stream())
+        assert len(out) == 2
+    finally:
+        eng.stop()

@@ -40,13 +40,14 @@ class ModelConfig:
     # int8 KV cache with per-token-per-head f32 scales: halves the bytes the
     # bandwidth-bound decode step streams (1 + 4/head_dim bytes/elem vs 2 for
     # bf16) and doubles serving tenant density per HBM GiB. Off by default:
-    # training and tests keep exact bf16 KV.
-    kv_int8: bool = False
-    # Decode/verify attention implementation: "auto" routes per measured
-    # shape edges (DECODE_ATTN_r05.json, real v5e: the fused Pallas kernel
-    # wins bf16 decode everywhere — 1.1-1.6x, ~760 GB/s vs XLA's dispatch-
-    # bound op chain — and int8 at windows >= 2048, while XLA's fused-
-    # convert int8 stays faster at small windows); "pallas" / "xla" force.
+    # training and tests keep exact bf16 KV. The serving engine also accepts
+    # "auto": resolved at engine construction via the measured router
+    # (serving.engine.choose_kv_int8 — INT8_AB_r05 cells).
+    kv_int8: bool | str = False
+    # Decode/verify attention implementation. "auto" (and "xla") = the XLA
+    # op chain — the FULL-TRUNK measurements pick it at every serving cell
+    # (MFU_r05; see _decode_attn_pallas for why the kernel's standalone
+    # wins don't survive integration). "pallas" forces the fused kernel.
     decode_attn: str = "auto"
 
     @property
@@ -234,47 +235,22 @@ def decode_step(
     return logits, {**new_kv, "len": cache["len"] + 1}
 
 
-# chunk widths the DECODE_ATTN_r05 routing table actually measured (decode
-# tick T=1, verify ticks up to draft+1); wider chunks (chunked prefill
-# admission runs T=prefill_chunk through this same trunk) are MXU-bound
-# prefill work outside the table's domain and keep the XLA/flash path
-_DECODE_KERNEL_MAX_T = 8
+def _decode_attn_pallas(cfg: ModelConfig) -> bool:
+    """Route the decode/verify attention. "auto" = the XLA op chain,
+    decided by FULL-TRUNK measurement, not kernel microbenches.
 
-
-def _decode_attn_pallas(cfg: ModelConfig, bucket: int, quant: bool,
-                        t: int = 1) -> bool:
-    """Route the decode/verify attention. "auto" follows the measured edges
-    (hack/decode_attn_bench.py -> DECODE_ATTN_r05.json on the real v5e):
-    bf16 -> the fused Pallas kernel at every serving cell (1.1-1.6x over the
-    XLA op chain, which is dispatch-bound at M=1, not byte-bound); int8 ->
-    Pallas at windows >= 2048 (1.2-1.9x) but XLA's fused convert below (its
-    materialization fits pre-cliff and wins ~1.4x at 1024). A misrouted
-    deployment loses throughput silently, so the default consults the
-    table instead of trusting one global flag (VERDICT r4 #3)."""
-    # getattr: every family sharing this trunk (MoEConfig, tests' ad-hoc
-    # configs) routes here; absent fields mean "auto" with kernels allowed
-    mode = getattr(cfg, "decode_attn", "auto")
-    if mode == "pallas":
-        return True
-    if mode == "xla":
-        return False
-    if not getattr(cfg, "use_pallas", True):
-        return False
-    if jax.default_backend() != "tpu":
-        # interpret-mode emulation has no perf meaning and slows the CPU
-        # suite; tests cover the kernel path via decode_attn="pallas"
-        return False
-    if jax.device_count() > 1:
-        # a pallas_call cannot GSPMD-partition over a head-sharded cache;
-        # mesh serving pins XLA in the adapter, and "auto" stays
-        # conservative for anyone driving the trunk directly on a mesh
-        # process (force decode_attn="pallas" to override)
-        return False
-    if t > _DECODE_KERNEL_MAX_T:
-        return False
-    if quant:
-        return bucket >= 2048
-    return True
+    The r5 history, kept because it is the lesson: standalone, the fused
+    Pallas decode kernel beat XLA at every serving cell (DECODE_ATTN_r05,
+    two-chain-difference timing — 1.1-1.9x, ~760 GB/s). In the trunk it
+    loses everywhere (MFU_r05 decode, same timing): 3.09 vs 1.52 ms at
+    batch 8 / kv 1024, 22-25 ms flat vs 3.0-5.4 at batch 32. A pallas
+    operand must be materialized, and inside the decode step the cache is
+    simultaneously scatter-updated, so XLA copies the layer view it would
+    otherwise fuse the windowed reads from — the copy costs more than the
+    kernel saves, and no operand shape avoids both the copy and the
+    window. The kernel stays in-tree (decode_attn="pallas") as the
+    shard_map/aliasing work item; the DEFAULT follows the trunk numbers."""
+    return getattr(cfg, "decode_attn", "auto") == "pallas"
 
 
 def decode_layer_loop(
@@ -371,17 +347,23 @@ def spec_verify_loop(
             lp = jax.tree_util.tree_map(lambda a: a[l], params["layers"])
         q, k, v = _qkv(cfg, lp, x, cos, sin, positions)
         kv = write_kv(l, kv, k, v)
-        # Pallas routing requires the UNROLLED loop: the kernel takes the
-        # full per-layer view kv[key][l] — with a STATIC l that is a
-        # contiguous leading-dim slice (no copy), and the grid bounds the
-        # reads to `bucket`; a [:, :bucket] slice would force XLA to
-        # materialize the whole window as the pallas operand every tick
-        # (see decode_attention's docstring for the measured cost). Under
-        # fori_loop the layer index is loop-carried, so the same expression
-        # materializes the FULL max_seq cache — strictly worse than the
-        # bucketed XLA path — hence fori stays XLA.
-        if unroll and _decode_attn_pallas(cfg, bucket, quant, t):
-            full = {key: kv[key][l] for key in kv_keys}
+        # The forced kernel takes the full per-layer view kv[key][l]: with
+        # the UNROLLED loop (the serving default) the static index is a
+        # contiguous leading-dim slice (no copy) and the grid bounds reads
+        # to `bucket`; a [:, :bucket] slice would force XLA to materialize
+        # the window as the pallas operand every tick (see
+        # decode_attention's docstring for the measured cost). Under
+        # fori_loop the loop-carried index materializes the full max_seq
+        # cache — correct but slow; a forced "pallas" still honors it.
+        if _decode_attn_pallas(cfg):
+            if unroll:
+                full = {key: kv[key][l] for key in kv_keys}
+            else:
+                full = {
+                    key: jax.lax.dynamic_index_in_dim(
+                        kv[key], l, 0, keepdims=False)
+                    for key in kv_keys
+                }
             attn = decode_attention(
                 q, full["k"], full["v"], ragged_len,
                 full.get("k_scale"), full.get("v_scale"), bucket=bucket)

@@ -105,12 +105,14 @@ def causal_attention_int8kv(
     return out.astype(q.dtype)
 
 
-# Below this sequence length the kernel is maintenance without payoff: with
-# K/V VMEM-resident, XLA's fused attention is within ~1.1x of the kernel at
-# serving shapes (measured r3+r4: 0.95-1.08x at s<=1024), while the kernel
-# wins 1.27x at 2048, 1.44x at 4096 and >12x at 8192, where XLA's score
-# materialization falls off the VMEM cliff. transformer_layer routes on this.
-FLASH_MIN_SEQ = 2048
+# Below this sequence length the kernel is maintenance without payoff.
+# r5 re-measured with RTT-cancelled timing (two-chain-length difference —
+# the r3/r4 per-call numbers carried ~RTT/k of tunnel transport, which
+# compressed every ratio toward 1): flash is 1.6x XLA at [16,1024],
+# 2.75x at [16,2048], 7.5x at [4,2048] and ~98x at [1,8192] (MFU_r05
+# attention table), so the prefill route now engages at 1024 — that is
+# the serving bucket where prefill MFU was 3 points under target.
+FLASH_MIN_SEQ = 1024
 
 
 def _decode_kernel(q_ref, k_ref, v_ref, lens_ref, o_ref,

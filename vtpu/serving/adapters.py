@@ -47,13 +47,14 @@ class TransformerSlotModel:
 
             # tp serving runs the trunk under GSPMD auto-partitioning; a
             # pallas_call there cannot be partitioned over the head-sharded
-            # cache (it would gather the full window per chip). Until the
-            # kernel is wrapped in shard_map, mesh serving pins the XLA
-            # decode attention — the single-chip engine keeps the kernel.
-            import dataclasses as _dc
-
-            if getattr(cfg, "decode_attn", None) == "auto":
-                self.cfg = cfg = _dc.replace(cfg, decode_attn="xla")
+            # cache (it would gather the full window per chip). "auto"
+            # already routes XLA (r5: the trunk measurements picked it
+            # everywhere), so this guard only needs to catch an explicit
+            # decode_attn="pallas" leaking onto a mesh.
+            if getattr(cfg, "decode_attn", None) == "pallas":
+                raise ValueError(
+                    "decode_attn='pallas' is single-chip only (the kernel "
+                    "cannot GSPMD-partition a head-sharded cache)")
 
             extra = {a: n for a, n in mesh.shape.items() if a != "tp" and n != 1}
             if extra:
