@@ -421,10 +421,28 @@ class Tenant:
             self.errfile.close()
 
 
+def pooled_inflation(solo: list[float], shared: list[float]) -> float:
+    """Shared-vs-solo inflation of a control tenant, in percent. The single
+    implementation all three consumers (point estimate, per-round
+    diagnostic, bootstrap) call, so they cannot drift."""
+    if not solo or not shared:
+        return 0.0
+    return ((statistics.median(shared) - statistics.median(solo))
+            / statistics.median(solo) * 100.0)
+
+
 def bootstrap_p90_ci(rounds: list[float], n_boot: int = 10000,
-                     seed: int = 20260731) -> tuple[float, float]:
+                     seed: int = 20260731,
+                     control: list[tuple[list[float], list[float]]] | None = None,
+                     ) -> tuple[float, float]:
     """Percentile-bootstrap 95% CI on the p90-of-rounds statistic (resample
     rounds with replacement, recompute the same order-statistic estimator).
+    With `control` — per-round (solo_samples, shared_samples) aligned with
+    `rounds` — each iteration reuses the SAME resampled round indices for
+    the control pools before dividing the control inflation out of the p90:
+    control TTFTs within a round share that round's tunnel weather, so
+    resampling them at round granularity (not iid per sample) keeps the
+    attributed CI honest about that correlation.
     Deterministic seed: the CI must be a property of the data, not the run."""
     import random
 
@@ -432,8 +450,15 @@ def bootstrap_p90_ci(rounds: list[float], n_boot: int = 10000,
     n = len(rounds)
     stats_: list[float] = []
     for _ in range(n_boot):
-        sample = sorted(rng.choice(rounds) for _ in range(n))
-        stats_.append(sample[max(0, min(n - 1, round(0.9 * n) - 1))])
+        idxs = [rng.randrange(n) for _ in range(n)]
+        sample = sorted(rounds[i] for i in idxs)
+        p90 = sample[max(0, min(n - 1, round(0.9 * n) - 1))]
+        if control is not None:
+            solo = [t for i in idxs for t in control[i][0]]
+            shared = [t for i in idxs for t in control[i][1]]
+            infl = pooled_inflation(solo, shared)
+            p90 = ((1.0 + p90 / 100.0) / (1.0 + infl / 100.0) - 1.0) * 100.0
+        stats_.append(p90)
     stats_.sort()
     return (stats_[int(0.025 * n_boot)], stats_[min(n_boot - 1, int(0.975 * n_boot))])
 
@@ -593,34 +618,93 @@ def main() -> None:
         # pays one-off costs no later round sees (four processes' first
         # simultaneous dispatches re-priming the transport; observed as a
         # single +775% round 0 with every later round under 5%). All
-        # MEASURED rounds are published.
+        # MEASURED rounds are published. The controls join the warm-up too,
+        # so their first-ever concurrent window is not measured round 1.
         for i, s in enumerate(stacks):
             s.start_block(2, interval_ms, i * interval_ms / TENANTS)
+        native.start_block(2, interval_ms, interval_ms / (2 * TENANTS))
+        stack_x.start_block(2, interval_ms, 3 * interval_ms / (2 * TENANTS))
         for s in stacks:
             s.read_block()
+        native.read_block()
+        stack_x.read_block()
 
         def sharing_round() -> dict:
+            # Transport control (r5): the NATIVE tenant — no libvtpu, no
+            # limits, not even the wrapper — measures the same solo/shared
+            # windows. Its shared-window inflation can only be the
+            # platform's relay concurrency (CHIP_ISOLATION_r05: concurrent
+            # sessions on this rig contend in the shared tunnel relay, not
+            # on chip — a cost a direct-attached deployment does not have),
+            # so the STACK-ATTRIBUTED degradation is the raw degradation
+            # with the control's inflation divided out. Both are published;
+            # the control rides INSIDE the same windows it corrects, so
+            # weather hits both symmetrically (no clamping — a negative
+            # control inflation raises the attributed number too). Caveat:
+            # the two controls are a 5th and 6th concurrent session, so
+            # shared windows carry two more sessions than the 4-way name
+            # implies and raw numbers are not directly comparable with
+            # control-free runs. The native control's own inflation
+            # (r05_5: -1.06%) bounds the marginal load of a direct-path
+            # session; stack_x additionally loads the loopback relay the
+            # sharing tenants ride — which is exactly the shared resource
+            # it exists to measure.
+            # Two controls ride the same windows:
+            #  - native (unwrapped, direct pool path): a zero-stack
+            #    reference — its inflation is what a stack-free session
+            #    pays for window concurrency (r05_5: -1.06%, nothing).
+            #  - stack_x (WRAPPED, uncapped, exclusive contract): rides
+            #    the same loopback relay the sharing tenants do — the
+            #    wrapped tenants share that relay's queue with each other,
+            #    which the native control structurally cannot see. Its
+            #    inflation is the transport-path concurrency cost WITHOUT
+            #    enforcement, so dividing it out isolates what the CAPPED
+            #    contract itself costs — the product behavior under test.
             solo: list[float] = []
             shared: list[float] = []
             sub_solo_medians: list[float] = []
+            nat_solo: list[float] = []
+            nat_shared: list[float] = []
+            wrp_solo: list[float] = []
+            wrp_shared: list[float] = []
             for _ in range(subcycles):
                 sub: list[float] = []
                 for s in stacks:  # each tenant alone on the chip
                     sub += s.run_block(solo_per_tenant)["ttfts"]
+                nat_solo += native.run_block(solo_per_tenant)["ttfts"]
+                wrp_solo += stack_x.run_block(solo_per_tenant)["ttfts"]
                 solo += sub
                 sub_solo_medians.append(statistics.median(sub))
                 for i, s in enumerate(stacks):  # all 4 at once, staggered
                     s.start_block(shared_per_tenant, interval_ms,
                                   i * interval_ms / TENANTS)
+                # the controls join the SAME concurrent window, offset to
+                # land between the stack tenants' arrivals
+                native.start_block(shared_per_tenant, interval_ms,
+                                   interval_ms / (2 * TENANTS))
+                stack_x.start_block(shared_per_tenant, interval_ms,
+                                    3 * interval_ms / (2 * TENANTS))
                 for s in stacks:
                     shared += s.read_block()["ttfts"]
+                nat_shared += native.read_block()["ttfts"]
+                wrp_shared += stack_x.read_block()["ttfts"]
             base_med = statistics.median(solo)
             shared_med = statistics.median(shared)
+            degradation = (shared_med - base_med) / base_med * 100.0
+            # Per-round control inflation is published for audit, but the
+            # attribution divides by the POOLED control (computed after
+            # acceptance): a round's control rests on ~6 TTFTs and a
+            # per-round division amplifies its noise into +-15 pp swings;
+            # the pooled estimate is stable and weather-symmetric.
+            native_infl = pooled_inflation(nat_solo, nat_shared)
             return {
                 "solo": solo, "shared": shared,
+                "nat_solo": nat_solo, "nat_shared": nat_shared,
+                "wrp_solo": wrp_solo, "wrp_shared": wrp_shared,
                 "base_median": base_med, "shared_median": shared_med,
                 "sub_solo_medians": sub_solo_medians,
-                "degradation": (shared_med - base_med) / base_med * 100.0,
+                "degradation": degradation,
+                "native_inflation": native_infl,
             }
 
         accepted: list[dict] = []
@@ -658,7 +742,8 @@ def main() -> None:
                 accepted.append(r)
                 log(f"sharing round {len(accepted)}: degradation "
                     f"{r['degradation']:+.2f}% (base "
-                    f"{r['base_median'] * 1e3:.1f} ms)")
+                    f"{r['base_median'] * 1e3:.1f} ms, native control "
+                    f"{r['native_inflation']:+.2f}%)")
         # Final pass of criterion (b) against the COMPLETE session: early
         # rounds were judged against a partial median. Still baseline-only.
         final_base = statistics.median(
@@ -703,6 +788,10 @@ def main() -> None:
     # per-upload breakdown of where libvtpu's time goes, from the shim's own
     # counters in the stack-exclusive tenant. The derived *_ms fields are the
     # added wrapper cost — real plugin time (enqueue/upload_real) excluded.
+    # r5 caveat: stack_x now also serves as the sharing windows' wrapped
+    # control, so its cumulative counters include contended-window activity;
+    # the attribution is an UPPER bound on solo wrapper cost and is not
+    # directly comparable with pre-r5 artifacts.
     # Shared-tenant throttle introspection: nonzero admit waits mean core
     # pacing fired during the sharing windows and polluted the sharing
     # signal (must be 0 under the SHARE_CORE_LIMIT contract; the field
@@ -743,18 +832,81 @@ def main() -> None:
             f"execute wrapper cost, {st['size_rpcs']} size RPCs over "
             f"{ex} executes ({st['size_cache_hits']} cache hits)")
 
-    srt = sorted(round_degradations)
-    degradation = srt[max(0, min(len(srt) - 1, round(0.9 * len(srt)) - 1))]  # p90
-    ci_lo, ci_hi = bootstrap_p90_ci(round_degradations)
+    def p90_of(vals: list[float]) -> float:
+        srt = sorted(vals)
+        return srt[max(0, min(len(srt) - 1, round(0.9 * len(srt)) - 1))]
+
+    round_native_infl = [r.get("native_inflation", 0.0) for r in accepted]
+    pooled_nat_solo = [t for r in accepted for t in r.get("nat_solo", [])]
+    pooled_nat_shared = [t for r in accepted for t in r.get("nat_shared", [])]
+    native_pooled_infl = pooled_inflation(pooled_nat_solo, pooled_nat_shared)
+    # Attribution control: the WRAPPED-uncapped tenant (see sharing_round) —
+    # it shares the loopback relay's queue with the sharing tenants, so its
+    # inflation is the transport-path concurrency cost without enforcement.
+    if any(r.get("wrp_solo") for r in accepted):
+        control_kind = "wrapped_uncapped_same_relay"
+        round_control = [(r.get("wrp_solo", []), r.get("wrp_shared", []))
+                         for r in accepted]
+    else:  # pre-control artifacts / fallback: the native reference
+        control_kind = "native"
+        round_control = [(r.get("nat_solo", []), r.get("nat_shared", []))
+                         for r in accepted]
+    ctrl_solo = [t for solo, _ in round_control for t in solo]
+    ctrl_shared = [t for _, shared in round_control for t in shared]
+    pooled_infl = pooled_inflation(ctrl_solo, ctrl_shared)
+    round_attributed = [
+        ((1.0 + d / 100.0) / (1.0 + pooled_infl / 100.0) - 1.0) * 100.0
+        for d in round_degradations]
+    degradation = p90_of(round_attributed)
+    raw_degradation = p90_of(round_degradations)
+    raw_ci = bootstrap_p90_ci(round_degradations)
+    # The attributed CI jointly resamples rounds AND the per-round control
+    # pools (same indices), so it carries the control's own sampling
+    # uncertainty at round granularity.
+    ci_lo, ci_hi = bootstrap_p90_ci(round_degradations, control=round_control)
+    log(f"{control_kind} control: pooled transport-path inflation "
+        f"{pooled_infl:+.2f}% over "
+        f"{len(ctrl_shared)} shared / {len(ctrl_solo)} solo "
+        f"samples; raw p90 {raw_degradation:+.2f}% -> attributed "
+        f"{degradation:+.2f}% (exploratory)")
     print(json.dumps({
+        # The headline stays the RAW p90. Control-based attribution was
+        # built and measured (both a stack-free native session and a
+        # wrapped-uncapped session riding the sharing tenants' relay, in
+        # the same windows), but on this tunnel both controls read
+        # NON-PHYSICAL negative inflations anticorrelated with the stack
+        # series (BENCH_VALIDATION_r05_6), so no correction is applied —
+        # dividing by a control we cannot explain would launder noise into
+        # the headline. The controls' series stay published as diagnostics:
+        # a stack-free session visibly pays ~nothing for the same windows,
+        # which bounds the platform's chip-level contention at zero without
+        # licensing a subtraction.
         "metric": "p90_round_ttft_degradation_4way_share_stack",
-        "value": round(degradation, 2),
+        "value": round(raw_degradation, 2),
         "unit": "percent",
-        "vs_baseline": round(degradation / 5.0, 3),
+        "vs_baseline": round(raw_degradation / 5.0, 3),
         # bootstrap 95% CI on the p90-of-rounds statistic itself: the SLO
         # claim is only as good as this interval's upper edge vs 5%
-        "degradation_p90_ci95": [round(ci_lo, 2), round(ci_hi, 2)],
-        "ci95_excludes_5pct": bool(ci_hi < 5.0),
+        "degradation_p90_ci95": [round(raw_ci[0], 2), round(raw_ci[1], 2)],
+        "ci95_excludes_5pct": bool(raw_ci[1] < 5.0),
+        # exploratory: control-corrected p90 + joint-bootstrap CI (see note)
+        "attributed_p90_exploratory": round(degradation, 2),
+        "attributed_p90_ci95_exploratory": [round(ci_lo, 2), round(ci_hi, 2)],
+        "control_pooled_inflation_pct": round(pooled_infl, 2),
+        "control_samples": [len(ctrl_solo), len(ctrl_shared)],
+        "control_kind": control_kind,
+        # Self-describing window shape (r5): shared windows carry the 4
+        # sharing tenants PLUS both always-on controls, while solo
+        # baselines are single-session — raw numbers are therefore not
+        # directly comparable with pre-r5 (control-free, 4-session)
+        # artifacts on the same metric key.
+        "shared_window_sessions": TENANTS + 2,
+        "solo_window_sessions": 1,
+        "native_reference_pooled_inflation_pct": round(native_pooled_infl, 2),
+        "native_reference_samples":
+            [len(pooled_nat_solo), len(pooled_nat_shared)],
+        "per_round_native_inflation": [round(x, 2) for x in round_native_infl],
+        "per_round_attributed": [round(x, 2) for x in round_attributed],
         "stack_in_loop": wrap,
         "p50_ttft_exclusive_native_ms": round(p50_nat * 1e3, 2),
         "p50_ttft_exclusive_stack_ms": round(p50_stk * 1e3, 2),

@@ -129,6 +129,32 @@ struct ScopedNs {
 // VTPU_CHARGE_FLOOR_MAX_MS (operator ceiling, default 1 s), every wall
 // pays at least 1/16 regardless of floor, and bucket aging (kMaxAgeNs) is
 // retained for any future periodic re-probe.
+//
+// r5: the floor stays ATTACH-PROBE-ONLY. On a shared relay the ambient
+// round trip rises and jitters with concurrent sessions' traffic —
+// queueing that is transport, not this tenant's chip busy
+// (CHIP_ISOLATION_r05: concurrent sessions on this rig contend in the
+// relay, never on chip) — and a static idle floor charges that jitter as
+// duty, pacing tenants whose true device busy is <1%
+// (BENCH_VALIDATION_r05_1: 20-40 s admit waits at 0.2% measured duty).
+// Two repairs were tried:
+//  (a) feeding gated tenant D2H walls into this min-floor — rejected
+//      twice over: a steady 1:1 tenant's walls converge the min on
+//      RTT+compute (the constant-work misread r4 documented), and
+//      BENCH_VALIDATION_r05_3 caught the dual failure mode live: ONE
+//      transiently-fast wall (57 ms on a ~97 ms session) stuck as the
+//      bucket min — sparse samples never rotate it out — halving the
+//      floor AND the floor-scaled cap threshold below, which re-enabled
+//      full-wall charging mid-run;
+//  (b) the charge-side cap in charge_sync_wall — kept: gated walls
+//      charge at most their provable own compute (pending executes x the
+//      event-fed EMA estimate), with eligibility scale-tested against
+//      this stable attach floor. Jitter is absorbed per-wall by the cap
+//      instead of being subtracted by a drifting floor, so no tenant
+//      sample can ever move the floor, in either direction.
+// Upward transport drift within a session is likewise absorbed by the
+// cap for gated walls (drift excess stays under the scale test); ungated
+// bursts over-charge conservatively, in the limit's favor.
 class RttFloor {
  public:
   static constexpr int kMinSamples = 4;
@@ -180,6 +206,29 @@ RttFloor& rtt_floor() {
   static RttFloor* f = new RttFloor();
   return *f;
 }
+
+// Charge-cap gate state (see RttFloor AMBIENT notes above). The counter
+// measures executes since the last D2H SUBMISSION, but work submitted
+// before the PREVIOUS fetch may still be draining on device when this one
+// runs (a D2H waits only for its own buffer's producer), so the provable
+// bound on compute hiding in a wall is the executes of the last TWO
+// submission windows — g_prev_execs carries the prior window's count
+// forward into the cap budget.
+std::atomic<int> g_d2h_inflight{0};
+std::atomic<uint32_t> g_execs_since_d2h{0};
+std::atomic<uint32_t> g_prev_execs{0};
+// Serializes the two-window rotation below: two racing fetches would
+// otherwise double-count one window's executes in both budgets and zero
+// the carry. D2H cadence is per decode tick (milliseconds), so a mutex
+// here is noise.
+std::mutex g_d2h_window_mu;
+constexpr uint64_t kAmbientMaxBytes = 256 * 1024;
+// Idle wall of a fetch-sized (128 KiB) round trip, probed at attach next to
+// the tiny-payload RttFloor: the charge cap's scale test judges gated FETCH
+// walls against this (see probe_transport_floor and charge_sync_wall); the
+// universal exemption floor stays tiny-payload. 0 = not probed (the scale
+// test then falls back to the tiny floor — tighter, conservative).
+std::atomic<uint64_t> g_fetch_floor_ns{0};
 
 // The floor charge_sync_wall actually starts from (before the per-wall 1/16
 // clamp): the operator-declared value when set, else the calibrated minimum
@@ -365,8 +414,9 @@ void destroy_event(PJRT_Event* ev);
 // waited to transfer completion, on the fresh client — BEFORE any tenant
 // work exists. The minimum of 4 round trips seeds the transport floor (see
 // RttFloor). Everything goes through s.real directly so the shim's own HBM
-// accounting never sees the probe buffers. Cost: ~4 RTTs once per attach
-// (µs locally, ~0.5 s on the dev tunnel — noise next to attach+compile).
+// accounting never sees the probe buffers. Cost: two phases of 4 round
+// trips each (tiny + 128 KiB payloads) once per attach — µs locally, ~1 s
+// on the dev tunnel; noise next to attach+compile.
 // Await-then-destroy a real-API event (probe helper).
 bool await_and_destroy(PJRT_Event* ev) {
   if (ev == nullptr) return true;
@@ -415,9 +465,25 @@ void probe_transport_floor(PJRT_Client* client) {
   }
   if (da.num_addressable_devices == 0) return;
 
-  float src[64] = {0};
-  int64_t dims[1] = {64};
-  char dst[sizeof(src)];
+  // TWO payloads are probed, for two different consumers:
+  //  - tiny (256 B): the universal charge-exemption floor (RttFloor). It
+  //    must stay payload-free — it deducts from EVERY sync wall, including
+  //    event-await and large/ungated D2H walls that carry no fetch
+  //    payload; a payload-sized value here would over-exempt real compute
+  //    on lying-event runtimes (r05_6 review finding).
+  //  - fetch-sized (128 KiB — the middle of the gated class, which
+  //    kAmbientMaxBytes bounds at 256 KiB): the charge cap's scale-test
+  //    reference (g_fetch_floor_ns). The cap judges gated FETCH walls,
+  //    and on a chunking relay a tiny-payload reference under-measures
+  //    their idle cost by the transfer time (BENCH_VALIDATION_r05_5:
+  //    71 ms tiny floor vs 115 ms idle fetch walls, which parked the
+  //    scale test right below typical walls and re-enabled the charging
+  //    the cap exists to prevent).
+  static float src[32 * 1024] = {0};
+  static char dst[sizeof(src)];
+  for (int phase = 0; phase < 2; phase++) {
+  int64_t dims[1] = {phase == 0 ? 64 : 32 * 1024};
+  uint64_t fetch_min = UINT64_MAX;
   for (int i = 0; i < RttFloor::kMinSamples; i++) {
     PJRT_Client_BufferFromHostBuffer_Args ba;
     std::memset(&ba, 0, sizeof(ba));
@@ -448,7 +514,7 @@ void probe_transport_floor(PJRT_Client* client) {
     th.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
     th.src = ba.buffer;
     th.dst = dst;
-    th.dst_size = sizeof(dst);
+    th.dst_size = (size_t)dims[0] * sizeof(float);
     if (ok) {
       PJRT_Error* terr = s.real->PJRT_Buffer_ToHostBuffer(&th);
       if (terr != nullptr) {
@@ -472,10 +538,25 @@ void probe_transport_floor(PJRT_Client* client) {
                 (unsigned long long)rtt_floor().floor_ns(tick_ns()));
       return;
     }
-    rtt_floor().record(t1 - t0, t1);
+    if (phase == 0) {
+      rtt_floor().record(t1 - t0, t1);
+    } else if (t1 - t0 < fetch_min) {
+      fetch_min = t1 - t0;
+    }
   }
-  VTPU_INFO("transport floor probed: %llu ns",
-            (unsigned long long)rtt_floor().floor_ns(tick_ns()));
+  if (phase == 1 && fetch_min != UINT64_MAX) {
+    // Same operator ceiling the tiny floor gets in base_charge_floor_ns: an
+    // attach into a congested relay must not inflate the cap's eligibility
+    // band for the process lifetime (the probe is attach-static).
+    if (fetch_min > s.limits.charge_floor_max_ns) {
+      fetch_min = s.limits.charge_floor_max_ns;
+    }
+    g_fetch_floor_ns.store(fetch_min, std::memory_order_relaxed);
+  }
+  }
+  VTPU_INFO("transport floors probed: tiny %llu ns, fetch %llu ns",
+            (unsigned long long)rtt_floor().floor_ns(tick_ns()),
+            (unsigned long long)g_fetch_floor_ns.load(std::memory_order_relaxed));
 }
 
 uint64_t buffer_device_size(PJRT_Buffer* buffer) {
@@ -1048,7 +1129,8 @@ PJRT_Error* wrapped_copy_to_memory(PJRT_Buffer_CopyToMemory_Args* args) {
 // when set, else the self-calibrated small-upload minimum (RttFloor) — so
 // the core knob works out of the box on tunneled runtimes, like the
 // reference's SM limit does locally.
-void charge_sync_wall(size_t dev_idx, uint64_t start_ns, uint64_t end_ns) {
+void charge_sync_wall(size_t dev_idx, uint64_t start_ns, uint64_t end_ns,
+                      int own_pending_execs = -1) {
   auto& s = S();
   if (!s.limits.core_enforced() && s.region == nullptr) return;
   DutyCycleLimiter* limiter;
@@ -1067,6 +1149,40 @@ void charge_sync_wall(size_t dev_idx, uint64_t start_ns, uint64_t end_ns) {
     if (floor > max_exempt) floor = max_exempt;
   }
   start_ns += floor;
+  // Own-work charge cap (r5, see RttFloor AMBIENT notes): when the caller
+  // PROVES how many of its own executes can be hiding in this wall
+  // (own_pending_execs >= 0 — only the gated D2H paths claim this) AND the
+  // wall is transport-scale for its class (wall <= 2x the FETCH-SIZED
+  // probed idle wall, g_fetch_floor_ns — the gated class moves payloads,
+  // and judging payload walls against the tiny-payload floor parked the
+  // threshold right below typical idle fetch walls
+  // [BENCH_VALIDATION_r05_4/5: tiny floor 71-80 ms vs idle fetch walls
+  // 115-135 ms], so transport-shaped walls charged in full), the charge
+  // is capped at that many executes'
+  // device-time estimate (the limiter's completion-event-fed EMA) plus
+  // copy slack. Relay-queueing jitter above the floor is transport, not
+  // duty: a MIN-based floor can never absorb it, and BENCH_VALIDATION_r05_1
+  // measured it pacing tenants at 0.2% true duty into 20-40 s admit waits.
+  // The scale test keeps lying-event runtimes honest: there a cycle's real
+  // compute also lands in the D2H wall (smoke 7c), but with local
+  // transport the floor is ~us, any real compute dwarfs it, and the wall
+  // charges in full. It also bounds the gaming surface: a 1:1
+  // execute-fetch adversary can hide at most one floor per RTT-serialized
+  // cycle, i.e. < 1/2 duty in the worst case, only on lying-event
+  // high-RTT relays — and a tenant pushing real compute past its quota
+  // pushes its walls past 2x floor and is charged in full. On
+  // direct-attached runtimes the cap never engages. Ungated walls
+  // (bursts of many executes per fetch — the CORESHARE proportionality
+  // case) are charged in full as before.
+  uint64_t fetch_floor = g_fetch_floor_ns.load(std::memory_order_relaxed);
+  if (fetch_floor == 0) fetch_floor = floor;  // probe absent: conservative
+  if (own_pending_execs >= 0 && floor > 0 && end_ns > start_ns &&
+      (end_ns - start_ns) + floor <= 2 * fetch_floor) {
+    constexpr uint64_t kD2hCopySlackNs = 500'000;  // small-transfer copy+sync
+    uint64_t cap =
+        (uint64_t)own_pending_execs * limiter->estimate_ns() + kD2hCopySlackNs;
+    if (end_ns > start_ns + cap) end_ns = start_ns + cap;
+  }
   if (end_ns > start_ns) {
     limiter->charge_interval(start_ns, end_ns);
   }
@@ -1100,13 +1216,17 @@ PJRT_Error* wrapped_event_await(PJRT_Event_Await_Args* args) {
 struct D2hCtx {
   size_t dev_idx;
   uint64_t start_ns;
+  bool cap_ok;
+  uint32_t pending_total;
 };
 
 void d2h_done_cb(PJRT_Error* error, void* user_arg) {
   auto* ctx = static_cast<D2hCtx*>(user_arg);
   uint64_t now = tick_ns();
+  g_d2h_inflight.fetch_sub(1, std::memory_order_relaxed);
   stats().tohost_ns.fetch_add(now - ctx->start_ns, std::memory_order_relaxed);
-  charge_sync_wall(ctx->dev_idx, ctx->start_ns, now);
+  charge_sync_wall(ctx->dev_idx, ctx->start_ns, now,
+                   ctx->cap_ok ? (int)ctx->pending_total : -1);
   if (error != nullptr) {
     PJRT_Error_Destroy_Args d{PJRT_Error_Destroy_Args_STRUCT_SIZE, nullptr, error};
     S().real->PJRT_Error_Destroy(&d);
@@ -1119,15 +1239,49 @@ PJRT_Error* wrapped_to_host(PJRT_Buffer_ToHostBuffer_Args* args) {
   auto& st = stats();
   st.tohost_calls.fetch_add(1, std::memory_order_relaxed);
   size_t dev_idx = 0;
+  uint64_t src_bytes = UINT64_MAX;  // unknown size fails the ambient gate
   {
     std::lock_guard<std::mutex> lock(s.mu);
     auto it = s.buffers.find(args->src);
-    if (it != s.buffers.end()) dev_idx = it->second.first;
+    if (it != s.buffers.end()) {
+      dev_idx = it->second.first;
+      src_bytes = it->second.second;
+    }
   }
+  // Charge-cap gate (see RttFloor AMBIENT notes and charge_sync_wall):
+  // eligibility is decided at submit — no other own D2H in flight, an
+  // untainted predecessor, small transfer — so the wall's hidden own
+  // compute is bounded by the KNOWN number of executes submitted since the
+  // previous D2H, and the charge can be capped at that many device-time
+  // estimates. A serving TTFT fetch typically follows several executes
+  // (prefill + cache install + first decode), so the cap scales with the
+  // count rather than requiring <=1; the fetch-floor scale test in
+  // charge_sync_wall bounds what a burst could hide regardless.
+  uint32_t pending_total;
+  {
+    std::lock_guard<std::mutex> wlock(g_d2h_window_mu);
+    uint32_t execs_now =
+        g_execs_since_d2h.exchange(0, std::memory_order_relaxed);
+    uint32_t execs_prev =
+        g_prev_execs.exchange(execs_now, std::memory_order_relaxed);
+    pending_total = execs_now + execs_prev;
+  }
+  // The gate state is process-global: on a multi-chip assignment one
+  // chip's executes would inflate another chip's cap budget (and its
+  // in-flight D2H would veto the cap for unrelated chips), so the cap —
+  // like the event-await wall charge above — only claims single-chip
+  // assignments, the case vTPU containers actually run.
+  bool cap_ok =
+      g_d2h_inflight.fetch_add(1, std::memory_order_relaxed) == 0 &&
+      src_bytes <= kAmbientMaxBytes &&
+      s.device_count.load(std::memory_order_relaxed) <= 1;
   uint64_t t0 = tick_ns();
   PJRT_Error* err = s.real->PJRT_Buffer_ToHostBuffer(args);
   uint64_t t1 = tick_ns();
-  if (err != nullptr) return err;
+  if (err != nullptr) {
+    g_d2h_inflight.fetch_sub(1, std::memory_order_relaxed);
+    return err;
+  }
   // The D2H completion EVENT is the one signal even eager-event runtimes
   // must keep honest — the caller's bytes have to actually arrive. Observe
   // it WITHOUT consuming and charge [call, ready]; if there is no event,
@@ -1140,7 +1294,7 @@ PJRT_Error* wrapped_to_host(PJRT_Buffer_ToHostBuffer_Args* args) {
   bool hooked = false;
   if (s.limits.d2h_event_hook && args->event != nullptr &&
       s.real->PJRT_Event_OnReady != nullptr) {
-    auto* ctx = new D2hCtx{dev_idx, t0};
+    auto* ctx = new D2hCtx{dev_idx, t0, cap_ok, pending_total};
     PJRT_Event_OnReady_Args on;
     std::memset(&on, 0, sizeof(on));
     on.struct_size = PJRT_Event_OnReady_Args_STRUCT_SIZE;
@@ -1156,8 +1310,9 @@ PJRT_Error* wrapped_to_host(PJRT_Buffer_ToHostBuffer_Args* args) {
     }
   }
   if (!hooked) {
+    g_d2h_inflight.fetch_sub(1, std::memory_order_relaxed);
     st.tohost_ns.fetch_add(t1 - t0, std::memory_order_relaxed);
-    charge_sync_wall(dev_idx, t0, t1);
+    charge_sync_wall(dev_idx, t0, t1, cap_ok ? (int)pending_total : -1);
   }
   return err;
 }
@@ -1273,6 +1428,7 @@ PJRT_Error* wrapped_execute(PJRT_LoadedExecutable_Execute_Args* args) {
   auto& s = S();
   auto& st = stats();
   st.executes.fetch_add(1, std::memory_order_relaxed);
+  g_execs_since_d2h.fetch_add(1, std::memory_order_relaxed);
   size_t dev_idx =
       args->execute_device ? device_index_of(args->execute_device) : 0;
 
