@@ -246,6 +246,7 @@ def _fuzz_check_invariants(client, sched, slice_of: dict,
 
 
 @pytest.mark.slow
+@pytest.mark.fuzz
 @pytest.mark.parametrize("seed", [11, 23, 37, 53, 71])
 def test_gang_multislice_churn_fuzzer(seed):
     """Randomized churn over the gang/multislice state machine (VERDICT r4
