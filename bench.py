@@ -804,6 +804,25 @@ def main() -> None:
                 "admit_wait_ms": round(s.stats["admit_ns"] / 1e6, 1),
                 "gate_wait_ms": round(s.stats["gate_ns"] / 1e6, 1),
                 "executes": s.stats["executes"],
+                # r5 charge-cap gate audit, per SHARING tenant (the paced
+                # ones — stack_x's attribution block is the unpaced
+                # exclusive tenant): which leg failed, and how much wall
+                # time was actually charged into this tenant's limiter.
+                "d2h_capped": s.stats.get("d2h_capped"),
+                "d2h_floored": s.stats.get("d2h_floored"),
+                "d2h_uncapped": s.stats.get("d2h_uncapped"),
+                "d2h_gate_inflight": s.stats.get("d2h_gate_inflight"),
+                "d2h_gate_size": s.stats.get("d2h_gate_size"),
+                "d2h_gate_multichip": s.stats.get("d2h_gate_multichip"),
+                "d2h_errors": s.stats.get("d2h_errors"),
+                # None-propagating like the d2h_* fields: absence (old shim)
+                # must stay distinguishable from a genuine zero
+                "sync_charged_ms": None if "sync_charged_ns" not in s.stats
+                else round(s.stats["sync_charged_ns"] / 1e6, 1),
+                "settled_busy_ms": None if "settled_busy_ns" not in s.stats
+                else round(s.stats["settled_busy_ns"] / 1e6, 1),
+                "rtt_floor_ms": None if "rtt_floor_ns" not in s.stats
+                else round(s.stats["rtt_floor_ns"] / 1e6, 1),
             }
             for i, s in enumerate(stacks) if s.stats
         ] or None
