@@ -30,6 +30,7 @@
 #include <time.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <atomic>
 #include <cstddef>
 #include <cstdint>
@@ -256,6 +257,23 @@ std::atomic<uint64_t> g_fetch_floor_ns{0};
 // state must never degrade because a monitor zeroed its counters.
 std::atomic<uint64_t> g_settles{0};
 std::atomic<uint64_t> g_settled_busy_ns{0};
+// Rolling window of recent cap-eligible D2H walls (guarded by
+// g_d2h_window_mu). On a PROXIED rig (fetch floor >= 10 ms) the scale
+// band tracks max(fetch_floor, min of these): a relay storm stretches
+// every wall together, and an attach-static band would flip them all to
+// charged-in-full exactly when transport misattribution is worst
+// (BENCH_VALIDATION_r05_11). The min over recent walls is the current
+// weather baseline; the budget stays the settled-busy figure either way.
+// Local/faithful runtimes (floor ~us) keep the static band, so the
+// lying-event smoke case (7c) and direct-attached prod are unaffected.
+// Trade, documented: on a lying-event HIGH-RTT relay a saturating 1:1
+// tenant's own walls raise the band over itself — dev-rig adversarial
+// tightness is traded for correct attribution; prod never takes this
+// path.
+constexpr int kRecentWalls = 32;
+constexpr uint64_t kProxiedFloorNs = 10'000'000;  // 10 ms
+uint64_t g_recent_walls[kRecentWalls] = {0};
+int g_recent_walls_idx = 0;
 
 // The floor charge_sync_wall actually starts from (before the per-wall 1/16
 // clamp): the operator-declared value when set, else the calibrated minimum
@@ -1166,13 +1184,13 @@ void charge_sync_wall(size_t dev_idx, uint64_t start_ns, uint64_t end_ns,
     limiter = s.dev(dev_idx).limiter;
   }
   uint64_t floor = base_charge_floor_ns(s.limits);
+  const uint64_t wall_ns = end_ns > start_ns ? end_ns - start_ns : 0;
   if (s.limits.charge_floor_ns == 0 && floor > 0) {
     // Bound the gameable surface: the auto floor never exempts more than
     // 15/16 of a wall, so a tenant that inflated its own calibration still
     // pays 1/16 of observed busy (see RttFloor adversarial notes). An
     // operator-DECLARED floor is trusted in full.
-    uint64_t wall = end_ns > start_ns ? end_ns - start_ns : 0;
-    uint64_t max_exempt = wall - wall / 16;
+    uint64_t max_exempt = wall_ns - wall_ns / 16;
     if (floor > max_exempt) floor = max_exempt;
   }
   start_ns += floor;
@@ -1203,12 +1221,40 @@ void charge_sync_wall(size_t dev_idx, uint64_t start_ns, uint64_t end_ns,
   // case) are charged in full as before.
   uint64_t fetch_floor = g_fetch_floor_ns.load(std::memory_order_relaxed);
   if (fetch_floor == 0) fetch_floor = floor;  // probe absent: conservative
+  uint64_t band_ref = fetch_floor;
+  if (own_pending_execs >= 0 && fetch_floor >= kProxiedFloorNs &&
+      wall_ns > 0) {
+    // Proxied rig: the band reference tracks current weather (see the
+    // g_recent_walls notes). Record this wall, then take the rolling min.
+    std::lock_guard<std::mutex> wlock(g_d2h_window_mu);
+    g_recent_walls[g_recent_walls_idx] = wall_ns;
+    g_recent_walls_idx = (g_recent_walls_idx + 1) % kRecentWalls;
+    uint64_t vals[kRecentWalls];
+    int have = 0;
+    for (int i = 0; i < kRecentWalls; i++) {
+      if (g_recent_walls[i] > 0) vals[have++] = g_recent_walls[i];
+    }
+    if (have >= 8) {
+      // Low percentile rather than strict min: one anomalously fast wall
+      // (runtime-prefetched data, event already ready) must not collapse
+      // the band back to the static floor for 32 walls mid-storm.
+      int k = have / 8;
+      std::nth_element(vals, vals + k, vals + have);
+      uint64_t weather = vals[k];
+      if (weather > band_ref) band_ref = weather;
+      // Hard ceiling: the dynamic band restores the adversarial bound the
+      // static test had — a lying-event tenant whose compute stretches its
+      // own walls past 4x the probed idle fetch wall fails the band and
+      // charges in full, so per-cycle hiding stays bounded instead of the
+      // band tracking the adversary's own walls without limit.
+      if (band_ref > 4 * fetch_floor) band_ref = 4 * fetch_floor;
+    }
+  }
   if (own_pending_execs >= 0) {
     if (end_ns <= start_ns) {
       // the floor absorbed the whole wall: nothing to cap, nothing charged
       stats().d2h_floored.fetch_add(1, std::memory_order_relaxed);
-    } else if (floor > 0 &&
-               (end_ns - start_ns) + floor <= 2 * fetch_floor) {
+    } else if (floor > 0 && wall_ns <= 2 * band_ref) {
       constexpr uint64_t kD2hCopySlackNs = 500'000;  // small copy+sync
       // The per-execute budget is the EVENT-SETTLED busy average, not the
       // limiter's admit EMA: the admit EMA is fed by settle_interval's
