@@ -262,8 +262,18 @@ def tenant_main(a: argparse.Namespace) -> None:
                 ttft, total = one_request()
                 ttfts.append(ttft)
                 totals.append(total)
+        # Decode data-plane telemetry rides every block: proves the
+        # one-device_get-per-tick transfer contract held under this
+        # tenant's real traffic and shows the host bookkeeping the
+        # pipelined loop hides under the next dispatch. Cumulative over
+        # the engine's lifetime — the parent keeps the last block's view.
+        es = eng.stats()
         print("BLOCK " + json.dumps({
             "rank": a.rank, "backend": backend, "ttfts": ttfts, "totals": totals,
+            "engine": {k: es[k] for k in (
+                "device_gets_per_tick", "bytes_fetched_per_tick",
+                "host_ms_per_tick", "device_sampling", "pipelined",
+                "pipelined_ticks", "decode_ticks", "generated_tokens")},
         }), flush=True)
     eng.stop()
     if os.environ.get("VTPU_BENCH_REGISTER") == "1":
@@ -326,6 +336,11 @@ def wrap_available() -> bool:
 
 class Tenant:
     def __init__(self, rank: int, wrap: bool, tag: str, core_limit: int = 25):
+        self.rank = rank
+        self.tag = tag
+        # last-seen serving-engine decode telemetry from this tenant's
+        # BLOCK lines (cumulative; the final block's view is the report)
+        self.engine_stats: dict | None = None
         env = dict(os.environ)
         (ROOT / "build").mkdir(exist_ok=True)
         # stderr to a file, not a pipe: a chatty runtime would fill a 64KB
@@ -380,7 +395,10 @@ class Tenant:
             line = self.proc.stdout.readline()
         if not line:
             raise RuntimeError(f"tenant died mid-block:\n{self._stderr_tail()}")
-        return json.loads(line[len("BLOCK "):])
+        blk = json.loads(line[len("BLOCK "):])
+        if "engine" in blk:
+            self.engine_stats = blk["engine"]
+        return blk
 
     def run_block(self, n: int, interval_ms: float = 0.0, stagger_ms: float = 0.0) -> dict:
         self.start_block(n, interval_ms, stagger_ms)
@@ -784,6 +802,20 @@ def main() -> None:
     rtt_after_ms = probe_dispatch_rtt_ms()
     log(f"dispatch RTT probe (end): {rtt_after_ms:.1f} ms")
 
+    # Serving-engine decode data plane, per tenant (the last block's
+    # cumulative view): with device-side sampling + pipelining on (the
+    # default) every tenant must read device_gets_per_tick == 1.0 at
+    # slots*4 bytes/tick; a host-sampler fallback or a disabled pipeline
+    # is immediately visible here, not buried in TTFT noise.
+    tenant_engine = [
+        {"tenant": f"{t.tag}{t.rank}", **t.engine_stats}
+        for t in tenants if t.engine_stats] or None
+    for e in tenant_engine or []:
+        log(f"engine[{e['tenant']}]: {e['device_gets_per_tick']} "
+            f"device_gets/tick, {e['bytes_fetched_per_tick']} B/tick, "
+            f"host {e['host_ms_per_tick']} ms/tick, pipelined={e['pipelined']} "
+            f"({e['pipelined_ticks']}/{e['decode_ticks']} decode ticks)")
+
     # Interception cost attribution (VERDICT r2 weak #1): per-execute /
     # per-upload breakdown of where libvtpu's time goes, from the shim's own
     # counters in the stack-exclusive tenant. The derived *_ms fields are the
@@ -945,6 +977,9 @@ def main() -> None:
         "overhead_rejection_exhausted": overhead_rejection_exhausted,
         "libvtpu_attribution": attribution,
         "shared_tenant_throttle": shared_throttle,
+        # decode data-plane contract per tenant (device_gets_per_tick must
+        # be 1.0 under the default device-sampled pipelined loop)
+        "tenant_engine_stats": tenant_engine,
         "tenants": TENANTS,
         "tenant_contract": {"hbm": "4g", "core_limit": SHARE_CORE_LIMIT,
                             "note": "full stack, core pacing ON: libvtpu "

@@ -1,0 +1,143 @@
+"""Decode data-plane A/B: host sampling + synchronous tick loop vs on-device
+batched sampling + one-tick-deep pipelined loop (ISSUE 1 tentpole).
+
+Both arms run the SAME ServingEngine over the same weights and prompts; only
+the sampling/pipelining configuration differs:
+
+  host arm:    ``sample=`` callable configured -> the engine's fallback path.
+               Every tick fetches the full [B, vocab] logits to the host and
+               argmaxes per slot in Python — the seed repo's hot path, and
+               what any custom sampler still gets today.
+  device arm:  default config -> sampling fused into the jitted decode step
+               (B*4 token bytes per tick instead of B*vocab*4 logit bytes),
+               tick t+1 dispatched from the device-resident sampled tokens
+               while the host delivers tick t (one-tick lookahead).
+
+Reports tokens/sec and host-overhead µs/tick per arm (from the engine's own
+stats() telemetry: device_gets_per_tick, bytes_fetched_per_tick,
+host_ms_per_tick) plus the device/host speedup. Timed windows exclude
+compiles: each arm runs one full warmup wave before measurement.
+
+Usage:  python benchmarks/decode_bench.py [--quick] [--slots 8]
+            [--steps 96] [--waves 3] [--repeats 3]
+Emits:  one JSON object on stdout (human summary on stderr). --quick trims
+        steps/waves/repeats for CI while keeping the 8-slot A/B shape.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser("decode-bench")
+    ap.add_argument("--quick", action="store_true",
+                    help="CI mode: fewer steps/waves/repeats, same A/B shape")
+    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--steps", type=int, default=96,
+                    help="decode tokens per request")
+    ap.add_argument("--waves", type=int, default=3,
+                    help="request waves per measurement (waves*slots requests;"
+                    " >1 exercises retire->re-admit slot reuse)")
+    ap.add_argument("--repeats", type=int, default=3,
+                    help="timed measurements per arm (median reported)")
+    a = ap.parse_args()
+    if a.quick:
+        a.steps, a.waves, a.repeats = 32, 1, 2
+
+    import jax
+
+    if jax.default_backend() != "cpu":
+        # the A/B is a host-overhead experiment; numbers are CPU-calibrated
+        print("note: running on", jax.default_backend(), file=sys.stderr)
+    import jax.numpy as jnp
+
+    from vtpu.models import ModelConfig, init_params
+    from vtpu.serving import ServingConfig, ServingEngine
+
+    # Tiny on purpose: per-tick device compute is small, so the A/B isolates
+    # what the tick LOOP costs — per-slot host argmax round-trips and the
+    # host/device serialization the pipelined arm hides.
+    cfg = ModelConfig(
+        vocab=256, d_model=64, n_heads=2, n_layers=2, d_ff=128,
+        max_seq=a.steps + 24, head_dim=32, dtype=jnp.float32, use_pallas=False,
+    )
+    params = init_params(jax.random.key(0), cfg)
+    serving = ServingConfig(slots=a.slots, prefill_buckets=(16,),
+                            max_new_tokens=a.steps)
+    prompts = [
+        [int(t) for t in jax.random.randint(
+            jax.random.key(100 + i), (12,), 0, cfg.vocab, jnp.int32)]
+        for i in range(a.slots * a.waves)
+    ]
+
+    def run_arm(name: str, **engine_kw) -> dict:
+        eng = ServingEngine(params, cfg, serving, **engine_kw)
+        eng.start()
+        try:
+            # warmup wave: prefill + decode compiles, thread steady state
+            for r in [eng.submit(p, max_new_tokens=4)
+                      for p in prompts[: a.slots]]:
+                for _ in r.stream():
+                    pass
+            rates = []
+            for _ in range(a.repeats):
+                t0 = time.perf_counter()
+                reqs = [eng.submit(p, max_new_tokens=a.steps)
+                        for p in prompts]
+                total = sum(
+                    sum(1 for _ in r.stream()) for r in reqs)
+                rates.append(total / (time.perf_counter() - t0))
+            stats = eng.stats()
+        finally:
+            eng.stop()
+        out = {
+            "arm": name,
+            "tokens_per_sec": round(statistics.median(rates), 1),
+            "tokens_per_sec_runs": [round(r, 1) for r in rates],
+            "host_overhead_us_per_tick": (
+                round(stats["host_ms_per_tick"] * 1e3, 1)
+                if stats["host_ms_per_tick"] is not None else None),
+            "device_gets_per_tick": stats["device_gets_per_tick"],
+            "bytes_fetched_per_tick": stats["bytes_fetched_per_tick"],
+            "device_sampling": stats["device_sampling"],
+            "pipelined": stats["pipelined"],
+        }
+        print(f"{name:>6}: {out['tokens_per_sec']:8.1f} tok/s, host "
+              f"{out['host_overhead_us_per_tick']} µs/tick, "
+              f"{out['bytes_fetched_per_tick']} B/tick "
+              f"({stats['device_gets_per_tick']} fetch/tick, "
+              f"pipelined={out['pipelined']})", file=sys.stderr)
+        return out
+
+    # host arm first so its (larger) compile set never shares a timed
+    # window with the device arm's
+    host = run_arm("host", sample=lambda logits: int(jnp.argmax(logits)))
+    device = run_arm("device")
+    speedup = device["tokens_per_sec"] / host["tokens_per_sec"]
+    print(f"device-sampled pipelined speedup: {speedup:.2f}x",
+          file=sys.stderr)
+    json.dump({
+        "metric": "device_pipelined_decode_speedup",
+        "value": round(speedup, 3),
+        "unit": "x_tokens_per_sec_vs_host_sync",
+        "slots": a.slots,
+        "steps": a.steps,
+        "waves": a.waves,
+        "quick": a.quick,
+        "model": {"vocab": cfg.vocab, "d_model": cfg.d_model,
+                  "n_layers": cfg.n_layers},
+        "arms": [host, device],
+    }, sys.stdout, indent=2)
+    print()
+
+
+if __name__ == "__main__":
+    main()

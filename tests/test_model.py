@@ -78,7 +78,18 @@ def test_decode_unroll_matches_fori(params):
 def test_int8_kv_decode_tracks_bf16(params):
     """kv_int8=True: the cache stores int8 values + per-token-per-head f32
     scales (half the decode read bytes); logits must track the exact-cache
-    path within quantization tolerance, and greedy tokens must match."""
+    path within quantization tolerance at EVERY step, and greedy argmax must
+    agree wherever the decision isn't inside the noise floor.
+
+    Teacher-forced multi-step comparison, not free-running greedy equality:
+    both paths decode the exact path's own greedy stream, so quantization
+    error is measured per step instead of compounding through divergent
+    trajectories. (The previous free-running assertion was chaotic by
+    construction: on this random tiny model one step's top-2 argmax margin
+    is 4e-4 while per-token-per-head int8 noise is a healthy, bounded
+    ~0.02-0.04 — far inside the 0.05 logit tolerance this same test already
+    accepts — so a coin-flip argmax fork compounded into arbitrary token
+    disagreement. Such margin-0 flips say nothing about the read path.)"""
     cfg_q = dataclasses.replace(TINY, kv_int8=True)
     tokens = jax.random.randint(jax.random.key(7), (2, 12), 0, TINY.vocab)
 
@@ -92,17 +103,31 @@ def test_int8_kv_decode_tracks_bf16(params):
     np.testing.assert_allclose(
         np.asarray(logits_q), np.asarray(logits_ex), rtol=1e-5, atol=1e-5)
 
-    # decode reads the quantized window: close, not identical
-    step_ex, cache_ex = decode_step(params, TINY, cache_ex, tokens[:, 0])
-    step_q, cache_q = decode_step(params, cfg_q, cache_q, tokens[:, 0])
-    np.testing.assert_allclose(
-        np.asarray(step_q), np.asarray(step_ex), rtol=0.05, atol=0.05)
-    assert int(cache_q["len"][0]) == 13
-
-    # end to end: greedy argmax is robust to the quant noise at this scale
-    out_ex = greedy_generate(params, TINY, tokens, steps=5)
-    out_q = greedy_generate(params, cfg_q, tokens, steps=5)
-    np.testing.assert_array_equal(np.asarray(out_ex), np.asarray(out_q))
+    # teacher-forced decode: every step reads a one-token-longer quantized
+    # window; error must stay bounded (no accumulation across steps) and
+    # argmax must agree whenever the exact path's top-2 margin clears the
+    # quantization noise the logit tolerance itself allows
+    tol = 0.05
+    cur = tokens[:, 0]
+    for step in range(6):
+        step_ex, cache_ex = decode_step(params, TINY, cache_ex, cur)
+        step_q, cache_q = decode_step(params, cfg_q, cache_q, cur)
+        np.testing.assert_allclose(
+            np.asarray(step_q), np.asarray(step_ex), rtol=tol, atol=tol,
+            err_msg=f"quantized decode logits diverged at step {step}")
+        top2 = np.asarray(jax.lax.top_k(step_ex, 2)[0])
+        # the margin bound must cover the error the allclose above permits
+        # on BOTH contenders (rtol*|logit| + atol each), or an in-tolerance
+        # error could flip an argmax this assert then blames on the read path
+        noise = tol * (np.abs(top2[:, 0]) + np.abs(top2[:, 1])) + 2 * tol
+        decided = (top2[:, 0] - top2[:, 1]) > noise
+        agree = np.asarray(
+            jnp.argmax(step_q, -1) == jnp.argmax(step_ex, -1))
+        assert agree[decided].all(), (
+            f"argmax flipped outside the noise floor at step {step}")
+        # follow the EXACT path's greedy choice on both caches
+        cur = jnp.argmax(step_ex, -1).astype(jnp.int32)
+    assert int(cache_q["len"][0]) == 12 + 6
 
 
 def test_int8_kv_decode_bucketed_and_unrolled(params):
@@ -138,3 +163,93 @@ def test_decode_attn_pallas_routing_matches_xla(params):
         want = np.asarray(greedy_generate(params, cfg_x, tokens, 8))
         got = np.asarray(greedy_generate(params, cfg_p, tokens, 8))
         np.testing.assert_array_equal(got, want)
+
+
+# --------------------------------------------------------------- sampling
+
+def test_sample_tokens_greedy_is_argmax():
+    """temperature=0: a bare batched argmax — token-identical to the host
+    argmax the engine's fallback sampler computes, keys untouched, and the
+    reported logprob is log-softmax at the chosen token."""
+    from vtpu.models.transformer import sample_tokens
+
+    logits = jax.random.normal(jax.random.key(0), (4, 50)) * 3.0
+    keys = jax.random.split(jax.random.key(1), 4)
+    tok, lp, keys_out = sample_tokens(logits, keys, temperature=0.0,
+                                      return_logprobs=True)
+    np.testing.assert_array_equal(
+        np.asarray(tok), np.asarray(jnp.argmax(logits, -1)))
+    want_lp = jax.nn.log_softmax(logits, -1)[jnp.arange(4), tok]
+    np.testing.assert_allclose(np.asarray(lp), np.asarray(want_lp),
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(jax.random.key_data(keys_out)),
+                                  np.asarray(jax.random.key_data(keys)))
+
+
+def test_sample_tokens_temperature_matches_softmax_distribution():
+    """Seeded distribution sanity: Gumbel-max draws over a known 8-token
+    distribution must reproduce softmax(logits/T) frequencies within
+    binomial noise (4 sigma at N=4096 — deterministic given the fixed
+    key, so a pass is reproducible, and a real sampling bug shows up as
+    tens of sigma)."""
+    from vtpu.models.transformer import sample_tokens
+
+    n, temp = 4096, 0.7
+    logits = jnp.asarray([[2.0, 1.5, 1.0, 0.5, 0.0, -0.5, -1.0, -1.5]])
+    keys = jax.random.split(jax.random.key(42), n)
+    tok, _, _ = sample_tokens(jnp.broadcast_to(logits, (n, 8)), keys,
+                              temperature=temp)
+    freq = np.bincount(np.asarray(tok), minlength=8) / n
+    p = np.asarray(jax.nn.softmax(logits[0] / temp))
+    sigma = np.sqrt(p * (1 - p) / n)
+    np.testing.assert_array_less(np.abs(freq - p), 4 * sigma + 1e-9)
+
+
+def test_sample_tokens_top_k_top_p_support():
+    """Filtering invariants: top-k draws only from the k highest logits,
+    top-p only from the smallest nucleus reaching p, and the top-1 token
+    always survives both cuts."""
+    from vtpu.models.transformer import sample_tokens
+
+    n = 512
+    logits = jnp.asarray([[3.0, 2.0, 1.0, 0.0, -1.0, -2.0, -3.0, -4.0]])
+    tiled = jnp.broadcast_to(logits, (n, 8))
+    keys = jax.random.split(jax.random.key(7), n)
+    tok_k, _, _ = sample_tokens(tiled, keys, temperature=1.0, top_k=3)
+    assert set(np.asarray(tok_k).tolist()) <= {0, 1, 2}
+    # nucleus at p=0.6: softmax mass is ~[.63,.23,...] — token 0 alone
+    # already reaches p (mass_before for token 1 is .63 >= .6), so the
+    # support is exactly {0}
+    p = np.asarray(jax.nn.softmax(logits[0]))
+    nucleus = {i for i in range(8) if p[:i].sum() < 0.6}
+    tok_p, _, _ = sample_tokens(tiled, keys, temperature=1.0, top_p=0.6)
+    assert set(np.asarray(tok_p).tolist()) <= nucleus
+    # degenerate nucleus: top_p at or below the top-1 mass still keeps it
+    # (top_p=0.0 would otherwise mask the whole row to -inf)
+    for p_deg in (1e-6, 0.0):
+        tok_1, lp_1, _ = sample_tokens(tiled, keys, temperature=1.0,
+                                       top_p=p_deg, return_logprobs=True)
+        assert set(np.asarray(tok_1).tolist()) == {0}
+        assert np.isfinite(np.asarray(lp_1)).all()
+
+
+def test_sample_tokens_per_slot_streams_independent_and_deterministic():
+    """Same keys -> same draws (replayable); keys advance per call; and a
+    slot's stream is a function of ITS key alone — neighbor rows don't
+    perturb it (the property that makes device sampling safe under
+    continuous batching admission churn)."""
+    from vtpu.models.transformer import sample_tokens
+
+    logits = jax.random.normal(jax.random.key(3), (4, 32))
+    keys = jax.random.split(jax.random.key(9), 4)
+    t1, _, k1 = sample_tokens(logits, keys, temperature=1.0)
+    t2, _, k2 = sample_tokens(logits, keys, temperature=1.0)
+    np.testing.assert_array_equal(np.asarray(t1), np.asarray(t2))
+    np.testing.assert_array_equal(np.asarray(jax.random.key_data(k1)),
+                                  np.asarray(jax.random.key_data(k2)))
+    assert not np.array_equal(np.asarray(jax.random.key_data(k1)),
+                              np.asarray(jax.random.key_data(keys)))
+    # perturb every OTHER row's logits: row 2's draw must not move
+    other = logits.at[0].add(5.0).at[1].add(-3.0).at[3].add(1.0)
+    t3, _, _ = sample_tokens(other, keys, temperature=1.0)
+    assert int(t3[2]) == int(t1[2])

@@ -423,7 +423,9 @@ def test_spec_decode_repetitive_prompt_fewer_ticks(params):
     steps = 24
     eng = ServingEngine(params, CFG, _spec_cfg(max_new_tokens=steps))
     calls = {"spec": 0, "decode": 0}
-    spec_fn, decode_fn = eng._spec, eng._decode
+    # plain fallback ticks route through the fused sampled step on the
+    # default (device-sampling) path; _decode exists only for custom samplers
+    spec_fn, decode_fn = eng._spec, eng._decode_sampled
 
     def counting_spec(*a, **kw):
         calls["spec"] += 1
@@ -433,7 +435,7 @@ def test_spec_decode_repetitive_prompt_fewer_ticks(params):
         calls["decode"] += 1
         return decode_fn(*a, **kw)
 
-    eng._spec, eng._decode = counting_spec, counting_decode
+    eng._spec, eng._decode_sampled = counting_spec, counting_decode
     eng.start()
     try:
         got = list(eng.submit(prompt, max_new_tokens=steps).stream())
@@ -648,7 +650,9 @@ def test_chunked_admission_interleaves_with_decode(params):
                             max_new_tokens=20, prefill_chunk=16)
     eng = ServingEngine(params, CFG, serving)
     order = []
-    chunk_fn, dec_fn = eng._prefill_chunk, eng._decode
+    # default config fuses sampling into the decode step (_decode_sampled);
+    # _decode exists only on the host-sampler fallback
+    chunk_fn, dec_fn = eng._prefill_chunk, eng._decode_sampled
 
     def chunk_w(*a, **kw):
         order.append("chunk")
@@ -658,7 +662,7 @@ def test_chunked_admission_interleaves_with_decode(params):
         order.append("decode")
         return dec_fn(*a, **kw)
 
-    eng._prefill_chunk, eng._decode = chunk_w, dec_w
+    eng._prefill_chunk, eng._decode_sampled = chunk_w, dec_w
     # both submitted BEFORE the loop starts: the first sweep admits the
     # short prompt into slot 0 (bucketed) and parks the long one (chunked),
     # so decode ticks and admission chunks deterministically coexist

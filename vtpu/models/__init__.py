@@ -14,6 +14,7 @@ from vtpu.models.transformer import (
     prefill,
     decode_step,
     greedy_generate,
+    sample_tokens,
 )
 from vtpu.models.moe import MoEConfig, init_moe_params, moe_forward, moe_loss
 from vtpu.models.ssm import (
@@ -38,6 +39,7 @@ __all__ = [
     "prefill",
     "decode_step",
     "greedy_generate",
+    "sample_tokens",
     "MoEConfig",
     "init_moe_params",
     "moe_forward",

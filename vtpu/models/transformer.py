@@ -12,7 +12,7 @@ TPU-first choices:
 from __future__ import annotations
 
 import dataclasses
-from typing import Any
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
@@ -115,6 +115,77 @@ def quantize_kv(x: jax.Array) -> tuple[jax.Array, jax.Array]:
     return q, scale
 
 
+
+
+def sample_tokens(
+    logits: jax.Array,
+    keys: jax.Array,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+    return_logprobs: bool = False,
+) -> tuple[jax.Array, Optional[jax.Array], jax.Array]:
+    """Batched on-device sampling: [B, vocab] f32 logits + [B] PRNG keys ->
+    ([B] int32 tokens, [B] f32 logprobs or None, advanced [B] keys).
+
+    All sampling config is STATIC, so a caller that closes over it and jits
+    gets the whole chain fused into its decode step — the per-tick
+    device->host transfer shrinks from B x vocab x 4 logit bytes to B x 4
+    token bytes, which is what makes the serving engine's pipelined tick
+    possible (the sampled array feeds the next dispatch device-resident).
+
+    temperature == 0 is greedy (a bare argmax; keys unused and returned
+    unchanged). Otherwise: temperature scaling, optional top-k cut (keep the
+    k highest logits), optional nucleus cut (keep the smallest set whose
+    probability mass reaches top_p; the top-1 token always survives), then
+    EXACT categorical sampling over the filtered distribution via the
+    Gumbel-max trick — argmax(logits + Gumbel noise) draws from
+    softmax(logits) without materializing a CDF, and masked entries at -inf
+    can never win. One key per slot: slot b's draw stream is independent of
+    its neighbors, so admission order in other slots never perturbs it.
+    Keys advance (split) once per call for every row, active or not.
+
+    return_logprobs: also return log p(token) under the FINAL (filtered,
+    temperature-scaled) distribution — what a serving API reports per
+    streamed token.
+    """
+    v = logits.shape[-1]
+    logits = logits.astype(jnp.float32)
+    if temperature <= 0.0:
+        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        lp = None
+        if return_logprobs:
+            lp = jnp.take_along_axis(
+                jax.nn.log_softmax(logits, axis=-1), tok[:, None], axis=-1
+            )[:, 0]
+        return tok, lp, keys
+    x = logits / temperature
+    if top_k and top_k < v:
+        kth = jax.lax.top_k(x, top_k)[0][:, -1:]
+        x = jnp.where(x < kth, -jnp.inf, x)
+    if top_p < 1.0:
+        srt = jnp.sort(x, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(srt, axis=-1)
+        mass_before = jnp.cumsum(probs, axis=-1) - probs
+        # the top-1 column is kept unconditionally: at top_p <= 0 the mass
+        # test alone keeps nothing (thresh = inf) and the whole row would
+        # collapse to -inf
+        keep = (mass_before < top_p).at[:, 0].set(True)
+        thresh = jnp.min(
+            jnp.where(keep, srt, jnp.inf), axis=-1, keepdims=True
+        )
+        x = jnp.where(x < thresh, -jnp.inf, x)
+    split = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
+    gumbel = jax.vmap(lambda k: jax.random.gumbel(k, (v,), jnp.float32))(
+        split[:, 0]
+    )
+    tok = jnp.argmax(x + gumbel, axis=-1).astype(jnp.int32)
+    lp = None
+    if return_logprobs:
+        lp = jnp.take_along_axis(
+            jax.nn.log_softmax(x, axis=-1), tok[:, None], axis=-1
+        )[:, 0]
+    return tok, lp, split[:, 1]
 
 
 def _qkv(cfg, lp, x, cos, sin, positions):
@@ -404,7 +475,14 @@ def spec_verify_loop(
 def greedy_generate(
     params: Params, cfg: ModelConfig, tokens: jax.Array, steps: int
 ) -> jax.Array:
-    """Prefill + `steps` greedy decode steps; returns [B, steps] generated ids."""
+    """Prefill + greedy decode; returns [B, steps] generated ids.
+
+    The FIRST generated id is the argmax of the prefill's last-position
+    logits — the same token a serving engine streams at admission — followed
+    by steps-1 decode steps. (Previously that token was computed to seed the
+    decode loop but dropped from the output, so the returned stream was ids
+    2..steps+1: self-consistent comparisons never noticed, but any check of
+    an engine stream against this reference was off by one.)"""
     logits, cache = prefill(params, cfg, tokens)
     tok = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
 
@@ -414,5 +492,6 @@ def greedy_generate(
         nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         return (nxt, cache), nxt
 
-    (_, _), out = jax.lax.scan(step, (tok, cache), None, length=steps)
-    return out.T  # [B, steps]
+    (_, _), out = jax.lax.scan(step, (tok, cache), None,
+                               length=max(steps - 1, 0))
+    return jnp.concatenate([tok[:, None], out.T], axis=1)[:, :steps]

@@ -16,6 +16,13 @@ Contract (all shapes static; the engine jits these with the state donated):
   prefill_into_slot(params, state, padded[1,bucket], slot, true_len)
       -> (last_logits[V], state)
   decode_step(params, state, tokens[B], active[B], kv_bucket) -> (logits, state)
+
+decode_step's [B, vocab] logits are a DEVICE-INTERNAL value on the default
+serving path: the engine composes decode_step with the on-device sampler
+(sampled_decode_step below) inside one jit, so a decode tick returns [B]
+int32 tokens — the array the pipelined loop feeds straight into the next
+dispatch. Logits only cross to the host when a custom ``sample=`` callable
+is configured (the fallback path, which also disables pipelining).
 """
 
 from __future__ import annotations
@@ -24,6 +31,32 @@ from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
+
+
+def sampled_decode_step(model: Any, temperature: float, top_k: int,
+                        top_p: float, logprobs: bool):
+    """Compose a slot model's decode_step with the on-device batched sampler
+    (models.transformer.sample_tokens) into ONE jit-able step:
+
+        (params, state, tokens[B], active[B], keys[B], kv_bucket, unroll)
+            -> (next_tokens[B] int32, logprobs[B] f32 | None, state, keys)
+
+    Works for every adapter family — the sampler only sees the [B, vocab]
+    logits the decode contract already guarantees. Sampling config is bound
+    statically here so XLA fuses filter + Gumbel + argmax into the decode
+    executable; the per-tick transfer is then B*4 bytes of tokens instead
+    of B*vocab*4 of logits."""
+    from vtpu.models.transformer import sample_tokens
+
+    def step(params, state, tokens, active, keys, kv_bucket, unroll=False):
+        logits, state = model.decode_step(
+            params, state, tokens, active, kv_bucket, unroll=unroll)
+        tok, lp, keys = sample_tokens(
+            logits, keys, temperature=temperature, top_k=top_k, top_p=top_p,
+            return_logprobs=logprobs)
+        return tok, lp, state, keys
+
+    return step
 
 
 class TransformerSlotModel:

@@ -23,6 +23,7 @@ import dataclasses
 import logging
 import queue
 import threading
+import time
 from typing import Any, Callable, Optional
 
 import jax
@@ -84,6 +85,35 @@ class ServingConfig:
     # bounded at C tokens of work. None = off (bucketed prompts only).
     # Short prompts keep using buckets (one dispatch beats ceil(n/C)).
     prefill_chunk: Optional[int] = None
+    # --- on-device batched sampling (the default decode path) ------------
+    # Sampling runs INSIDE the jitted decode step (transformer.sample_tokens
+    # composed via adapters.sampled_decode_step), so a tick fetches [B] int32
+    # tokens instead of [B, vocab] f32 logits. temperature 0 = greedy;
+    # temperature/top-k/top-p draw exact categorical samples via Gumbel-max
+    # with one PRNG stream per slot (seeded from sampling_seed). A custom
+    # ``sample=`` callable on the engine bypasses all of this (host fallback:
+    # full logits fetched per tick, no pipelining); the callable receives a
+    # fetched numpy [vocab] row — admission and per-tick alike — and returns
+    # a token id.
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    sampling_seed: int = 0
+    # Also stream log p(token) per generated token (Request.logprobs); adds
+    # B*4 bytes to the one per-tick fetch. Disables speculation: a verify
+    # tick returns token ids only, so spec-emitted tokens would have no
+    # logprob entries and the stream/logprobs pairing would silently skew.
+    logprobs: bool = False
+    # One-tick-deep decode pipelining: tick t+1 is dispatched with the
+    # device-resident sampled token array BEFORE tick t is delivered, so the
+    # host's Python bookkeeping for tick t overlaps the device computing
+    # t+1 (JAX async dispatch). A slot retired or re-admitted between the
+    # two invalidates only ITS in-flight lookahead (request-identity check
+    # at delivery). None = auto: on whenever device sampling is active and
+    # speculation is off (a spec tick must see the newest token on the host
+    # to build its draft, so speculation forces the synchronous loop).
+    # False forces the synchronous loop (still one device_get per tick).
+    pipeline_decode: Optional[bool] = None
 
 
 def choose_kv_int8(slots: int, max_window: int) -> bool:
@@ -111,6 +141,11 @@ class Request:
     prefix: Optional[int] = None  # id from ServingEngine.register_prefix
     out: "queue.Queue[Optional[int]]" = dataclasses.field(default_factory=queue.Queue)
     cancelled: bool = False
+    # per-token log p under the engine's sampling distribution, appended at
+    # delivery when ServingConfig.logprobs is on (device-sampled path only;
+    # index i pairs with the i-th DECODED token, the prefill first token has
+    # no entry)
+    logprobs: list = dataclasses.field(default_factory=list)
 
     def cancel(self) -> None:
         """Abandon the request: the engine retires its slot on the next tick
@@ -430,23 +465,86 @@ class ServingEngine:
         self.params = model.params
         self.cfg = getattr(model, "cfg", cfg)
         self.serving = serving
-        # speculation verifies against argmax, so it is only sound under the
-        # default greedy sampler; a model without spec_step can't speculate
+        # speculation verifies against argmax, so it is only sound under
+        # greedy sampling (the device default at temperature 0); a custom
+        # sampler or temperature > 0 would make the emitted stream diverge
+        # from its own non-speculative distribution, a spec tick emits
+        # tokens without per-token logprobs (the verify step returns ids
+        # only, so logprobs streaming forces plain ticks), and a model
+        # without spec_step can't speculate at all
         self._spec_tokens = (
             serving.spec_tokens
-            if sample is None and hasattr(model, "spec_step")
+            if sample is None and serving.temperature <= 0.0
+            and not serving.logprobs and hasattr(model, "spec_step")
             else 0
         )
         self.sample = sample or (lambda logits: int(jnp.argmax(logits)))
         b = serving.slots
         self.state = model.init_state(b)
-        # the state is donated through both jits: the engine is its only
-        # holder and reassigns self.state from the result, so XLA can alias
-        # input to output instead of copying the whole pool state per call
-        self._decode = jax.jit(
-            model.decode_step, static_argnames=("kv_bucket", "unroll"),
-            donate_argnums=(1,),
-        )
+        # Device-side sampling is the default: the sampler is fused into the
+        # jitted decode step (adapters.sampled_decode_step), so a tick's
+        # device->host transfer is [B] int32 tokens (+ optional [B] f32
+        # logprobs), not [B, vocab] f32 logits. A custom ``sample=``
+        # callable keeps the old host path (full logits per tick) — and
+        # disables pipelining, exactly as custom samplers disable
+        # speculation: the host must see logits before the next dispatch.
+        self._device_sampling = sample is None
+        if not self._device_sampling and serving.logprobs:
+            # the host fallback never computes log-probabilities (the
+            # callable returns a bare token id); silently streaming empty
+            # Request.logprobs would break the token/logprob pairing the
+            # field promises
+            raise ValueError(
+                "logprobs=True requires the device sampler; it is not "
+                "available with a custom sample= callable")
+        # the state is donated through every step jit: the engine is its
+        # only holder and reassigns self.state from the result, so XLA can
+        # alias input to output instead of copying the pool state per call
+        if self._device_sampling:
+            from vtpu.serving.adapters import sampled_decode_step
+
+            self._decode = None
+            self._decode_sampled = jax.jit(
+                sampled_decode_step(
+                    model, serving.temperature, serving.top_k,
+                    serving.top_p, serving.logprobs),
+                static_argnames=("kv_bucket", "unroll"),
+                donate_argnums=(1, 4),  # state + per-slot PRNG keys
+            )
+            self._rng = jax.random.split(
+                jax.random.key(serving.sampling_seed), b)
+            # admission-time first tokens draw from their own stream (one
+            # split per admission, host-side — admissions are rare next to
+            # ticks); greedy never touches it
+            self._admit_key = jax.random.key(serving.sampling_seed + 1)
+            from vtpu.models.transformer import sample_tokens
+
+            self._sample1 = jax.jit(
+                lambda logits, key: sample_tokens(
+                    logits[None], key[None],
+                    temperature=serving.temperature, top_k=serving.top_k,
+                    top_p=serving.top_p)[0][0])
+        else:
+            self._decode = jax.jit(
+                model.decode_step, static_argnames=("kv_bucket", "unroll"),
+                donate_argnums=(1,),
+            )
+            self._decode_sampled = None
+            self._rng = None
+        pipeline = serving.pipeline_decode
+        # pipelining needs device-resident next tokens (device sampling) and
+        # no speculation (a spec tick builds its draft from host history, so
+        # it must observe the previous token before dispatching). auto (None)
+        # downgrades silently; an EXPLICIT True that cannot be honored is a
+        # config contradiction and raises, like logprobs + custom sampler
+        if pipeline and (not self._device_sampling or self._spec_tokens):
+            raise ValueError(
+                "pipeline_decode=True requires device sampling (no custom "
+                "sample= callable) and no active speculation")
+        if pipeline is None:
+            pipeline = True
+        self._pipeline = bool(
+            pipeline and self._device_sampling and not self._spec_tokens)
         self._spec = jax.jit(
             model.spec_step, static_argnames=("kv_bucket", "unroll"),
             donate_argnums=(1,),
@@ -519,7 +617,16 @@ class ServingEngine:
                        "spec_ticks": 0, "spec_slot_ticks": 0,
                        "spec_emitted": 0,
                        "spec_emitted_hist": [0] * (serving.spec_tokens + 2),
-                       "prefill_chunks": 0, "admissions": 0}
+                       "prefill_chunks": 0, "admissions": 0,
+                       # per-tick transfer accounting: every loop
+                       # device->host read goes through _fetch, which counts
+                       # calls and payload bytes — the proof behind the
+                       # "one device_get per tick" contract
+                       "device_gets": 0, "bytes_fetched": 0,
+                       "pipelined_ticks": 0}
+        # EMA of host bookkeeping ms per delivered tick (the Python work the
+        # pipelined loop hides under the next dispatch)
+        self._host_ms_ema: Optional[float] = None
         # registered prompt prefixes: id -> {tokens, buffers, len, pad,
         # last_logits}; install is a device copy, suffixes chunk from the
         # prefix offset
@@ -740,7 +847,7 @@ class ServingEngine:
                 # no suffix: the first token comes straight from the
                 # prefix's stored final logits
                 self._finish_admit(
-                    slot, req, self.sample(entry["last_logits"]), base)
+                    slot, req, self._sample_first(entry["last_logits"]), base)
                 return
             self._admitting[slot] = {
                 "req": req, "padded": pad_to_chunks(prompt, n, self._chunk),
@@ -762,7 +869,7 @@ class ServingEngine:
         logits, self.state = self._prefill(
             self.params, self.state, padded, jnp.int32(slot), jnp.int32(n)
         )
-        self._finish_admit(slot, req, self.sample(logits), n)
+        self._finish_admit(slot, req, self._sample_first(logits), n)
 
     def _advance_admissions(self) -> None:
         """One prefill chunk for every mid-admission slot (then back to the
@@ -795,8 +902,94 @@ class ServingEngine:
                 pad = adm["padded"].shape[1]
                 self._finish_admit(
                     slot, req,
-                    self.sample(logits[0, (n - base - 1) - (pad - c)]), n,
+                    self._sample_first(logits[0, (n - base - 1) - (pad - c)]),
+                    n,
                 )
+
+    def _sample_first(self, logits) -> int:
+        """Sample a request's FIRST token from its prefill logits. Host
+        fallback uses the configured callable; device sampling draws greedy
+        (key-free argmax) or one categorical sample from the admission key
+        stream. Either way this is a per-ADMISSION device sync of a handful
+        of bytes, not a per-tick one — the tick loop's transfer contract
+        (see _fetch) is unaffected. The callable's contract is a fetched
+        numpy [vocab] row at BOTH call sites (here and the per-tick
+        fallback loop), never a device array."""
+        if not self._device_sampling:
+            return self.sample(jax.device_get(logits))
+        if self.serving.temperature <= 0.0:
+            return int(jnp.argmax(logits))
+        self._admit_key, sub = jax.random.split(self._admit_key)
+        return int(self._sample1(logits, sub))
+
+    def _fetch(self, arrays):
+        """The tick loop's ONLY device->host read: one batched device_get
+        per call, counted with its payload bytes so stats() can prove the
+        per-tick transfer contract (device_gets_per_tick == 1.0, and
+        bytes_fetched_per_tick == B*4 on the device-sampled path vs
+        B*vocab*4 on the host-sampler fallback)."""
+        self._stats["device_gets"] += 1
+        self._stats["bytes_fetched"] += sum(
+            a.size * a.dtype.itemsize
+            for a in jax.tree_util.tree_leaves(arrays))
+        return jax.device_get(arrays)
+
+    def _note_host_ms(self, seconds: float) -> None:
+        ms = seconds * 1e3
+        self._host_ms_ema = (
+            ms if self._host_ms_ema is None
+            else 0.9 * self._host_ms_ema + 0.1 * ms)
+
+    def _deliver(self, tick: dict, extra_host_s: float = 0.0) -> None:
+        """Deliver one decode tick's device-sampled tokens: ONE batched
+        fetch, then pure-Python bookkeeping (stream, budget, eos, retire).
+        ``extra_host_s`` is host work already spent on this loop pass
+        outside this call (the pipelined loop's dispatch-side build), folded
+        into the same host_ms_per_tick sample so the telemetry reports the
+        full per-tick host cost, not just the delivery half.
+
+        ``tick["reqs"]`` snapshots each slot's Request AT DISPATCH; a slot
+        whose occupant changed since (retired on the previous delivery,
+        cancelled, or recycled to a new request) fails the identity check
+        and its in-flight token is dropped — that token belongs to a
+        sequence that no longer exists, and the device state it advanced is
+        overwritten by the slot's next admission. This check is what makes
+        the one-tick lookahead safe: retire/admit invalidate a single
+        slot's lookahead, never the tick."""
+        if tick["logprobs"] is not None:
+            toks, lps = self._fetch((tick["tokens"], tick["logprobs"]))
+        else:
+            toks, lps = self._fetch(tick["tokens"]), None
+        t0 = time.perf_counter()
+        for slot, req in enumerate(tick["reqs"]):
+            if req is None or req is not self._slot_req[slot]:
+                continue
+            self._emit(slot, int(toks[slot]),
+                       float(lps[slot]) if lps is not None else None)
+        self._note_host_ms(extra_host_s + time.perf_counter() - t0)
+
+    def _emit(self, slot: int, tok: int, lp: Optional[float] = None) -> None:
+        """Per-slot bookkeeping for ONE delivered decode token — the single
+        implementation behind both the device-sampled delivery (_deliver)
+        and the host-sampler fallback, so budget/eos/retire semantics cannot
+        fork between the two paths. Mirrors the device first: its cache
+        length advanced for this slot at dispatch, unconditionally of what
+        eos does below."""
+        req = self._slot_req[slot]
+        self._tokens[slot] = tok
+        self._slot_len[slot] += 1
+        # logprob BEFORE the queue put: the put unblocks the client thread,
+        # which may immediately read logprobs[-1] expecting this token's
+        # entry to exist
+        if lp is not None:
+            req.logprobs.append(lp)
+        req.out.put(tok)
+        self._stats["generated_tokens"] += 1
+        self._slot_budget[slot] -= 1
+        if self._spec_tokens:
+            self._history[slot].append(tok)
+        if self._slot_budget[slot] <= 0 or tok == self.serving.eos_token:
+            self._retire(slot)
 
     def _finish_admit(self, slot: int, req: Request, first: int, n: int) -> None:
         self._slot_req[slot] = req
@@ -856,6 +1049,19 @@ class ServingEngine:
         s["admitting_slots"] = len(self._admitting)
         s["queued"] = self._pending.qsize()
         s["registered_prefixes"] = len(self._prefixes)
+        # per-tick transfer + host-overhead telemetry (the decode data-plane
+        # contract: ONE batched device_get per tick; B*4 bytes when sampling
+        # is on-device, B*vocab*4 on the host-sampler fallback)
+        ticks = s["decode_ticks"] + s["spec_ticks"]
+        s["device_gets_per_tick"] = (
+            round(s["device_gets"] / ticks, 4) if ticks else None)
+        s["bytes_fetched_per_tick"] = (
+            round(s["bytes_fetched"] / ticks, 1) if ticks else None)
+        s["host_ms_per_tick"] = (
+            round(self._host_ms_ema, 4)
+            if self._host_ms_ema is not None else None)
+        s["device_sampling"] = self._device_sampling
+        s["pipelined"] = self._pipeline
         return s
 
     def _retire(self, slot: int) -> None:
@@ -878,10 +1084,16 @@ class ServingEngine:
         tokens = jnp.zeros((b,), jnp.int32)
         inactive = jnp.zeros((b,), bool)
         for bucket in (self._kv_buckets if self._use_kv_buckets else (0,)):
-            _, self.state = self._decode(
-                self.params, self.state, tokens, inactive, bucket,
-                unroll=self._unroll,
-            )
+            if self._device_sampling:
+                _, _, self.state, self._rng = self._decode_sampled(
+                    self.params, self.state, tokens, inactive, self._rng,
+                    bucket, unroll=self._unroll,
+                )
+            else:
+                _, self.state = self._decode(
+                    self.params, self.state, tokens, inactive, bucket,
+                    unroll=self._unroll,
+                )
             if self._spec is not None:
                 _, _, self.state = self._spec(
                     self.params, self.state,
@@ -890,10 +1102,14 @@ class ServingEngine:
                     unroll=self._unroll,
                 )
         for bucket in self._prefill_buckets:
-            _, self.state = self._prefill(
+            logits, self.state = self._prefill(
                 self.params, self.state, jnp.zeros((1, bucket), jnp.int32),
                 jnp.int32(0), jnp.int32(1),
             )
+        if self._device_sampling and self.serving.temperature > 0.0:
+            # the admission-time sampler draws the first token of every
+            # request; its first-use compile must not happen in-loop either
+            self._sample1(logits, jax.random.key(0))
         if self._prefill_chunk is not None:
             # one executable per (chunk, read-bucket) pair. EVERY bucket
             # >= chunk is reachable: prefix-cached admissions chunk from
@@ -910,60 +1126,188 @@ class ServingEngine:
     def _loop(self) -> None:
         try:
             self._warm_executables()
-            self._loop_body()
+            if self._pipeline:
+                self._loop_pipelined()
+            else:
+                self._loop_sync()
         finally:
             # the loop owns slot/queue state, so it also owns the shutdown
             # sweep: every live Request gets its end-of-stream sentinel the
             # moment the loop exits (stop() only waits, never mutates)
             self._drain_all()
 
-    def _loop_body(self) -> None:
+    def _tick_head(self) -> bool:
+        """Between-tick host work shared by both loop flavors: fill every
+        idle slot that has a waiter (cancelled waiters are skipped IN PLACE
+        so they never cost an idle slot a decode tick), advance one prefill
+        chunk per mid-admission slot, and retire slots whose client walked
+        away. Returns whether any admission happened."""
+        b = self.serving.slots
+        admitted = False
+        drained = False
+        for slot in range(b):
+            if drained:
+                break
+            while self._slot_req[slot] is None and slot not in self._admitting:
+                try:
+                    req = self._pending.get_nowait()
+                except queue.Empty:
+                    drained = True
+                    break
+                if req.cancelled:
+                    req.out.put(None)
+                    continue
+                self._admit(slot, req)
+                admitted = True
+        self._advance_admissions()
+        for slot in range(b):
+            req = self._slot_req[slot]
+            if req is not None and req.cancelled:
+                self._retire(slot)
+        return admitted
+
+    def _idle_wait(self, admitted: bool) -> None:
+        """Nothing to decode and nothing in flight: block briefly on the
+        queue so an idle engine doesn't spin — unless admissions are mid-
+        chunk (keep advancing them) or one just landed this pass."""
+        if self._admitting or admitted:
+            return
+        try:
+            req = self._pending.get(timeout=0.05)
+        except queue.Empty:
+            return
+        if req.cancelled:
+            req.out.put(None)
+            return
+        self._admit(0, req)
+
+    def _loop_pipelined(self) -> None:
+        """One-tick-deep decode pipeline (device sampling on, speculation
+        off):
+
+            dispatch tick t   -> device starts computing t immediately
+            deliver tick t-1  -> ONE batched device_get (t-1 is already
+                                 done), then Python bookkeeping runs WHILE
+                                 the device works on t
+
+        Tick t's token inputs are tick t-1's sampled tokens, still
+        device-resident — no host round-trip sits between consecutive
+        ticks. The host runs one tick behind, so slot lifecycle needs care:
+
+        - budget exhaustion is PREDICTED at dispatch: a slot whose
+          in-flight token spends its last budget is excluded from the new
+          tick (it will retire at delivery), so the device length never
+          runs past the budget wall;
+        - eos is not predictable: an eos at t-1 wastes exactly one
+          slot-tick of device work at t, and _deliver's request-identity
+          check drops the orphaned token (the slot's next admission
+          overwrites the over-advanced cache row wholesale);
+        - a slot admitted after t's dispatch joins at t+1, its prefill
+          first token supplied as a host override into the lookahead
+          array.
+        """
+        b = self.serving.slots
+        inflight: Optional[dict] = None
+        # the [B] active mask only changes on admit/retire; cache the device
+        # array keyed on the dispatch set so steady-state ticks skip the
+        # rebuild + upload (the tokens input already skips its own)
+        active = None
+        active_key: Optional[tuple] = None
+        while not self._stop.is_set():
+            admitted = self._tick_head()
+            t_disp = time.perf_counter()
+            # fed[i]: slot i's next token is the in-flight tick's device
+            # sample (same request then and now; identity survives neither
+            # retire nor recycle)
+            fed = [
+                inflight is not None
+                and inflight["reqs"][i] is not None
+                and inflight["reqs"][i] is self._slot_req[i]
+                for i in range(b)
+            ]
+            dispatch = [
+                i for i in range(b)
+                if self._slot_req[i] is not None
+                and self._slot_budget[i] - (1 if fed[i] else 0) > 0
+            ]
+            if not dispatch and inflight is None:
+                self._idle_wait(admitted)
+                continue
+            new_inflight = None
+            disp_s = 0.0
+            if dispatch:
+                live = set(dispatch)
+                if inflight is not None and all(fed[i] for i in dispatch):
+                    # steady state (no admit/retire since last tick): feed
+                    # the in-flight device tokens straight back — no host
+                    # upload, no where; non-dispatched rows carry stale
+                    # device values the active mask ignores
+                    tokens = inflight["tokens"]
+                elif inflight is None:
+                    tokens = jnp.asarray(self._tokens, jnp.int32)
+                else:
+                    tokens = jnp.where(
+                        jnp.asarray(fed, bool), inflight["tokens"],
+                        jnp.asarray(self._tokens, jnp.int32))
+                if active_key != tuple(dispatch):
+                    active = jnp.asarray([i in live for i in range(b)], bool)
+                    active_key = tuple(dispatch)
+                if self._use_kv_buckets:
+                    # the host length mirror lags one tick for in-flight
+                    # slots; the read window must cover the DEVICE length
+                    need = 1 + max(
+                        self._slot_len[i] + (1 if fed[i] else 0)
+                        for i in dispatch)
+                    kv_bucket = next(
+                        (bkt for bkt in self._kv_buckets if bkt >= need),
+                        self.model.max_context,
+                    )
+                else:
+                    kv_bucket = 0
+                tok_d, lp_d, self.state, self._rng = self._decode_sampled(
+                    self.params, self.state, tokens, active, self._rng,
+                    kv_bucket, unroll=self._unroll,
+                )
+                self._stats["decode_ticks"] += 1
+                if inflight is not None:
+                    self._stats["pipelined_ticks"] += 1
+                new_inflight = {
+                    "tokens": tok_d, "logprobs": lp_d,
+                    "reqs": [self._slot_req[i] if i in live else None
+                             for i in range(b)],
+                }
+                disp_s = time.perf_counter() - t_disp
+            if inflight is not None:
+                self._deliver(inflight, extra_host_s=disp_s)
+            inflight = new_inflight
+        if inflight is not None:
+            # stop() landed between dispatch and delivery: the tick's
+            # tokens are already computed — deliver them so a mid-stream
+            # client loses nothing the sync loop would have given it (and
+            # the device_gets == decode_ticks contract survives shutdown)
+            self._deliver(inflight)
+
+    def _loop_sync(self) -> None:
+        """Synchronous tick loop: dispatch, deliver, repeat. Used when a
+        custom host sampler needs the full logits each tick, or when
+        speculation is on (drafts are built from host-side history, so the
+        newest token must be observed before the next dispatch). Still one
+        batched device_get per tick — only the overlap is missing."""
         b = self.serving.slots
         while not self._stop.is_set():
-            # 1. admission first: fill every idle slot that has a waiter.
-            # Cancelled waiters are skipped IN PLACE (inner loop) so they
-            # never cost an idle slot a decode tick.
-            admitted = False
-            drained = False
-            for slot in range(b):
-                if drained:
-                    break
-                while self._slot_req[slot] is None and slot not in self._admitting:
-                    try:
-                        req = self._pending.get_nowait()
-                    except queue.Empty:
-                        drained = True
-                        break
-                    if req.cancelled:
-                        req.out.put(None)
-                        continue
-                    self._admit(slot, req)
-                    admitted = True
-            # one prefill chunk per mid-admission slot, between decode ticks
-            self._advance_admissions()
-            # retire slots whose client walked away before decoding for them
-            for slot in range(b):
-                req = self._slot_req[slot]
-                if req is not None and req.cancelled:
-                    self._retire(slot)
+            admitted = self._tick_head()
             active_slots = [i for i in range(b) if self._slot_req[i] is not None]
             if not active_slots:
-                if self._admitting:
-                    continue  # keep advancing chunks; never block on the queue
-                if not admitted:
-                    try:
-                        req = self._pending.get(timeout=0.05)
-                    except queue.Empty:
-                        continue
-                    if req.cancelled:
-                        req.out.put(None)
-                        continue
-                    self._admit(0, req)
+                self._idle_wait(admitted)
                 continue
             # 2. one decode tick for the whole pool; the read window is the
             # smallest bucket past the longest LIVE sequence (this tick
             # writes chunk tokens starting at len, so the view must cover
-            # len + chunk)
+            # len + chunk). Dispatch-side host work (array builds, bucket
+            # pick, draft scans) is timed into the same host_ms sample the
+            # delivery side feeds, so the telemetry is comparable with the
+            # pipelined loop's
+            t_disp = time.perf_counter()
             tokens = jnp.asarray(self._tokens, jnp.int32)
             active = jnp.asarray(
                 [self._slot_req[i] is not None for i in range(b)], bool
@@ -1004,7 +1348,9 @@ class ServingEngine:
                     self.params, self.state, draft, active, cap, kv_bucket,
                     unroll=self._unroll,
                 )
-                pred, count = jax.device_get((pred, count))
+                disp_s = time.perf_counter() - t_disp
+                pred, count = self._fetch((pred, count))
+                t0 = time.perf_counter()
                 emitted_total = 0
                 for slot in active_slots:
                     emitted = [int(x) for x in pred[slot, : int(count[slot])]]
@@ -1050,21 +1396,36 @@ class ServingEngine:
                 if (self.serving.spec_min_mean
                         and self._spec_ema < self.serving.spec_min_mean):
                     self._spec_cooloff = self.serving.spec_cooloff_ticks
+                self._note_host_ms(disp_s + time.perf_counter() - t0)
                 continue
+            if self._device_sampling:
+                # fused device sampling: the tick returns [B] tokens, not
+                # logits, and _deliver does the one batched fetch
+                tok_d, lp_d, self.state, self._rng = self._decode_sampled(
+                    self.params, self.state, tokens, active, self._rng,
+                    kv_bucket, unroll=self._unroll,
+                )
+                self._stats["decode_ticks"] += 1
+                # active_slots IS the set of non-None _slot_req entries
+                # this iteration, so the snapshot is simply the list (the
+                # pipelined loop's dispatch can be a strict subset; here it
+                # cannot)
+                self._deliver({
+                    "tokens": tok_d, "logprobs": lp_d,
+                    "reqs": list(self._slot_req),
+                }, extra_host_s=time.perf_counter() - t_disp)
+                continue
+            # host-sampler fallback: fetch the FULL logits once (still a
+            # single batched device_get — never B per-slot syncs) and run
+            # the callable per live row
             logits, self.state = self._decode(
                 self.params, self.state, tokens, active, kv_bucket,
                 unroll=self._unroll,
             )
             self._stats["decode_ticks"] += 1
+            disp_s = time.perf_counter() - t_disp
+            logits = self._fetch(logits)
+            t0 = time.perf_counter()
             for slot in active_slots:
-                tok = self.sample(logits[slot])
-                self._tokens[slot] = tok
-                self._slot_len[slot] += 1
-                req = self._slot_req[slot]
-                req.out.put(tok)
-                self._stats["generated_tokens"] += 1
-                self._slot_budget[slot] -= 1
-                if self._spec_tokens:
-                    self._history[slot].append(tok)
-                if self._slot_budget[slot] <= 0 or tok == self.serving.eos_token:
-                    self._retire(slot)
+                self._emit(slot, self.sample(logits[slot]))
+            self._note_host_ms(disp_s + time.perf_counter() - t0)
