@@ -273,7 +273,14 @@ def tenant_main(a: argparse.Namespace) -> None:
             "engine": {k: es[k] for k in (
                 "device_gets_per_tick", "bytes_fetched_per_tick",
                 "host_ms_per_tick", "device_sampling", "pipelined",
-                "pipelined_ticks", "decode_ticks", "generated_tokens")},
+                "pipelined_ticks", "decode_ticks", "generated_tokens",
+                # admission data plane: host stall EMA in _tick_head,
+                # batched prefill dispatch sizes, blocking admission syncs
+                # (0 on the batched-async path), and this engine's own
+                # inter-token-latency percentiles
+                "admission_stall_ms", "prefill_batch_hist",
+                "admission_syncs", "batched_admission",
+                "itl_p50_ms", "itl_p99_ms")},
         }), flush=True)
     eng.stop()
     if os.environ.get("VTPU_BENCH_REGISTER") == "1":

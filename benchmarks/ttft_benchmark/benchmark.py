@@ -11,10 +11,15 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import statistics
 import sys
 import time
 import urllib.request
+
+# one percentile convention for the whole benchmark pair: report.py owns it
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from report import pct  # noqa: E402
 
 
 def one_request(url: str, prompt_len: int, max_tokens: int) -> dict:
@@ -38,6 +43,10 @@ def one_request(url: str, prompt_len: int, max_tokens: int) -> dict:
         "ttft_ms": (ttft or 0.0) * 1e3,
         "tokens": len(stamps),
         "per_token_ms": statistics.mean(gaps) * 1e3 if gaps else 0.0,
+        # raw inter-token gaps: report.py aggregates run-level ITL
+        # percentiles from these (a per-request mean hides tail stalls —
+        # exactly what admission bursts inflict)
+        "gaps_ms": [round(g * 1e3, 3) for g in gaps],
         "total_ms": (stamps[-1] - start) * 1e3 if stamps else 0.0,
         "ts": time.time(),
     }
@@ -77,10 +86,17 @@ def main() -> None:
     print(file=sys.stderr)
 
     ttfts = sorted(s["ttft_ms"] for s in samples)
-    p50 = statistics.median(ttfts)
-    p99 = ttfts[min(len(ttfts) - 1, int(len(ttfts) * 0.99))]
-    print(json.dumps({"runs": len(samples), "p50_ttft_ms": round(p50, 2),
-                      "p99_ttft_ms": round(p99, 2), "out": args.out}))
+    itl = sorted(g for s in samples for g in s["gaps_ms"])
+    print(json.dumps({
+        "runs": len(samples),
+        "p50_ttft_ms": round(statistics.median(ttfts), 2),
+        "p95_ttft_ms": round(pct(ttfts, 0.95), 2),
+        "p99_ttft_ms": round(pct(ttfts, 0.99), 2),
+        "p50_itl_ms": round(pct(itl, 0.50), 2),
+        "p95_itl_ms": round(pct(itl, 0.95), 2),
+        "p99_itl_ms": round(pct(itl, 0.99), 2),
+        "out": args.out,
+    }))
 
 
 if __name__ == "__main__":

@@ -27,12 +27,21 @@ def pct(sorted_vals: list[float], q: float) -> float:
 def stats(samples: list[dict]) -> dict:
     ttfts = sorted(s["ttft_ms"] for s in samples)
     per_tok = sorted(s["per_token_ms"] for s in samples)
+    # run-level inter-token latency percentiles from the raw gaps (newer
+    # benchmark.py records gaps_ms per request; older JSONL falls back to
+    # the per-request means so mixed files still aggregate)
+    gaps = sorted(g for s in samples for g in s.get("gaps_ms", []))
+    if not gaps:
+        gaps = per_tok
     return {
         "runs": len(samples),
         "p50_ttft_ms": statistics.median(ttfts) if ttfts else 0.0,
         "p90_ttft_ms": pct(ttfts, 0.90),
+        "p95_ttft_ms": pct(ttfts, 0.95),
         "p99_ttft_ms": pct(ttfts, 0.99),
         "p50_per_token_ms": statistics.median(per_tok) if per_tok else 0.0,
+        "p50_itl_ms": pct(gaps, 0.50),
+        "p99_itl_ms": pct(gaps, 0.99),
     }
 
 
@@ -49,7 +58,9 @@ def main() -> None:
         sys.exit("empty sample file")
 
     rows = [("", "exclusive", "shared")]
-    for key in ("runs", "p50_ttft_ms", "p90_ttft_ms", "p99_ttft_ms", "p50_per_token_ms"):
+    for key in ("runs", "p50_ttft_ms", "p90_ttft_ms", "p95_ttft_ms",
+                "p99_ttft_ms", "p50_per_token_ms", "p50_itl_ms",
+                "p99_itl_ms"):
         rows.append((key, f"{base[key]:.2f}" if isinstance(base[key], float) else str(base[key]),
                      f"{cand[key]:.2f}" if isinstance(cand[key], float) else str(cand[key])))
     width = max(len(r[0]) for r in rows) + 2

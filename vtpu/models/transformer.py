@@ -225,9 +225,17 @@ def transformer_layer(
 
 
 def prefill(
-    params: Params, cfg: ModelConfig, tokens: jax.Array
+    params: Params, cfg: ModelConfig, tokens: jax.Array,
+    logits_at: Optional[jax.Array] = None,
 ) -> tuple[jax.Array, dict[str, jax.Array]]:
-    """Full-sequence forward. tokens: [B, S] int32. Returns (logits, kv_cache)."""
+    """Full-sequence forward. tokens: [B, S] int32. Returns (logits, kv_cache).
+
+    ``logits_at`` ([B] int32 positions) gathers the trunk output at one
+    position per row BEFORE the vocab projection, returning [B, vocab]
+    instead of [B, S, vocab] — admission only consumes each prompt's final
+    position, and the full-bucket projection is O(S*D*V) of wasted compute
+    (and, batched, an [N, bucket, vocab] f32 intermediate) at every prefill
+    dispatch."""
     b, s = tokens.shape
     cos, sin = rope_angles(cfg.max_seq, cfg.head_dim)
     positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
@@ -238,6 +246,8 @@ def prefill(
 
     x, (ks, vs) = jax.lax.scan(layer, x, params["layers"])
     x = rms_norm(x, params["final_norm"])
+    if logits_at is not None:
+        x = x[jnp.arange(b), logits_at]  # [B, D]
     logits = (x @ params["embed"].T).astype(jnp.float32)
 
     cache = init_kv_cache(cfg, b)

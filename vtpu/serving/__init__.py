@@ -1,6 +1,13 @@
 """TPU-native serving engine: continuous batching over a slot-based KV cache."""
 
-from vtpu.serving.engine import Request, ServingConfig, ServingEngine, batched_decode_step, prefill_into_slot
+from vtpu.serving.engine import (
+    Request,
+    ServingConfig,
+    ServingEngine,
+    batched_decode_step,
+    prefill_into_slot,
+    prefill_into_slots,
+)
 
 __all__ = [
     "Request",
@@ -8,4 +15,5 @@ __all__ = [
     "ServingEngine",
     "batched_decode_step",
     "prefill_into_slot",
+    "prefill_into_slots",
 ]

@@ -59,6 +59,36 @@ def sampled_decode_step(model: Any, temperature: float, top_k: int,
     return step
 
 
+def batched_admission_step(model: Any, temperature: float, top_k: int,
+                           top_p: float):
+    """Compose a slot model's batched prefill (prefill_into_slots) with the
+    on-device sampler into ONE jit-able admission step:
+
+        (params, state, buf[B], tokens[N, bucket], slots[N], true_lens[N],
+         keys[N]) -> (first_tokens[N] int32, buf[B], state)
+
+    The engine compiles one executable per (N, bucket) pair (N from
+    ServingConfig.prefill_batch_sizes) in _warm_executables. Everything an
+    admission needs — N prompts' trunk forward, the per-slot KV scatter,
+    the N first tokens, AND their scatter into the engine's per-slot
+    first-token buffer ``buf`` — happens inside this single dispatch, so
+    the host never blocks on the device to admit and the next decode
+    dispatch picks the tokens up from ``buf`` with one static-shape merge
+    (no per-batch-size host-op compiles in the serving loop). Greedy
+    ignores ``keys``; the signature keeps them so the executable shape is
+    sampling-agnostic."""
+    from vtpu.models.transformer import sample_tokens
+
+    def step(params, state, buf, tokens, slots, true_lens, keys):
+        last, state = model.prefill_into_slots(
+            params, state, tokens, slots, true_lens)
+        tok, _, _ = sample_tokens(
+            last, keys, temperature=temperature, top_k=top_k, top_p=top_p)
+        return tok, buf.at[slots].set(tok), state
+
+    return step
+
+
 class TransformerSlotModel:
     """Dense transformer with a slot-pooled KV cache (vtpu/models/transformer).
 
@@ -120,6 +150,17 @@ class TransformerSlotModel:
 
         return prefill_into_slot(params, self.cfg, state, padded, slot, true_len)
 
+    def prefill_into_slots(self, params, state, padded, slots, true_lens):
+        from vtpu.models.transformer import prefill
+        from vtpu.serving.engine import prefill_into_slots
+
+        # logits_at: gather each row's final position before the vocab
+        # projection — the [N, bucket, vocab] intermediate never exists
+        return prefill_into_slots(
+            params, self.cfg, state, padded, slots, true_lens,
+            prefill_fn=lambda p, c, t: prefill(p, c, t, logits_at=true_lens - 1),
+        )
+
     def decode_step(self, params, state, tokens, active, kv_bucket,
                     unroll=False):
         from vtpu.serving.engine import batched_decode_step
@@ -175,6 +216,18 @@ class MoeSlotModel:
         return prefill_into_slot(
             params, self.cfg, state, padded, slot, true_len,
             prefill_fn=lambda p, c, t: moe_prefill(p, c, t, true_len=true_len),
+        )
+
+    def prefill_into_slots(self, params, state, padded, slots, true_lens):
+        from vtpu.models.moe import moe_prefill
+        from vtpu.serving.engine import prefill_into_slots
+
+        # moe_prefill natively takes [B] true_len (per-row routing masks);
+        # the full [N, bucket, vocab] logits come back and the engine
+        # gathers the final positions (rank-3 path)
+        return prefill_into_slots(
+            params, self.cfg, state, padded, slots, true_lens,
+            prefill_fn=lambda p, c, t: moe_prefill(p, c, t, true_len=true_lens),
         )
 
     def decode_step(self, params, state, tokens, active, kv_bucket,
@@ -238,6 +291,27 @@ class SsmSlotModel:
             "h": state["h"].at[:, slot].set(row["h"][:, 0]),
         }
         return logits[0, true_len - 1], new_state
+
+    def prefill_into_slots(self, params, state, padded, slots, true_lens):
+        from vtpu.models.ssm import ssm_prefill
+
+        # ssm_prefill gathers its recurrent state at ONE scalar position
+        # (dynamic_slice start), so per-row true lengths go through vmap —
+        # one fused batched executable, same layer math as the single-slot
+        # path (the state-extraction slice becomes a batched gather)
+        def one(tokens_row, n):
+            logits, row = ssm_prefill(params, self.cfg, tokens_row[None], n)
+            return logits[0, n - 1], {"conv": row["conv"][:, 0],
+                                      "h": row["h"][:, 0]}
+
+        last, rows = jax.vmap(one)(padded, true_lens)
+        # vmap stacked the row axis first: [N, L, ...] -> scatter at axis 1
+        new_state = {
+            "conv": state["conv"].at[:, slots].set(
+                jnp.moveaxis(rows["conv"], 0, 1)),
+            "h": state["h"].at[:, slots].set(jnp.moveaxis(rows["h"], 0, 1)),
+        }
+        return last, new_state
 
     def decode_step(self, params, state, tokens, active, kv_bucket,
                     unroll=False):

@@ -19,6 +19,7 @@ tenants each run one of these engines against their fractional chip share.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import logging
 import queue
@@ -28,6 +29,7 @@ from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from vtpu.models.transformer import (
     ModelConfig,
@@ -114,6 +116,34 @@ class ServingConfig:
     # to build its draft, so speculation forces the synchronous loop).
     # False forces the synchronous loop (still one device_get per tick).
     pipeline_decode: Optional[bool] = None
+    # --- batched async admission (the admission data plane) --------------
+    # Same-bucket waiting prompts are coalesced into one [N, bucket] prefill
+    # dispatch (N the largest warmed size that fits; sizes are capped at the
+    # slot count and 1 is always included) that scatters KV into N slots at
+    # once AND samples the N first tokens on device — a K-prompt burst
+    # drains in ceil(K/Nmax) dispatches instead of K, with zero blocking
+    # per-admission host syncs: the first tokens ride the tick loop's
+    # existing batched fetch (or one batched admission fetch on an idle
+    # engine). Each (N, bucket) executable is compiled in _warm_executables.
+    prefill_batch_sizes: tuple[int, ...] = (1, 2, 4, 8)
+    # None = auto: batched/async admission whenever device sampling is
+    # active and speculation is off (the legacy path samples each first
+    # token with a blocking per-admission sync — a custom sampler needs the
+    # fetched logits row, and a spec tick needs the first token on the host
+    # to seed its draft history). False forces the legacy serial path; an
+    # explicit True that cannot be honored raises, like pipeline_decode.
+    async_admission: Optional[bool] = None
+    # Sarathi-style per-tick admission budget, in prompt tokens: bucketed
+    # batches (N*bucket) and chunked-prefill chunks (C each) draw from one
+    # budget per tick, bounding how much prefill work can be injected
+    # between two decode ticks — a prompt burst then degrades live streams'
+    # inter-token latency by a bounded, configurable amount instead of
+    # stalling them for the whole burst. 0 = uncapped. BYPASSED while no
+    # slot is decoding: an idle engine admits at full speed for the lowest
+    # possible TTFT. Must cover the smallest prefill bucket (and the
+    # prefill chunk, when chunking is on) or admission could starve until
+    # the engine drains idle; validated at engine construction.
+    prefill_budget: int = 0
 
 
 def choose_kv_int8(slots: int, max_window: int) -> bool:
@@ -134,8 +164,13 @@ def choose_kv_int8(slots: int, max_window: int) -> bool:
     return slots >= 16 or max_window <= 1024
 
 
-@dataclasses.dataclass
+@dataclasses.dataclass(eq=False)
 class Request:
+    # eq=False: requests compare by IDENTITY. The engine's lifecycle checks
+    # are all `is`-based, and the generated __eq__ would compare the jnp
+    # token arrays — which RAISES (ambiguous truth value / broadcast error)
+    # the moment a list operation like `waiting.remove(req)` scans past a
+    # different request, killing the serving loop.
     tokens: Any  # [S] int32 prompt (the SUFFIX when prefix is set)
     max_new_tokens: int = 0  # 0: serving config default
     prefix: Optional[int] = None  # id from ServingEngine.register_prefix
@@ -425,6 +460,45 @@ def prefill_into_slot(
     return last, new_cache
 
 
+def prefill_into_slots(
+    params: Params,
+    cfg: ModelConfig,
+    cache: dict[str, jax.Array],
+    tokens: jax.Array,
+    slots: jax.Array,
+    true_lens: jax.Array,
+    prefill_fn=None,
+) -> tuple[jax.Array, dict[str, jax.Array]]:
+    """Batched admission: prefill N right-padded [N, bucket] prompts in ONE
+    dispatch and scatter each row's KV into its own slot — a K-prompt
+    same-bucket burst drains in ceil(K/Nmax) dispatches instead of K, and
+    the batch shares one trunk forward (lockstep hardware loves uniformity;
+    the rows are independent sequences exactly like the decode pool's).
+
+    slots/true_lens: [N] int32; slot indices must be distinct (duplicate
+    rows would race the scatter — the engine assigns each waiting request
+    its own free slot). ``prefill_fn(params, cfg, tokens)`` may return
+    either [N, S, vocab] logits or, when it supports gathering at the final
+    position (transformer.prefill's logits_at), [N, vocab] directly —
+    detected by rank, so families without the fast path stay correct.
+    Returns (last-position logits [N, vocab], updated pool cache).
+    """
+    logits, seq_cache = (prefill_fn or prefill)(params, cfg, tokens)
+    s = tokens.shape[1]
+    new_cache = dict(cache)
+    for key in ("k", "v", "k_scale", "v_scale"):
+        if key in cache:
+            # one advanced-index scatter over the slot axis: [L, N, s, ...]
+            new_cache[key] = cache[key].at[:, slots, :s].set(
+                seq_cache[key][:, :, :s])
+    new_cache["len"] = cache["len"].at[slots].set(true_lens)
+    if logits.ndim == 2:
+        last = logits  # prefill_fn already gathered the final positions
+    else:
+        last = logits[jnp.arange(tokens.shape[0]), true_lens - 1]
+    return last, new_cache
+
+
 class ServingEngine:
     """Continuous-batching loop: admit -> prefill -> joint decode -> stream.
 
@@ -550,6 +624,55 @@ class ServingEngine:
             donate_argnums=(1,),
         ) if self._spec_tokens else None
         self._prefill = jax.jit(model.prefill_into_slot, donate_argnums=(1,))
+        # batched async admission: device sampling supplies the fused first-
+        # token sampler, and speculation needs the first token ON THE HOST
+        # (draft history) — same gating shape as pipelining
+        async_adm = serving.async_admission
+        can_async = (
+            self._device_sampling and not self._spec_tokens
+            and hasattr(model, "prefill_into_slots"))
+        if async_adm and not can_async:
+            raise ValueError(
+                "async_admission=True requires device sampling (no custom "
+                "sample= callable), no active speculation, and a model with "
+                "prefill_into_slots")
+        self._async_admission = can_async if async_adm is None else bool(async_adm)
+        # warmed admission batch sizes: capped at the slot pool (an [N]
+        # batch needs N free slots), 1 always present so a lone waiter
+        # never waits for company
+        self._admit_sizes = tuple(sorted(
+            {n for n in serving.prefill_batch_sizes if 1 <= n <= b} | {1}))
+        if self._async_admission:
+            from vtpu.serving.adapters import batched_admission_step
+
+            self._admit_step = jax.jit(
+                batched_admission_step(
+                    model, serving.temperature, serving.top_k, serving.top_p),
+                donate_argnums=(1, 2),  # state + first-token buffer
+            )
+            # device-resident first token for the chunked/prefix admission
+            # tails (a single [vocab] logits row, not a batch)
+            self._argmax1 = jax.jit(
+                lambda l: jnp.argmax(l).astype(jnp.int32))
+            # [B] device buffer of pending admission first tokens plus a
+            # host mask of which slots hold one: the decode dispatch merges
+            # them in with ONE static-shape jitted where — never a
+            # per-batch-size scatter whose first-use XLA compile would
+            # stall the loop mid-serving (measured: 100-450 ms per eager
+            # host-op shape on CPU — the exact stall class this admission
+            # path exists to remove)
+            self._admit_buf = jnp.zeros((b,), jnp.int32)
+            self._set_buf1 = jax.jit(
+                lambda buf, i, v: buf.at[i].set(v), donate_argnums=(0,))
+        else:
+            self._admit_step = None
+            self._argmax1 = None
+            self._admit_buf = None
+        self._admit_mask = [False] * b
+        # static-shape [B] token merge, shared by the admission override and
+        # the pipelined loop's fed-merge (warmed — see above on compiles)
+        self._merge_tokens = jax.jit(
+            lambda mask, a, base: jnp.where(mask, a, base))
         chunk = serving.prefill_chunk
         if chunk and not hasattr(model, "prefill_chunk_into_slot"):
             chunk = None  # model family without a chunkable trunk (SSM)
@@ -594,7 +717,27 @@ class ServingEngine:
                 f"no prefill bucket fits max_context={ctx}: "
                 f"{serving.prefill_buckets}"
             )
+        budget = serving.prefill_budget
+        if budget:
+            # every admissible unit of work must fit one tick's budget: a
+            # single prompt of the LARGEST bucket (admission is per whole
+            # bucket — a prompt it can never afford would head-of-line
+            # block the queue until the engine drained fully idle) and a
+            # prefill chunk
+            floor = max(self._prefill_buckets)
+            if self._chunk:
+                floor = max(floor, self._chunk)
+            if budget < floor:
+                raise ValueError(
+                    f"prefill_budget {budget} is below the largest "
+                    f"admission unit {floor} (largest bucket"
+                    + (f" / prefill chunk {self._chunk}" if self._chunk else "")
+                    + ")")
         self._pending: "queue.Queue[Request]" = queue.Queue()
+        # requests pulled off the queue but not yet admitted (budget-
+        # deferred or waiting for a free slot); FIFO except that same-bucket
+        # prompts coalesce into one batched prefill dispatch
+        self._waiting: list[Request] = []
         self._slot_req: list[Optional[Request]] = [None] * b
         self._slot_budget = [0] * b
         self._tokens = [0] * b  # next token per slot (host-side)
@@ -605,6 +748,13 @@ class ServingEngine:
         # slots mid-chunked-admission: slot -> {req, padded, n, off, base};
         # the loop advances one chunk per iteration between decode ticks
         self._admitting: dict[int, dict] = {}
+        # rotating start index for chunk advancement under a prefill budget,
+        # so the same admitting slot never systematically loses the budget
+        self._adm_rr = 0
+        # async admission fetch manifest: each entry holds a device token
+        # array and the (slot, req, row-index) rows the next batched fetch
+        # delivers (the dispatch-side copies live in _admit_buf/_admit_mask)
+        self._pending_firsts: list[dict] = []
         # adaptive-speculation state: the probe EMA starts a LITTLE above
         # breakeven — a fresh engine (or a re-probe) gets a handful of
         # ticks to prove itself, then shuts back off; resetting to the
@@ -621,12 +771,37 @@ class ServingEngine:
                        # per-tick transfer accounting: every loop
                        # device->host read goes through _fetch, which counts
                        # calls and payload bytes — the proof behind the
-                       # "one device_get per tick" contract
+                       # "one device_get per tick" contract. tick_fetches
+                       # covers tick deliveries (admission first tokens
+                       # piggyback on them for free); admission_fetches are
+                       # the standalone batched first-token fetches an IDLE
+                       # engine performs; admission_syncs counts the legacy
+                       # path's blocking per-admission host syncs — ZERO on
+                       # the batched-async path, the tentpole's contract
                        "device_gets": 0, "bytes_fetched": 0,
+                       "tick_fetches": 0, "admission_fetches": 0,
+                       "admission_syncs": 0,
+                       # prefill_batch_hist[n]: bucketed prefill dispatches
+                       # of batch size n (index 0 unused)
+                       "prefill_batch_hist": [0] * (max(
+                           self._admit_sizes) + 1),
                        "pipelined_ticks": 0}
         # EMA of host bookkeeping ms per delivered tick (the Python work the
         # pipelined loop hides under the next dispatch)
         self._host_ms_ema: Optional[float] = None
+        # EMA of host ms per _tick_head pass (admission work sitting inside
+        # the tick loop — the stall the batched-async path shrinks)
+        self._admission_ms_ema: Optional[float] = None
+        # per-slot inter-token latency: timestamp of the last delivery per
+        # slot + a bounded reservoir of gaps feeding the p50/p99 telemetry
+        # (a slot's FIRST token records no gap — that interval is TTFT)
+        self._itl_last: list[Optional[float]] = [None] * b
+        self._itl_gaps: "collections.deque[float]" = collections.deque(
+            maxlen=2048)
+        # appends come from the loop thread, stats() snapshots from client
+        # threads — iterating a deque mid-append raises RuntimeError, so
+        # both sides take this (uncontended, per-delivery-round) lock
+        self._itl_lock = threading.Lock()
         # registered prompt prefixes: id -> {tokens, buffers, len, pad,
         # last_logits}; install is a device copy, suffixes chunk from the
         # prefix offset
@@ -803,6 +978,9 @@ class ServingEngine:
         for adm in self._admitting.values():
             adm["req"].out.put(None)
         self._admitting.clear()
+        for req in self._waiting:
+            req.out.put(None)
+        self._waiting.clear()
         while True:
             try:
                 req = self._pending.get_nowait()
@@ -830,6 +1008,11 @@ class ServingEngine:
         )
 
     def _admit(self, slot: int, req: Request) -> None:
+        """Admit ONE request into *slot*. Prefix-cached and chunked prompts
+        route the same way in both admission modes (install/park); a
+        bucketed prompt here is the LEGACY serial path — one [1, bucket]
+        dispatch plus a blocking first-token sync. Batched-async bucketed
+        admission goes through _admit_batch instead."""
         prompt = req.tokens
         n = int(prompt.shape[0])
         if req.prefix is not None:
@@ -846,8 +1029,13 @@ class ServingEngine:
             if n == 0:
                 # no suffix: the first token comes straight from the
                 # prefix's stored final logits
-                self._finish_admit(
-                    slot, req, self._sample_first(entry["last_logits"]), base)
+                if self._async_admission:
+                    self._begin_slot_async(
+                        slot, req, entry["last_logits"], base)
+                else:
+                    self._finish_admit(
+                        slot, req, self._sample_first(entry["last_logits"]),
+                        base)
                 return
             self._admitting[slot] = {
                 "req": req, "padded": pad_to_chunks(prompt, n, self._chunk),
@@ -869,12 +1057,147 @@ class ServingEngine:
         logits, self.state = self._prefill(
             self.params, self.state, padded, jnp.int32(slot), jnp.int32(n)
         )
+        self._stats["prefill_batch_hist"][1] += 1
         self._finish_admit(slot, req, self._sample_first(logits), n)
 
-    def _advance_admissions(self) -> None:
-        """One prefill chunk for every mid-admission slot (then back to the
-        decode tick). The final chunk completes admission."""
-        for slot in sorted(self._admitting):
+    def _admit_batch(self, slots: list[int], reqs: list[Request],
+                     bucket: int) -> None:
+        """Batched async admission: one [N, bucket] prefill dispatch that
+        scatters N prompts' KV into N slots and samples their first tokens
+        on device. NOTHING here blocks on the device: the sampled [N] token
+        array stays device-resident — fed into the next decode dispatch as
+        a per-slot override, and delivered to the clients through the tick
+        loop's batched fetch (_deliver's firsts manifest)."""
+        n = len(reqs)
+        lens = [int(r.tokens.shape[0]) for r in reqs]
+        # the padded batch is built in NUMPY: a jnp .at[].set here would
+        # XLA-compile one scatter per (row, length) shape at first use —
+        # measured 100-450 ms stalls inside the serving loop. Host memory
+        # writes cost nothing and the jitted step transfers the array once.
+        padded = np.zeros((n, bucket), np.int32)
+        for i, req in enumerate(reqs):
+            padded[i, :lens[i]] = np.asarray(req.tokens)
+        # one key split per admission BATCH (host-side; admissions are rare
+        # next to ticks; the split/slice shapes are warmed per batch size).
+        # Greedy never consumes the keys but the executable still takes
+        # them, so the signature is sampling-config-agnostic.
+        keys = jax.random.split(self._admit_key, n + 1)
+        self._admit_key, batch_keys = keys[0], keys[1:]
+        tok, self._admit_buf, self.state = self._admit_step(
+            self.params, self.state, self._admit_buf, padded,
+            np.asarray(slots, np.int32), np.asarray(lens, np.int32),
+            batch_keys,
+        )
+        rows = []
+        for i, (slot, req) in enumerate(zip(slots, reqs)):
+            self._begin_slot(slot, req, lens[i])
+            self._admit_mask[slot] = True
+            rows.append((slot, req, i))
+        self._pending_firsts.append({"tokens": tok, "rows": rows})
+        self._stats["prefill_batch_hist"][n] += 1
+
+    def _begin_slot(self, slot: int, req: Request, n: int) -> None:
+        """Async-admission slot bookkeeping: everything _finish_admit does
+        EXCEPT consuming the first token's value, which is still device-
+        resident (delivered later by _emit_first through a batched fetch).
+        The first token's budget slice is reserved here so the dispatch
+        predicates see the same numbers as the legacy path."""
+        self._slot_req[slot] = req
+        ctx = self.model.max_context
+        budget = min(req.max_new_tokens, ctx - n) if ctx else req.max_new_tokens
+        self._slot_budget[slot] = budget - 1
+        self._slot_len[slot] = n
+        self._itl_last[slot] = None
+        self._stats["admissions"] += 1
+
+    def _begin_slot_async(self, slot: int, req: Request, logits_row,
+                          n: int) -> None:
+        """Async admission for the single-row tails (prefix-only and final-
+        chunk): sample the first token on device from one [vocab] logits
+        row and queue it for the next batched fetch."""
+        if self.serving.temperature <= 0.0:
+            tok = self._argmax1(logits_row)
+        else:
+            self._admit_key, sub = jax.random.split(self._admit_key)
+            tok = self._sample1(logits_row, sub)
+        self._begin_slot(slot, req, n)
+        self._admit_buf = self._set_buf1(
+            self._admit_buf, jnp.int32(slot), tok)
+        self._admit_mask[slot] = True
+        self._pending_firsts.append({"tokens": tok, "rows": [(slot, req, None)]})
+
+    def _admit_waiting(self, budget: float) -> tuple[bool, float]:
+        """Admission scheduler: fill free slots from the waiting list under
+        the per-tick prompt-token budget. FIFO at the head; same-bucket
+        prompts COALESCE from anywhere in the list into one [N, bucket]
+        batched dispatch (async mode), so a burst drains in ceil(K/Nmax)
+        dispatches. Head-of-line blocking on budget is deliberate: when the
+        head's bucket doesn't fit the remaining budget, nothing younger
+        jumps it — the deferral lasts one tick, not a scheduling epoch.
+        Returns (any admission happened, remaining budget)."""
+        admitted = False
+        free = [i for i in range(self.serving.slots)
+                if self._slot_req[i] is None and i not in self._admitting]
+        while self._waiting and free:
+            head = self._waiting[0]
+            if head.cancelled:
+                self._waiting.pop(0)
+                head.out.put(None)
+                continue
+            n_head = int(head.tokens.shape[0])
+            if head.prefix is not None or self._bucket(n_head) is None:
+                # chunked routes park and pay their prompt tokens from the
+                # budget as their chunks advance (see _advance_admissions)
+                self._waiting.pop(0)
+                self._admit(free.pop(0), head)
+                admitted = True
+                continue
+            bucket = self._bucket(n_head)
+            if not self._async_admission:
+                if bucket > budget:
+                    break
+                self._waiting.pop(0)
+                self._admit(free.pop(0), head)
+                budget -= bucket
+                admitted = True
+                continue
+            # gather the head's same-bucket companions (FIFO within the
+            # bucket) into the largest warmed batch that fits the free
+            # slots and the remaining budget
+            cap = min(len(free), max(self._admit_sizes))
+            group = [head]
+            for req in self._waiting[1:]:
+                if len(group) >= cap:
+                    break
+                if (not req.cancelled and req.prefix is None
+                        and self._bucket(int(req.tokens.shape[0])) == bucket):
+                    group.append(req)
+            fit = [s for s in self._admit_sizes
+                   if s <= len(group) and s * bucket <= budget]
+            if not fit:
+                break  # budget exhausted for the head-of-line bucket
+            n = max(fit)
+            batch = group[:n]
+            for req in batch:
+                self._waiting.remove(req)
+            slots = [free.pop(0) for _ in batch]
+            self._admit_batch(slots, batch, bucket)
+            budget -= n * bucket
+            admitted = True
+        return admitted, budget
+
+    def _advance_admissions(self, budget: float = float("inf")) -> float:
+        """One prefill chunk per mid-admission slot (then back to the decode
+        tick), sharing the per-tick prompt-token budget with bucketed
+        admission. The rotation makes budget pressure fair: a different
+        admitting slot leads each tick, so no admission systematically
+        starves. The final chunk completes admission."""
+        order = sorted(self._admitting)
+        if len(order) > 1:
+            lead = self._adm_rr % len(order)
+            order = order[lead:] + order[:lead]
+        self._adm_rr += 1
+        for slot in order:
             adm = self._admitting[slot]
             req, n, off, base = adm["req"], adm["n"], adm["off"], adm["base"]
             if req.cancelled:
@@ -882,6 +1205,8 @@ class ServingEngine:
                 req.out.put(None)
                 continue
             c = self._chunk
+            if c > budget:
+                break  # remaining admitting slots advance next tick
             # off indexes the (suffix-)padded array; base is the installed
             # prefix length, so the device offset is base + off
             need = base + off + c
@@ -896,15 +1221,18 @@ class ServingEngine:
                 kv_bucket=kv_bucket, unroll=self._unroll,
             )
             adm["off"] = off + c
+            budget -= c
             self._stats["prefill_chunks"] += 1
             if adm["off"] >= adm["padded"].shape[1]:  # final chunk
                 del self._admitting[slot]
                 pad = adm["padded"].shape[1]
-                self._finish_admit(
-                    slot, req,
-                    self._sample_first(logits[0, (n - base - 1) - (pad - c)]),
-                    n,
-                )
+                last_row = logits[0, (n - base - 1) - (pad - c)]
+                if self._async_admission:
+                    self._begin_slot_async(slot, req, last_row, n)
+                else:
+                    self._finish_admit(
+                        slot, req, self._sample_first(last_row), n)
+        return budget
 
     def _sample_first(self, logits) -> int:
         """Sample a request's FIRST token from its prefill logits. Host
@@ -914,7 +1242,9 @@ class ServingEngine:
         of bytes, not a per-tick one — the tick loop's transfer contract
         (see _fetch) is unaffected. The callable's contract is a fetched
         numpy [vocab] row at BOTH call sites (here and the per-tick
-        fallback loop), never a device array."""
+        fallback loop), never a device array. Counted as an admission_sync:
+        the batched-async path exists to make this counter stay at zero."""
+        self._stats["admission_syncs"] += 1
         if not self._device_sampling:
             return self.sample(jax.device_get(logits))
         if self.serving.temperature <= 0.0:
@@ -922,13 +1252,18 @@ class ServingEngine:
         self._admit_key, sub = jax.random.split(self._admit_key)
         return int(self._sample1(logits, sub))
 
-    def _fetch(self, arrays):
-        """The tick loop's ONLY device->host read: one batched device_get
-        per call, counted with its payload bytes so stats() can prove the
+    def _fetch(self, arrays, kind: str = "tick"):
+        """The loop's ONLY device->host read: one batched device_get per
+        call, counted with its payload bytes so stats() can prove the
         per-tick transfer contract (device_gets_per_tick == 1.0, and
         bytes_fetched_per_tick == B*4 on the device-sampled path vs
-        B*vocab*4 on the host-sampler fallback)."""
+        B*vocab*4 on the host-sampler fallback). kind="tick" is a tick
+        delivery (admission first tokens piggyback on it for free);
+        kind="admission" is the standalone batched first-token fetch an
+        idle engine performs so TTFT never waits for a decode tick."""
         self._stats["device_gets"] += 1
+        self._stats["tick_fetches" if kind == "tick"
+                     else "admission_fetches"] += 1
         self._stats["bytes_fetched"] += sum(
             a.size * a.dtype.itemsize
             for a in jax.tree_util.tree_leaves(arrays))
@@ -940,13 +1275,67 @@ class ServingEngine:
             ms if self._host_ms_ema is None
             else 0.9 * self._host_ms_ema + 0.1 * ms)
 
-    def _deliver(self, tick: dict, extra_host_s: float = 0.0) -> None:
+    def _note_admission_ms(self, seconds: float) -> None:
+        ms = seconds * 1e3
+        self._admission_ms_ema = (
+            ms if self._admission_ms_ema is None
+            else 0.9 * self._admission_ms_ema + 0.1 * ms)
+
+    def _note_itl(self, slot: int, now: float) -> None:
+        """Record one inter-token gap for *slot* (first token after
+        admission only stamps the clock — that interval is TTFT)."""
+        last = self._itl_last[slot]
+        if last is not None:
+            with self._itl_lock:
+                self._itl_gaps.append(now - last)
+        self._itl_last[slot] = now
+
+    def _deliver_firsts(self, firsts: list[dict],
+                        fetched: Optional[list] = None) -> None:
+        """Deliver admission first tokens from their device arrays. When
+        ``fetched`` is None this is the IDLE-engine path: one standalone
+        batched fetch for the whole admission wave (kind="admission" —
+        never counted against the tick contract). Otherwise the caller
+        already fetched the arrays jointly with a tick's tokens and passes
+        the host copies. Delivery order guarantees a slot's first token
+        precedes any decode token the same pass delivers for it."""
+        if fetched is None:
+            fetched = self._fetch(tuple(f["tokens"] for f in firsts),
+                                  kind="admission")
+        for f, arr in zip(firsts, fetched):
+            for slot, req, idx in f["rows"]:
+                if req is not self._slot_req[slot]:
+                    continue  # retired between dispatch and delivery
+                if req.cancelled:
+                    self._retire(slot)
+                    continue
+                self._emit_first(slot, int(arr if idx is None else arr[idx]))
+
+    def _emit_first(self, slot: int, tok: int) -> None:
+        """Deliver an async-admitted request's FIRST token (its budget
+        slice was already reserved by _begin_slot; the cache length does
+        not move — the token's KV lands when the next decode tick consumes
+        it, exactly like the legacy path)."""
+        req = self._slot_req[slot]
+        self._tokens[slot] = tok
+        self._itl_last[slot] = time.perf_counter()
+        req.out.put(tok)
+        self._stats["generated_tokens"] += 1
+        if self._slot_budget[slot] <= 0 or tok == self.serving.eos_token:
+            self._retire(slot)
+
+    def _deliver(self, tick: dict, extra_host_s: float = 0.0,
+                 firsts: Optional[list] = None) -> None:
         """Deliver one decode tick's device-sampled tokens: ONE batched
         fetch, then pure-Python bookkeeping (stream, budget, eos, retire).
         ``extra_host_s`` is host work already spent on this loop pass
         outside this call (the pipelined loop's dispatch-side build), folded
         into the same host_ms_per_tick sample so the telemetry reports the
-        full per-tick host cost, not just the delivery half.
+        full per-tick host cost, not just the delivery half. ``firsts`` is
+        this pass's async-admission manifest: the first-token arrays ride
+        the SAME batched fetch (a few extra bytes, zero extra syncs) and
+        are delivered before the tick's tokens, so a freshly admitted
+        slot's stream always starts with its prefill-derived token.
 
         ``tick["reqs"]`` snapshots each slot's Request AT DISPATCH; a slot
         whose occupant changed since (retired on the previous delivery,
@@ -956,19 +1345,27 @@ class ServingEngine:
         overwritten by the slot's next admission. This check is what makes
         the one-tick lookahead safe: retire/admit invalidate a single
         slot's lookahead, never the tick."""
+        extra = tuple(f["tokens"] for f in firsts) if firsts else ()
         if tick["logprobs"] is not None:
-            toks, lps = self._fetch((tick["tokens"], tick["logprobs"]))
+            toks, lps, *first_arrs = self._fetch(
+                (tick["tokens"], tick["logprobs"]) + extra)
         else:
-            toks, lps = self._fetch(tick["tokens"]), None
+            toks, *first_arrs = self._fetch((tick["tokens"],) + extra)
+            lps = None
         t0 = time.perf_counter()
+        if firsts:
+            self._deliver_firsts(firsts, fetched=first_arrs)
+        now = time.perf_counter()
         for slot, req in enumerate(tick["reqs"]):
             if req is None or req is not self._slot_req[slot]:
                 continue
             self._emit(slot, int(toks[slot]),
-                       float(lps[slot]) if lps is not None else None)
+                       float(lps[slot]) if lps is not None else None,
+                       now=now)
         self._note_host_ms(extra_host_s + time.perf_counter() - t0)
 
-    def _emit(self, slot: int, tok: int, lp: Optional[float] = None) -> None:
+    def _emit(self, slot: int, tok: int, lp: Optional[float] = None,
+              now: Optional[float] = None) -> None:
         """Per-slot bookkeeping for ONE delivered decode token — the single
         implementation behind both the device-sampled delivery (_deliver)
         and the host-sampler fallback, so budget/eos/retire semantics cannot
@@ -978,6 +1375,7 @@ class ServingEngine:
         req = self._slot_req[slot]
         self._tokens[slot] = tok
         self._slot_len[slot] += 1
+        self._note_itl(slot, now if now is not None else time.perf_counter())
         # logprob BEFORE the queue put: the put unblocks the client thread,
         # which may immediately read logprobs[-1] expecting this token's
         # entry to exist
@@ -1010,6 +1408,7 @@ class ServingEngine:
                 pre + [int(x) for x in req.tokens.tolist()] + [first])
         self._stats["admissions"] += 1
         self._stats["generated_tokens"] += 1
+        self._itl_last[slot] = time.perf_counter()
         req.out.put(first)
         if self._slot_budget[slot] <= 0 or first == self.serving.eos_token:
             self._retire(slot)
@@ -1040,6 +1439,7 @@ class ServingEngine:
         participations) — directly comparable to spec_min_mean."""
         s = dict(self._stats)
         s["spec_emitted_hist"] = list(s["spec_emitted_hist"])
+        s["prefill_batch_hist"] = list(s["prefill_batch_hist"])
         s["mean_emitted_per_spec_tick"] = round(
             s["spec_emitted"] / s["spec_slot_ticks"], 3
         ) if s["spec_slot_ticks"] else None
@@ -1047,21 +1447,39 @@ class ServingEngine:
         s["spec_cooling_off"] = self._spec_cooloff > 0
         s["active_slots"] = sum(r is not None for r in self._slot_req)
         s["admitting_slots"] = len(self._admitting)
-        s["queued"] = self._pending.qsize()
+        s["queued"] = self._pending.qsize() + len(self._waiting)
         s["registered_prefixes"] = len(self._prefixes)
         # per-tick transfer + host-overhead telemetry (the decode data-plane
-        # contract: ONE batched device_get per tick; B*4 bytes when sampling
-        # is on-device, B*vocab*4 on the host-sampler fallback)
+        # contract: ONE batched device_get per tick delivery — admission
+        # first tokens piggyback on it; an idle engine's admission wave
+        # performs its own single batched fetch, counted separately so the
+        # tick ratio stays an exact contract; B*4 bytes when sampling is
+        # on-device, B*vocab*4 on the host-sampler fallback)
         ticks = s["decode_ticks"] + s["spec_ticks"]
         s["device_gets_per_tick"] = (
-            round(s["device_gets"] / ticks, 4) if ticks else None)
+            round(s["tick_fetches"] / ticks, 4) if ticks else None)
         s["bytes_fetched_per_tick"] = (
             round(s["bytes_fetched"] / ticks, 1) if ticks else None)
         s["host_ms_per_tick"] = (
             round(self._host_ms_ema, 4)
             if self._host_ms_ema is not None else None)
+        # admission data plane: host ms spent in _tick_head (EMA — the
+        # stall batched-async admission takes off the decode loop) and the
+        # engine's own inter-token-latency percentiles as its streams
+        # experienced them (bounded reservoir of per-slot delivery gaps)
+        s["admission_stall_ms"] = (
+            round(self._admission_ms_ema, 4)
+            if self._admission_ms_ema is not None else None)
+        with self._itl_lock:
+            gaps = sorted(self._itl_gaps)
+        s["itl_p50_ms"] = (
+            round(gaps[len(gaps) // 2] * 1e3, 3) if gaps else None)
+        s["itl_p99_ms"] = (
+            round(gaps[min(len(gaps) - 1, int(len(gaps) * 0.99))] * 1e3, 3)
+            if gaps else None)
         s["device_sampling"] = self._device_sampling
         s["pipelined"] = self._pipeline
+        s["batched_admission"] = self._async_admission
         return s
 
     def _retire(self, slot: int) -> None:
@@ -1072,6 +1490,8 @@ class ServingEngine:
         self._slot_budget[slot] = 0
         self._slot_len[slot] = 0
         self._history[slot] = []
+        self._itl_last[slot] = None
+        self._admit_mask[slot] = False
 
     def _warm_executables(self) -> None:
         """Compile every decode and prefill bucket before serving: a
@@ -1101,15 +1521,55 @@ class ServingEngine:
                     inactive, jnp.zeros((b,), jnp.int32), bucket,
                     unroll=self._unroll,
                 )
-        for bucket in self._prefill_buckets:
-            logits, self.state = self._prefill(
-                self.params, self.state, jnp.zeros((1, bucket), jnp.int32),
-                jnp.int32(0), jnp.int32(1),
-            )
-        if self._device_sampling and self.serving.temperature > 0.0:
+        if self._async_admission:
+            # one executable per (batch size, bucket): the batched admission
+            # step (prefill N rows + KV scatter + on-device first-token
+            # sample + first-token buffer scatter)
+            for bucket in self._prefill_buckets:
+                for n in self._admit_sizes:
+                    _, self._admit_buf, self.state = self._admit_step(
+                        self.params, self.state, self._admit_buf,
+                        jnp.zeros((n, bucket), jnp.int32),
+                        jnp.arange(n, dtype=jnp.int32),
+                        jnp.ones((n,), jnp.int32),
+                        jax.random.split(jax.random.key(0), n),
+                    )
+            # the admission path's HOST-side op shapes: key split + slices
+            # per batch size, the static-shape token merge, the single-slot
+            # buffer write. Each is trivial work but its first-use XLA
+            # compile costs 100-450 ms — unacceptable inside the loop.
+            for n in self._admit_sizes:
+                keys = jax.random.split(jax.random.key(0), n + 1)
+                _, _ = keys[0], keys[1:]
+            self._admit_buf = self._set_buf1(
+                self._admit_buf, jnp.int32(0), jnp.int32(0))
+        else:
+            for bucket in self._prefill_buckets:
+                logits, self.state = self._prefill(
+                    self.params, self.state, jnp.zeros((1, bucket), jnp.int32),
+                    jnp.int32(0), jnp.int32(1),
+                )
+        if self._device_sampling:
+            # the [B] token merge serves both the pipelined fed-merge and
+            # the admission override — warm its one executable
+            self._merge_tokens(
+                jnp.zeros((b,), bool), jnp.zeros((b,), jnp.int32), tokens)
+        vocab = getattr(self.cfg, "vocab", None)
+        row = (jnp.zeros((vocab,), jnp.float32) if vocab
+               else None)
+        if not self._async_admission and self._device_sampling \
+                and self.serving.temperature > 0.0:
             # the admission-time sampler draws the first token of every
             # request; its first-use compile must not happen in-loop either
             self._sample1(logits, jax.random.key(0))
+        if self._async_admission and row is not None:
+            # single-row admission tails (prefix-only, final chunk) sample
+            # through these; warm them so a first prefix-cached admission
+            # can't compile inside the loop
+            if self.serving.temperature > 0.0:
+                self._sample1(row, jax.random.key(0))
+            else:
+                self._argmax1(row)
         if self._prefill_chunk is not None:
             # one executable per (chunk, read-bucket) pair. EVERY bucket
             # >= chunk is reachable: prefix-cached admissions chunk from
@@ -1137,49 +1597,49 @@ class ServingEngine:
             self._drain_all()
 
     def _tick_head(self) -> bool:
-        """Between-tick host work shared by both loop flavors: fill every
-        idle slot that has a waiter (cancelled waiters are skipped IN PLACE
-        so they never cost an idle slot a decode tick), advance one prefill
-        chunk per mid-admission slot, and retire slots whose client walked
-        away. Returns whether any admission happened."""
-        b = self.serving.slots
-        admitted = False
-        drained = False
-        for slot in range(b):
-            if drained:
+        """Between-tick host work shared by both loop flavors: drain the
+        pending queue into the waiting list, advance in-flight chunked
+        admissions, fill free slots from the waiting list (same-bucket
+        prompts coalescing into batched prefill dispatches), and retire
+        slots whose client walked away. All prefill work — chunk advances
+        and bucketed batches — draws from ONE per-tick prompt-token budget
+        (ServingConfig.prefill_budget), bypassed while nothing is decoding
+        so an idle engine admits at full speed. In-flight chunks spend
+        first: finishing an admission frees its head-of-line latency and
+        its budget claim. Returns whether any admission happened."""
+        t0 = time.perf_counter()
+        while True:
+            try:
+                self._waiting.append(self._pending.get_nowait())
+            except queue.Empty:
                 break
-            while self._slot_req[slot] is None and slot not in self._admitting:
-                try:
-                    req = self._pending.get_nowait()
-                except queue.Empty:
-                    drained = True
-                    break
-                if req.cancelled:
-                    req.out.put(None)
-                    continue
-                self._admit(slot, req)
-                admitted = True
-        self._advance_admissions()
-        for slot in range(b):
+        decoding = any(r is not None for r in self._slot_req)
+        budget = (
+            float(self.serving.prefill_budget)
+            if self.serving.prefill_budget and decoding else float("inf"))
+        budget = self._advance_admissions(budget)
+        admitted, _ = self._admit_waiting(budget)
+        for slot in range(self.serving.slots):
             req = self._slot_req[slot]
             if req is not None and req.cancelled:
                 self._retire(slot)
+        self._note_admission_ms(time.perf_counter() - t0)
         return admitted
 
     def _idle_wait(self, admitted: bool) -> None:
         """Nothing to decode and nothing in flight: block briefly on the
         queue so an idle engine doesn't spin — unless admissions are mid-
-        chunk (keep advancing them) or one just landed this pass."""
+        chunk (keep advancing them) or one just landed this pass. The
+        request joins the waiting list and the next _tick_head admits it
+        into the FIRST FREE slot — this helper never picks a slot itself
+        (an earlier version hardcoded slot 0, correct only because its
+        guard implied every slot was free; see the regression test)."""
         if self._admitting or admitted:
             return
         try:
-            req = self._pending.get(timeout=0.05)
+            self._waiting.append(self._pending.get(timeout=0.05))
         except queue.Empty:
             return
-        if req.cancelled:
-            req.out.put(None)
-            return
-        self._admit(0, req)
 
     def _loop_pipelined(self) -> None:
         """One-tick-deep decode pipeline (device sampling on, speculation
@@ -1215,6 +1675,11 @@ class ServingEngine:
         active_key: Optional[tuple] = None
         while not self._stop.is_set():
             admitted = self._tick_head()
+            # this pass's async-admission manifest: their device token
+            # arrays ride the delivery fetch below (or a standalone batched
+            # admission fetch when no tick is in flight to piggyback on)
+            firsts = self._pending_firsts
+            self._pending_firsts = []
             t_disp = time.perf_counter()
             # fed[i]: slot i's next token is the in-flight tick's device
             # sample (same request then and now; identity survives neither
@@ -1231,7 +1696,12 @@ class ServingEngine:
                 and self._slot_budget[i] - (1 if fed[i] else 0) > 0
             ]
             if not dispatch and inflight is None:
-                self._idle_wait(admitted)
+                if firsts:
+                    # admissions whose every request spends its whole budget
+                    # on the first token: deliver (and retire) them now
+                    self._deliver_firsts(firsts)
+                else:
+                    self._idle_wait(admitted)
                 continue
             new_inflight = None
             disp_s = 0.0
@@ -1246,9 +1716,20 @@ class ServingEngine:
                 elif inflight is None:
                     tokens = jnp.asarray(self._tokens, jnp.int32)
                 else:
-                    tokens = jnp.where(
+                    tokens = self._merge_tokens(
                         jnp.asarray(fed, bool), inflight["tokens"],
                         jnp.asarray(self._tokens, jnp.int32))
+                over = [i for i in dispatch if self._admit_mask[i]]
+                if over:
+                    # freshly admitted slots: their first tokens are still
+                    # device-resident in _admit_buf (scattered there inside
+                    # the prefill dispatch) — one static-shape jitted merge,
+                    # no host visit and no per-pattern compile
+                    tokens = self._merge_tokens(
+                        jnp.asarray([i in over for i in range(b)], bool),
+                        self._admit_buf, tokens)
+                    for i in over:
+                        self._admit_mask[i] = False
                 if active_key != tuple(dispatch):
                     active = jnp.asarray([i in live for i in range(b)], bool)
                     active_key = tuple(dispatch)
@@ -1278,7 +1759,11 @@ class ServingEngine:
                 }
                 disp_s = time.perf_counter() - t_disp
             if inflight is not None:
-                self._deliver(inflight, extra_host_s=disp_s)
+                self._deliver(inflight, extra_host_s=disp_s, firsts=firsts)
+            elif firsts:
+                # no tick in flight to piggyback on (the engine was idle):
+                # one standalone batched fetch for the whole admission wave
+                self._deliver_firsts(firsts)
             inflight = new_inflight
         if inflight is not None:
             # stop() landed between dispatch and delivery: the tick's
@@ -1296,9 +1781,17 @@ class ServingEngine:
         b = self.serving.slots
         while not self._stop.is_set():
             admitted = self._tick_head()
+            # async-admission first tokens (device sampling with pipelining
+            # off): delivered through this tick's batched fetch, same
+            # contract as the pipelined loop
+            firsts = self._pending_firsts
+            self._pending_firsts = []
             active_slots = [i for i in range(b) if self._slot_req[i] is not None]
             if not active_slots:
-                self._idle_wait(admitted)
+                if firsts:
+                    self._deliver_firsts(firsts)
+                else:
+                    self._idle_wait(admitted)
                 continue
             # 2. one decode tick for the whole pool; the read window is the
             # smallest bucket past the longest LIVE sequence (this tick
@@ -1309,6 +1802,15 @@ class ServingEngine:
             # pipelined loop's
             t_disp = time.perf_counter()
             tokens = jnp.asarray(self._tokens, jnp.int32)
+            over = [i for i in active_slots if self._admit_mask[i]]
+            if over:
+                # freshly admitted slots' first tokens, still device-resident
+                # in _admit_buf: one static-shape jitted merge
+                tokens = self._merge_tokens(
+                    jnp.asarray([i in over for i in range(b)], bool),
+                    self._admit_buf, tokens)
+                for i in over:
+                    self._admit_mask[i] = False
             active = jnp.asarray(
                 [self._slot_req[i] is not None for i in range(b)], bool
             )
@@ -1379,6 +1881,10 @@ class ServingEngine:
                     self._history[slot].extend(emitted)
                     if emitted:
                         self._tokens[slot] = emitted[-1]
+                        # one gap per (slot, spec tick): the burst reaches
+                        # the client in one flush, so the user-visible ITL
+                        # is the inter-flush gap, not intra-burst zeros
+                        self._note_itl(slot, t0)
                     if (
                         self._slot_budget[slot] <= 0
                         or (emitted and emitted[-1] == eos)
@@ -1413,7 +1919,7 @@ class ServingEngine:
                 self._deliver({
                     "tokens": tok_d, "logprobs": lp_d,
                     "reqs": list(self._slot_req),
-                }, extra_host_s=time.perf_counter() - t_disp)
+                }, extra_host_s=time.perf_counter() - t_disp, firsts=firsts)
                 continue
             # host-sampler fallback: fetch the FULL logits once (still a
             # single batched device_get — never B per-slot syncs) and run
