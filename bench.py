@@ -41,12 +41,15 @@ VERDICT r4 weak #1/#2):
                      weather on the product stack. Rejected rounds are
                      published alongside the accepted ones.
 
-Prints exactly ONE JSON line on stdout:
+Prints exactly TWO JSON lines on stdout. First the full artifact:
   {"metric": ..., "value": <p90 of accepted per-round shared-vs-exclusive
    degradations % — a robust "every round passes" bar, not a median-lucky
    one>, "unit": "percent", "vs_baseline": <value / 5.0>,
    "degradation_p90_ci95": <bootstrap 95% CI on that p90>,
    "libvtpu_attribution": <per-execute wrapper-cost breakdown>, ...}
+then, as the FINAL stdout line, a compact headline summary (metric, value,
+CI, verdict) — drivers that truncate or last-line-parse long artifacts
+(BENCH_r05.json landed with "parsed": null) always get the headline intact.
 """
 
 from __future__ import annotations
@@ -862,6 +865,18 @@ def main() -> None:
                 else round(s.stats["settled_busy_ns"] / 1e6, 1),
                 "rtt_floor_ms": None if "rtt_floor_ns" not in s.stats
                 else round(s.stats["rtt_floor_ns"] / 1e6, 1),
+                # r6 calibration oracle: whether THIS tenant's runtime passed
+                # event attestation (verdict 1 = faithful -> walls never
+                # charged, tower disengaged), the calibrated scale/baseline,
+                # and how many walls the attestation skipped outright.
+                "calib_verdict": s.stats.get("calib_verdict"),
+                "calib_fallback": s.stats.get("calib_fallback"),
+                "calib_ratio_ppm": s.stats.get("calib_ratio_ppm"),
+                "calib_baseline_ms": None
+                if "calib_baseline_ns" not in s.stats
+                else round(s.stats["calib_baseline_ns"] / 1e6, 1),
+                "calib_recalibs": s.stats.get("calib_recalibs"),
+                "d2h_attested": s.stats.get("d2h_attested"),
             }
             for i, s in enumerate(stacks) if s.stats
         ] or None
@@ -1023,6 +1038,21 @@ def main() -> None:
         # the transport state the sharing windows actually saw
         "dispatch_rtt_probe_ms": rtt_before_ms,
         "dispatch_rtt_probe_end_ms": rtt_after_ms,
+    }))
+    # Compact headline as the FINAL stdout line (VERDICT r5 weak #3): the
+    # full artifact above runs to tens of KB and drivers that keep only a
+    # prefix or parse the last line recorded "parsed": null — the summary is
+    # a few hundred bytes and self-contained (metric, value, CI, verdict).
+    print(json.dumps({
+        "summary": True,
+        "metric": "p90_round_ttft_degradation_4way_share_stack",
+        "value": round(raw_degradation, 2),
+        "unit": "percent",
+        "ci95": [round(raw_ci[0], 2), round(raw_ci[1], 2)],
+        "verdict": "pass" if raw_ci[1] < 5.0 else "fail",
+        "vs_baseline": round(raw_degradation / 5.0, 3),
+        "rounds": len(round_degradations),
+        "stack_in_loop": wrap,
     }))
 
 

@@ -202,7 +202,7 @@ def bench_decode(cfg: ModelConfig, b: int, prompt_len: int, steps: int,
         "batch": b, "prompt_len": prompt_len, "steps": steps,
         "kv_bucket": kv_bucket or cfg.max_seq, "unroll": unroll,
         "kv_int8": bool(getattr(cfg, "kv_int8", False)),
-        "decode_attn": getattr(cfg, "decode_attn", "xla"),
+        "decode_attn": "xla",
         "timing": "two-chain-length difference (RTT-cancelled)",
         "ms_per_step": round(sec / steps * 1e3, 3),
         "tokens_per_sec": round(b * steps / sec),
@@ -265,7 +265,7 @@ def bench_spec_tick(cfg: ModelConfig, b: int, prompt_len: int, k: int,
     return {
         "batch": b, "prompt_len": prompt_len, "spec_tokens": k,
         "kv_bucket": kv_bucket or cfg.max_seq,
-        "decode_attn": getattr(cfg, "decode_attn", "xla"),
+        "decode_attn": "xla",
         "timing": "two-chain-length difference (RTT-cancelled)",
         "ms_per_verify_tick": round(spec_ms, 3),
         "ms_per_decode_tick": plain["ms_per_step"],
@@ -411,16 +411,10 @@ def main() -> None:
             r = safe(bench_decode, base, b, p, steps, kv_bucket=bkt)
             out["decode"].append(r)
             print("decode", r, flush=True)
-    if on_tpu:
-        # the decode kernel's in-trunk exhibit rows (auto == xla now; see
-        # transformer._decode_attn_pallas for the full story): kept so the
-        # routing decision stays re-checkable as the kernel evolves
-        for b in (8, 32):
-            rp = safe(bench_decode,
-                      dataclasses.replace(cfg, decode_attn="pallas"),
-                      b, 128, 64, kv_bucket=0)
-            out["decode"].append(rp)
-            print("decode", rp, flush=True)
+    # The fused decode kernel has no in-trunk route since r6 (it lost to XLA
+    # at every trunk cell — MFU_r05); its standalone numbers stay
+    # re-checkable via hack/decode_attn_bench.py over
+    # benchmarks/decode_attn_kernel.py.
     if on_tpu:
         # Root-cause exhibit for the r2 decode inversion (VERDICT weak #5):
         # under fori_loop the bounded read dynamic_index_in_dim(ks, l)

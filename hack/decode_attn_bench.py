@@ -5,7 +5,8 @@ VERDICT r4 #3: int8 KV lost at batch 8 / kv 2048 through the XLA path (the
 fused-convert formulation still bottoms out at ~33% HBM BW — decode
 attention there is dispatch-bound: M=1 batched matmuls + a materialized
 [B,H,T,S] mask/score chain). This measures whether the fused Pallas kernel
-(ops/attention.py decode_attention) moves the needle at every target cell
+(benchmarks/decode_attn_kernel.py decode_attention — the standalone
+study; no in-trunk route since r6) moves the needle at every target cell
 {batch 8, 32} x {window 1024, 2048}, bf16 AND int8, T=1 (decode tick) and
 T=4 (verify tick).
 
@@ -33,8 +34,9 @@ import numpy as np
 ROOT = pathlib.Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(ROOT))
 
+from benchmarks.decode_attn_kernel import decode_attention  # noqa: E402
 from vtpu.ops.attention import (  # noqa: E402
-    causal_attention, causal_attention_int8kv, decode_attention)
+    causal_attention, causal_attention_int8kv)
 
 H, DH = 8, 128
 CHAIN_LO, CHAIN_HI = 32, 288

@@ -23,7 +23,13 @@ extern "C" {
 #endif
 
 #define VTPU_REGION_MAGIC 0x56545055u /* "VTPU" */
-#define VTPU_REGION_VERSION 2u
+#define VTPU_REGION_VERSION 3u
+
+/* calib_verdict values (v3 calibration oracle, libvtpu/src/calib.*). */
+#define VTPU_CALIB_UNKNOWN 0
+#define VTPU_CALIB_FAITHFUL 1
+#define VTPU_CALIB_LYING 2
+#define VTPU_CALIB_TRANSPORT_POLLUTED 3
 #define VTPU_MAX_DEVICES 16
 #define VTPU_MAX_PROCS 64
 #define VTPU_UUID_LEN 64
@@ -67,6 +73,18 @@ typedef struct vtpu_shared_region {
   uint32_t _pad1;
   uint64_t gate_blocked_ns;      /* cumulative ns executes spent gated */
   uint64_t gate_forced_releases; /* releases without unblock (timeout/stale) */
+  /* v3: calibration oracle (libvtpu/src/calib.*). At attach the shim compiles
+   * and runs a known-duration probe through the real plugin, attesting whether
+   * completion events report device truth; these fields surface the verdict so
+   * the node monitor can export it per container. */
+  int32_t calib_verdict;        /* VTPU_CALIB_* (0 = not attested) */
+  uint32_t calib_fallback;      /* 1 = compensator tower engaged (events not
+                                 * live-verified faithful) */
+  uint64_t calib_ratio_ppm;     /* events->duty scale x 1e6: attested device
+                                 * duration / event-reported duration */
+  uint64_t calib_baseline_ns;   /* per-session idle-transport baseline */
+  uint64_t calib_recalibs;      /* periodic re-attestation count */
+  uint64_t calib_probe_busy_ns; /* cumulative self-charged probe device time */
   vtpu_device_slot devices[VTPU_MAX_DEVICES];
   int32_t num_procs;
   int32_t _pad0;
@@ -80,8 +98,10 @@ static_assert(sizeof(vtpu_device_slot) == 64 + 8 * 3 + 4 * 2 + 8 * 3,
               "vtpu_device_slot layout drifted");
 static_assert(sizeof(vtpu_proc_slot) == 8 + 8 * VTPU_MAX_DEVICES,
               "vtpu_proc_slot layout drifted");
-static_assert(offsetof(vtpu_shared_region, devices) == 72,
-              "vtpu_shared_region v2 header layout drifted");
+static_assert(offsetof(vtpu_shared_region, calib_verdict) == 72,
+              "vtpu_shared_region v3 calibration block drifted");
+static_assert(offsetof(vtpu_shared_region, devices) == 112,
+              "vtpu_shared_region v3 header layout drifted");
 #endif
 
 #endif /* VTPU_SHARED_REGION_H_ */

@@ -165,6 +165,11 @@ void DutyCycleLimiter::settle_interval(uint64_t start_ns, uint64_t end_ns,
   accum_busy(charged, end_ns);
 }
 
+void DutyCycleLimiter::charge_busy_unpaced(uint64_t busy_ns, uint64_t now_ns) {
+  std::lock_guard<std::mutex> lock(mu_);
+  accum_busy(busy_ns, now_ns);
+}
+
 void DutyCycleLimiter::charge_interval(uint64_t start_ns, uint64_t end_ns) {
   std::lock_guard<std::mutex> lock(mu_);
   uint64_t charged =

@@ -45,6 +45,14 @@ class DutyCycleLimiter {
   // includes the whole queue wait and would ratchet past the admit budget.
   void settle_interval(uint64_t start_ns, uint64_t end_ns, uint64_t precharge_ns);
 
+  // Charge device busy that is NOT tenant work (the calibration oracle's own
+  // probes, src/calib.*): it lands in the util window — the monitor's view
+  // stays truthful about what occupied the chip — but never debits the token
+  // bucket, never feeds the per-execute EMA, and never enters the union set,
+  // so a bounded re-attestation cadence can never pace the tenant or distort
+  // its estimates.
+  void charge_busy_unpaced(uint64_t busy_ns, uint64_t now_ns);
+
   // Charge a wall-clock interval the process spent blocked ON the runtime
   // (D2H reads, event waits). This is the busy signal of last resort:
   // proxied/tunneled runtimes fulfill completion events at ENQUEUE (observed:

@@ -149,6 +149,20 @@ void Region::set_device(size_t index, const char* uuid, uint64_t hbm_limit_bytes
   if ((int32_t)index >= region_->num_devices) region_->num_devices = index + 1;
 }
 
+void Region::set_calibration(int32_t verdict, uint32_t fallback,
+                             uint64_t ratio_ppm, uint64_t baseline_ns,
+                             uint64_t recalibs, uint64_t probe_busy_ns) {
+  if (!region_) return;
+  // Written from the attach path and the re-attestation thread while the
+  // monitor scans: relaxed atomics like every other shared field.
+  st(region_->calib_verdict, verdict);
+  st(region_->calib_fallback, fallback);
+  st(region_->calib_ratio_ppm, ratio_ppm);
+  st(region_->calib_baseline_ns, baseline_ns);
+  st(region_->calib_recalibs, recalibs);
+  st(region_->calib_probe_busy_ns, probe_busy_ns);
+}
+
 void Region::add_used(size_t index, int64_t delta) {
   if (!region_ || index >= VTPU_MAX_DEVICES) return;
   auto& slot = region_->devices[index];

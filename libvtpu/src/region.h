@@ -19,6 +19,11 @@ class Region {
 
   void set_device(size_t index, const char* uuid, uint64_t hbm_limit_bytes,
                   int core_limit_percent);
+  // Calibration-oracle state (src/calib.*): verdict, fallback flag, scale,
+  // idle-transport baseline, re-attestation count, self-charged probe busy.
+  void set_calibration(int32_t verdict, uint32_t fallback, uint64_t ratio_ppm,
+                       uint64_t baseline_ns, uint64_t recalibs,
+                       uint64_t probe_busy_ns);
   void add_used(size_t index, int64_t delta_bytes);
   void record_kernel(size_t index, uint64_t wait_ns);
   void set_core_util(size_t index, int percent);

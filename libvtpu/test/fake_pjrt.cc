@@ -10,6 +10,16 @@
 //                          runtime whose chip another tenant holds
 //   FAKE_PJRT_SHARED_QUEUE mmap this file as the busy-until so separate
 //                          PROCESSES serialize on one emulated chip
+//
+// Event-fidelity modes (the three verdict branches of the shim's
+// calibration oracle, libvtpu/src/calib.*):
+//   (default)                   FAITHFUL — execute completion events fire at
+//                               true device completion
+//   FAKE_PJRT_EVENT_AT_ENQUEUE  LYING — events report ready at enqueue (the
+//                               observed behavior of some proxied plugins)
+//   FAKE_PJRT_EVENT_RTT_NS      TRANSPORT-POLLUTED — events fire at real
+//                               completion PLUS this transport delay (event
+//                               delivery rides the tunnel)
 
 #include <fcntl.h>
 #include <string.h>
@@ -74,6 +84,15 @@ size_t num_outputs() {
 bool events_at_enqueue() {
   const char* e = std::getenv("FAKE_PJRT_EVENT_AT_ENQUEUE");
   return e != nullptr && e[0] == '1';
+}
+
+// Transport-polluted event channel: completion events are REAL (they fire
+// after the device drains) but their delivery rides the tunnel, so the host
+// observes completion this much later than it happened. Distinct from
+// FAKE_PJRT_RTT_NS, which delays the data-plane calls (uploads, D2H bytes).
+uint64_t event_rtt_ns() {
+  const char* e = std::getenv("FAKE_PJRT_EVENT_RTT_NS");
+  return e ? std::strtoull(e, nullptr, 10) : 0;
 }
 
 // Tunnel-runtime emulation: the transport round trip every synchronous call
@@ -281,6 +300,25 @@ PJRT_Error* EventOnReady(PJRT_Event_OnReady_Args* args) {
 
 // ------------------------------------------------------------- executable fns
 
+// Compile just mints an executable handle: the fake's Execute charges
+// exec_ns regardless of program content, which is exactly what the shim's
+// calibration oracle needs — a compiled probe whose device duration is a
+// process-lifetime constant it can measure by chain difference.
+PJRT_Error* ClientCompile(PJRT_Client_Compile_Args* args) {
+  if (args->program == nullptr || args->program->code_size == 0) {
+    return err(PJRT_Error_Code_INVALID_ARGUMENT, "fake: empty program");
+  }
+  args->executable = reinterpret_cast<PJRT_LoadedExecutable*>(new int(9));
+  return nullptr;
+}
+
+PJRT_Error* LoadedExecutableDestroy(PJRT_LoadedExecutable_Destroy_Args* args) {
+  // Only Compile-minted handles are heap-backed; the smoke driver passes a
+  // stack address it never destroys, so unconditional delete stays safe.
+  delete reinterpret_cast<int*>(args->executable);
+  return nullptr;
+}
+
 PJRT_Error* LoadedGetExecutable(PJRT_LoadedExecutable_GetExecutable_Args* args) {
   args->executable = reinterpret_cast<PJRT_Executable*>(new int(7));
   return nullptr;
@@ -305,7 +343,7 @@ PJRT_Error* Execute(PJRT_LoadedExecutable_Execute_Args* args) {
     done = (start > now ? start : now) + exec_ns();
   } while (!busy_until()->compare_exchange_weak(start, done));
   if (args->device_complete_events != nullptr) {
-    uint64_t ready = events_at_enqueue() ? now : done;
+    uint64_t ready = events_at_enqueue() ? now : done + event_rtt_ns();
     for (size_t d = 0; d < args->num_devices; d++) {
       args->device_complete_events[d] =
           reinterpret_cast<PJRT_Event*>(new FakeEvent{ready});
@@ -339,7 +377,9 @@ extern "C" const PJRT_Api* GetPjrtApi() {
     g_api.PJRT_Client_Create = ClientCreate;
     g_api.PJRT_Client_Destroy = ClientDestroy;
     g_api.PJRT_Client_AddressableDevices = ClientAddressableDevices;
+    g_api.PJRT_Client_Compile = ClientCompile;
     g_api.PJRT_Client_BufferFromHostBuffer = BufferFromHostBuffer;
+    g_api.PJRT_LoadedExecutable_Destroy = LoadedExecutableDestroy;
     g_api.PJRT_Buffer_Destroy = BufferDestroy;
     g_api.PJRT_Buffer_OnDeviceSizeInBytes = BufferOnDeviceSize;
     g_api.PJRT_Buffer_Device = BufferDevice;

@@ -286,7 +286,7 @@ int main(int argc, char** argv) {
   if (stats_fn == nullptr)
     stats_fn = (StatsFn)dlsym(RTLD_DEFAULT, "vtpu_stats_json");
   if (stats_fn != nullptr) {
-    char sbuf[1024];
+    char sbuf[2048];  // the calibration fields pushed the JSON past 1 KiB
     if (stats_fn(sbuf, sizeof(sbuf)) > 0) printf("STATS %s\n", sbuf);
   }
   return 0;

@@ -23,6 +23,7 @@
 #include <thread>
 #include <vector>
 
+#include "calib.h"
 #include "limiter.h"
 #include "region.h"
 
@@ -83,6 +84,39 @@ void limiter_stress(int submit_threads, int callback_threads, int iters) {
   for (size_t i = 0; i + 1 < ts.size(); i++) ts[i].join();
   stop.store(true, std::memory_order_release);
   ts.back().join();
+}
+
+void calib_stress(int reader_threads, int iters) {
+  // The calibration oracle's shared state: the attach path / re-attestation
+  // thread writes while every charge path does lock-free verdict reads and
+  // the stats exporter snapshots the whole block.
+  std::atomic<bool> stop{false};
+  std::vector<std::thread> ts;
+  for (int t = 0; t < reader_threads; t++) {
+    ts.emplace_back([&] {
+      uint64_t sink = 0;
+      while (!stop.load(std::memory_order_acquire)) {
+        sink += vtpu::calib::events_attested_faithful() ? 1 : 0;
+        sink += vtpu::calib::transport_baseline_ns();
+        sink += vtpu::calib::snapshot().ratio_ppm;
+        std::this_thread::yield();
+      }
+      if (sink == 0xdeadbeef) std::printf("unreachable\n");
+    });
+  }
+  for (int i = 0; i < iters; i++) {
+    vtpu::calib::Snapshot s;
+    s.verdict = i % 4;
+    s.fallback_engaged = s.verdict == vtpu::calib::kFaithful ? 0 : 1;
+    s.ratio_ppm = 1'000'000ull + (uint64_t)i;
+    s.baseline_ns = (uint64_t)i * 1000;
+    s.probe_ns = 2'000'000ull;
+    s.recalibs = (uint64_t)i;
+    s.probe_busy_ns = (uint64_t)i * 100;
+    vtpu::calib::set_state_for_stress(s);
+  }
+  stop.store(true, std::memory_order_release);
+  for (auto& t : ts) t.join();
 }
 
 void region_stress(const std::string& path, int writer_threads, int iters) {
@@ -150,6 +184,7 @@ int main(int argc, char** argv) {
   const char* tmp = argc > 1 ? argv[1] : "/tmp/vtpu_race_stress.cache";
   int iters = argc > 2 ? std::atoi(argv[2]) : 400;
   limiter_stress(/*submit=*/4, /*callbacks=*/3, iters);
+  calib_stress(/*readers=*/3, iters * 4);
   region_stress(tmp, /*writers=*/6, iters);
   std::printf("RACE_STRESS_OK\n");
   return 0;

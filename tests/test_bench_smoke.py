@@ -41,6 +41,12 @@ def test_prefill_bench_help_parses():
     assert "--quick" in r.stdout and "--burst" in r.stdout
 
 
+def test_spec_serving_bench_help_parses():
+    r = _run([str(ROOT / "hack" / "spec_serving_bench.py"), "--help"])
+    assert r.returncode == 0, r.stderr
+    assert "--quick" in r.stdout and "--batches" in r.stdout
+
+
 def test_decode_bench_quick_two_slot_iteration():
     r = _run([str(ROOT / "benchmarks" / "decode_bench.py"), "--quick",
               "--slots", "2", "--steps", "8", "--waves", "1",

@@ -210,6 +210,90 @@ def test_gate_releases_when_monitor_heartbeat_goes_stale(libvtpu_build, tmp_path
     assert snap.gate_forced_releases >= 1, snap.gate_forced_releases
 
 
+def _run_calib_workload(libvtpu_build, region, extra_env=None, execs=5):
+    import os
+    import subprocess as sp
+
+    env = dict(os.environ)
+    env.update({
+        "VTPU_REAL_LIBTPU": str(libvtpu_build / "fake_pjrt.so"),
+        "TPU_DEVICE_MEMORY_LIMIT_0": "64m",
+        "VTPU_SHARED_REGION": str(region),
+        "PJRT_SMOKE_D2H": "1",
+    })
+    env.update(extra_env or {})
+    r = sp.run(
+        [str(libvtpu_build / "pjrt_smoke"), str(libvtpu_build / "libvtpu.so"),
+         "1", "1", str(execs)],
+        env=env, capture_output=True, text=True,
+    )
+    assert r.returncode == 0, r.stderr
+    return r
+
+
+def test_calibration_faithful_verdict_exported_to_region(libvtpu_build, tmp_path):
+    """Attach-time attestation against the faithful fake lands in the shared
+    region: verdict faithful, fallback tower disengaged, a plausible probe
+    duration and events->duty scale (the contract vtpu.monitor exports)."""
+    from vtpu.monitor.region import CALIB_FAITHFUL, RegionReader
+
+    region = tmp_path / "usage.cache"
+    _run_calib_workload(libvtpu_build, region,
+                        {"FAKE_PJRT_EXEC_NS": "2000000"})
+    snap = RegionReader(str(region)).read()
+    assert snap.calib_verdict == CALIB_FAITHFUL
+    assert snap.calib_fallback == 0
+    # scale ~1 for a faithful channel; probe busy covers the attach runs
+    assert 500_000 <= snap.calib_ratio_ppm <= 2_000_000, snap.calib_ratio_ppm
+    assert snap.calib_probe_busy_ns > 0
+
+
+def test_calibration_lying_events_fail_attestation(libvtpu_build, tmp_path):
+    """A lying-event runtime (events ready at enqueue) must FAIL attestation:
+    its stretched calibration walls cannot match the claimed event durations,
+    so the verdict is lying and the compensator tower stays engaged."""
+    from vtpu.monitor.region import CALIB_LYING, RegionReader
+
+    region = tmp_path / "usage.cache"
+    _run_calib_workload(libvtpu_build, region,
+                        {"FAKE_PJRT_EXEC_NS": "2000000",
+                         "FAKE_PJRT_EVENT_AT_ENQUEUE": "1"})
+    snap = RegionReader(str(region)).read()
+    assert snap.calib_verdict == CALIB_LYING
+    assert snap.calib_fallback == 1
+
+
+def test_monitor_exports_calibration_metric_families(libvtpu_build, tmp_path):
+    """The monitor surfaces the calibration oracle per container: all six
+    vtpu_calibration_* families exist and carry the region's verdict."""
+    from vtpu.monitor.lister import ContainerLister
+    from vtpu.monitor.metrics import MonitorCollector
+
+    d = tmp_path / "hook" / "containers" / "poda_main"
+    d.mkdir(parents=True)
+    _run_calib_workload(libvtpu_build, d / "usage.cache",
+                        {"FAKE_PJRT_EXEC_NS": "2000000"})
+    lister = ContainerLister(str(tmp_path / "hook"))
+    metrics = {m.name: m for m in
+               MonitorCollector(lister, node_name="n1").collect()}
+    for fam in ("vtpu_calibration_verdict",
+                "vtpu_calibration_fallback_engaged",
+                "vtpu_calibration_events_scale_ratio",
+                "vtpu_calibration_transport_baseline_seconds",
+                "vtpu_calibration_recalibrations",
+                "vtpu_calibration_probe_busy_seconds"):
+        assert fam in metrics, f"{fam} missing from {sorted(metrics)}"
+    verdicts = {tuple(s.labels.values()): s.value
+                for s in metrics["vtpu_calibration_verdict"].samples}
+    assert verdicts[("poda", "main", "n1")] == 1.0  # faithful
+    scales = [s.value for s in
+              metrics["vtpu_calibration_events_scale_ratio"].samples]
+    assert scales and 0.5 <= scales[0] <= 2.0, scales
+    fallbacks = [s.value for s in
+                 metrics["vtpu_calibration_fallback_engaged"].samples]
+    assert fallbacks == [0.0], fallbacks
+
+
 def test_attach_queueing_on_exclusive_runtime(libvtpu_build, tmp_path):
     """Multi-process tenancy fallback (docs/multitenancy.md): on a runtime
     that refuses a second concurrent attach, a busy-class Client_Create

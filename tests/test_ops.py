@@ -57,10 +57,13 @@ def test_causal_attention_respects_kv_len():
 
 
 def test_decode_attention_matches_xla_paths():
-    """Pallas decode/verify kernel == the XLA reference on the same
-    operands: bf16 ragged, [B] T=1, and int8 with scale planes (the scales
-    post-matmul semantics must match causal_attention_int8kv exactly)."""
-    from vtpu.ops.attention import causal_attention_int8kv, decode_attention
+    """Pallas decode/verify kernel (the standalone study under
+    benchmarks/decode_attn_kernel.py — no in-trunk route since r6) == the
+    XLA reference on the same operands: bf16 ragged, [B] T=1, and int8 with
+    scale planes (the scales post-matmul semantics must match
+    causal_attention_int8kv exactly)."""
+    from vtpu.ops.attention import causal_attention_int8kv
+    from benchmarks.decode_attn_kernel import decode_attention
 
     rng = np.random.RandomState(3)
     b, t, h, dh, s = 2, 4, 2, 128, 256
@@ -93,7 +96,7 @@ def test_decode_attention_multiblock_online_softmax():
     """Windows larger than one S-block exercise the online accumulation
     (runs at S=1024 -> two 512 blocks); equality with the single-shot XLA
     softmax proves the rescaling bookkeeping."""
-    from vtpu.ops.attention import decode_attention
+    from benchmarks.decode_attn_kernel import decode_attention
 
     rng = np.random.RandomState(4)
     b, t, h, dh, s = 2, 1, 2, 128, 1024
@@ -107,7 +110,7 @@ def test_decode_attention_multiblock_online_softmax():
 
 
 def test_decode_attention_rejects_multi_t_flat_lens():
-    from vtpu.ops.attention import decode_attention
+    from benchmarks.decode_attn_kernel import decode_attention
     import pytest
 
     q = jnp.zeros((1, 2, 1, 128), jnp.float32)
@@ -120,7 +123,7 @@ def test_decode_attention_grid_bounded_bucket():
     """bucket bounds the reads via the grid over a LONGER cache: equality
     with XLA attention over the sliced window (the zero-copy integration
     contract — the trunk passes full per-layer views, never slices)."""
-    from vtpu.ops.attention import decode_attention
+    from benchmarks.decode_attn_kernel import decode_attention
 
     rng = np.random.RandomState(6)
     b, t, h, dh, s, bucket = 2, 1, 2, 128, 1024, 256

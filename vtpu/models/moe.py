@@ -42,10 +42,6 @@ class MoEConfig:
     head_dim: int = 128
     dtype: Any = jnp.bfloat16
     kv_int8: bool = False  # int8 KV cache (see ModelConfig.kv_int8)
-    # decode/verify attention routing through the shared trunk (see
-    # ModelConfig.decode_attn); "auto" follows DECODE_ATTN_r05 shape edges
-    decode_attn: str = "auto"
-
     @property
     def qkv_dim(self) -> int:
         return self.n_heads * self.head_dim

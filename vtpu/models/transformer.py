@@ -21,7 +21,7 @@ from vtpu.ops import (
     scaled_normal, rms_norm, apply_rope, rope_angles, causal_attention,
     causal_attention_int8kv, flash_attention,
 )
-from vtpu.ops.attention import FLASH_MIN_SEQ, decode_attention
+from vtpu.ops.attention import FLASH_MIN_SEQ
 
 Params = dict[str, Any]
 
@@ -44,12 +44,6 @@ class ModelConfig:
     # "auto": resolved at engine construction via the measured router
     # (serving.engine.choose_kv_int8 — INT8_AB_r05 cells).
     kv_int8: bool | str = False
-    # Decode/verify attention implementation. "auto" (and "xla") = the XLA
-    # op chain — the FULL-TRUNK measurements pick it at every serving cell
-    # (MFU_r05; see _decode_attn_pallas for why the kernel's standalone
-    # wins don't survive integration). "pallas" forces the fused kernel.
-    decode_attn: str = "auto"
-
     @property
     def qkv_dim(self) -> int:
         return self.n_heads * self.head_dim
@@ -316,24 +310,6 @@ def decode_step(
     return logits, {**new_kv, "len": cache["len"] + 1}
 
 
-def _decode_attn_pallas(cfg: ModelConfig) -> bool:
-    """Route the decode/verify attention. "auto" = the XLA op chain,
-    decided by FULL-TRUNK measurement, not kernel microbenches.
-
-    The r5 history, kept because it is the lesson: standalone, the fused
-    Pallas decode kernel beat XLA at every serving cell (DECODE_ATTN_r05,
-    two-chain-difference timing — 1.1-1.9x, ~760 GB/s). In the trunk it
-    loses everywhere (MFU_r05 decode, same timing): 3.09 vs 1.52 ms at
-    batch 8 / kv 1024, 22-25 ms flat vs 3.0-5.4 at batch 32. A pallas
-    operand must be materialized, and inside the decode step the cache is
-    simultaneously scatter-updated, so XLA copies the layer view it would
-    otherwise fuse the windowed reads from — the copy costs more than the
-    kernel saves, and no operand shape avoids both the copy and the
-    window. The kernel stays in-tree (decode_attn="pallas") as the
-    shard_map/aliasing work item; the DEFAULT follows the trunk numbers."""
-    return getattr(cfg, "decode_attn", "auto") == "pallas"
-
-
 def decode_layer_loop(
     params: Params,
     cfg: ModelConfig,
@@ -428,42 +404,29 @@ def spec_verify_loop(
             lp = jax.tree_util.tree_map(lambda a: a[l], params["layers"])
         q, k, v = _qkv(cfg, lp, x, cos, sin, positions)
         kv = write_kv(l, kv, k, v)
-        # The forced kernel takes the full per-layer view kv[key][l]: with
-        # the UNROLLED loop (the serving default) the static index is a
-        # contiguous leading-dim slice (no copy) and the grid bounds reads
-        # to `bucket`; a [:, :bucket] slice would force XLA to materialize
-        # the window as the pallas operand every tick (see
-        # decode_attention's docstring for the measured cost). Under
-        # fori_loop the loop-carried index materializes the full max_seq
-        # cache — correct but slow; a forced "pallas" still honors it.
-        if _decode_attn_pallas(cfg):
-            if unroll:
-                full = {key: kv[key][l] for key in kv_keys}
-            else:
-                full = {
-                    key: jax.lax.dynamic_index_in_dim(
-                        kv[key], l, 0, keepdims=False)
-                    for key in kv_keys
-                }
-            attn = decode_attention(
-                q, full["k"], full["v"], ragged_len,
-                full.get("k_scale"), full.get("v_scale"), bucket=bucket)
+        # Bounded window reads: with the UNROLLED loop (the serving
+        # default) the static index is a contiguous leading-dim slice and
+        # the [:, :bucket] view fuses into the attention reads; under
+        # fori_loop the loop-carried layer index materializes the slice
+        # (correct but slow — benchmarks/mfu_bench.py decode_fori_exhibit).
+        # The fused Pallas decode kernel that once had a forced route here
+        # is a standalone study in benchmarks/decode_attn_kernel.py: trunk
+        # measurement routed every serving cell to XLA (MFU_r05).
+        if unroll:
+            view = {key: kv[key][l, :, :bucket] for key in kv_keys}
         else:
-            if unroll:
-                view = {key: kv[key][l, :, :bucket] for key in kv_keys}
-            else:
-                view = {
-                    key: jax.lax.dynamic_index_in_dim(
-                        kv[key], l, 0, keepdims=False)[:, :bucket]
-                    for key in kv_keys
-                }
-            if quant:
-                attn = causal_attention_int8kv(
-                    q, view["k"], view["k_scale"], view["v"], view["v_scale"],
-                    kv_len=ragged_len)
-            else:
-                attn = causal_attention(
-                    q, view["k"], view["v"], kv_len=ragged_len)
+            view = {
+                key: jax.lax.dynamic_index_in_dim(
+                    kv[key], l, 0, keepdims=False)[:, :bucket]
+                for key in kv_keys
+            }
+        if quant:
+            attn = causal_attention_int8kv(
+                q, view["k"], view["k_scale"], view["v"], view["v_scale"],
+                kv_len=ragged_len)
+        else:
+            attn = causal_attention(
+                q, view["k"], view["v"], kv_len=ragged_len)
         x = x + attn.reshape(b, t, cfg.qkv_dim) @ lp["wo"]
         x = x + ffn(lp, x)
         return x, kv

@@ -81,6 +81,38 @@ class MonitorCollector(Collector):
             "Gate releases without an unblock (timeout or stale monitor)",
             labels=["podUid", "container", "nodename"],
         )
+        # Calibration oracle (libvtpu/src/calib.*): per-container event
+        # attestation state. verdict: 0 unknown, 1 faithful, 2 lying,
+        # 3 transport-polluted.
+        clabels = ["podUid", "container", "nodename"]
+        calib_verdict = GaugeMetricFamily(
+            "vtpu_calibration_verdict",
+            "Event-fidelity attestation verdict (0 unknown, 1 faithful, "
+            "2 lying, 3 transport-polluted)", labels=clabels,
+        )
+        calib_fallback = GaugeMetricFamily(
+            "vtpu_calibration_fallback_engaged",
+            "1 while the sync-wall compensator tower is the charging path "
+            "(events not live-verified faithful)", labels=clabels,
+        )
+        calib_scale = GaugeMetricFamily(
+            "vtpu_calibration_events_scale_ratio",
+            "Calibrated events-to-duty scale (attested device duration / "
+            "event-reported duration)", labels=clabels,
+        )
+        calib_baseline = GaugeMetricFamily(
+            "vtpu_calibration_transport_baseline_seconds",
+            "Attested per-session idle-transport baseline", labels=clabels,
+        )
+        calib_recalibs = CounterMetricFamily(
+            "vtpu_calibration_recalibrations_total",
+            "Periodic re-attestation probe runs", labels=clabels,
+        )
+        calib_probe_busy = CounterMetricFamily(
+            "vtpu_calibration_probe_busy_seconds_total",
+            "Cumulative self-charged calibration probe device time",
+            labels=clabels,
+        )
         now_ns = time.time_ns()
         for e in entries:
             snap = e.snapshot
@@ -95,6 +127,13 @@ class MonitorCollector(Collector):
             gate_forced.add_metric(
                 [e.pod_uid, e.container, self.node_name], snap.gate_forced_releases
             )
+            cl = [e.pod_uid, e.container, self.node_name]
+            calib_verdict.add_metric(cl, snap.calib_verdict)
+            calib_fallback.add_metric(cl, snap.calib_fallback)
+            calib_scale.add_metric(cl, snap.calib_ratio_ppm / 1e6)
+            calib_baseline.add_metric(cl, snap.calib_baseline_ns / 1e9)
+            calib_recalibs.add_metric(cl, snap.calib_recalibs)
+            calib_probe_busy.add_metric(cl, snap.calib_probe_busy_ns / 1e9)
             for dev in snap.devices:
                 lv = [e.pod_uid, e.container, dev.uuid, self.node_name]
                 mem_used.add_metric(lv, dev.hbm_used_bytes)
@@ -108,7 +147,9 @@ class MonitorCollector(Collector):
                 throttled.add_metric(lv, dev.throttle_wait_ns / 1e9)
         families = (mem_used, mem_limit, mem_peak, core_util, core_limit,
                     last_kernel, kernels, throttled, priority, blocked,
-                    gate_blocked, gate_forced)
+                    gate_blocked, gate_forced, calib_verdict, calib_fallback,
+                    calib_scale, calib_baseline, calib_recalibs,
+                    calib_probe_busy)
         yield from families
         yield from self._host_families(entries)
         if self.legacy_metrics:

@@ -11,7 +11,10 @@ static_asserts and the cross-language test in tests/test_libvtpu.py):
              recent_kernel i32 | utilization_switch i32 | heartbeat_ns u64 |
              owner_init_ns u64 | monitor_heartbeat_ns u64 |
              gate_timeout_ms u32 | pad u32 | gate_blocked_ns u64 |
-             gate_forced_releases u64                            (72 bytes)
+             gate_forced_releases u64 |
+             calib_verdict i32 | calib_fallback u32 | calib_ratio_ppm u64 |
+             calib_baseline_ns u64 | calib_recalibs u64 |
+             calib_probe_busy_ns u64                            (112 bytes)
     devices: 16 x { uuid[64] | hbm_limit u64 | hbm_used u64 | hbm_peak u64 |
              core_limit i32 | core_util i32 | last_kernel_ns u64 |
              kernel_count u64 | throttle_wait_ns u64 }          (120 bytes)
@@ -27,13 +30,25 @@ import struct
 from dataclasses import dataclass, field
 
 MAGIC = 0x56545055
-VERSION = 2
+VERSION = 3
 MAX_DEVICES = 16
 MAX_PROCS = 64
 UUID_LEN = 64
 
-HEADER_FMT = "<IIiiiiQQQIIQQ"
-HEADER_SIZE = struct.calcsize(HEADER_FMT)  # 72
+# calib_verdict values (libvtpu calibration oracle, shared_region.h)
+CALIB_UNKNOWN = 0
+CALIB_FAITHFUL = 1
+CALIB_LYING = 2
+CALIB_TRANSPORT_POLLUTED = 3
+CALIB_VERDICT_NAMES = {
+    CALIB_UNKNOWN: "unknown",
+    CALIB_FAITHFUL: "faithful",
+    CALIB_LYING: "lying",
+    CALIB_TRANSPORT_POLLUTED: "transport_polluted",
+}
+
+HEADER_FMT = "<IIiiiiQQQIIQQiIQQQQ"
+HEADER_SIZE = struct.calcsize(HEADER_FMT)  # 112
 DEVICE_FMT = f"<{UUID_LEN}sQQQiiQQQ"
 DEVICE_SIZE = struct.calcsize(DEVICE_FMT)  # 120
 DEVICES_OFF = HEADER_SIZE
@@ -85,6 +100,12 @@ class RegionSnapshot:
     gate_timeout_ms: int = 0
     gate_blocked_ns: int = 0
     gate_forced_releases: int = 0
+    calib_verdict: int = 0
+    calib_fallback: int = 1
+    calib_ratio_ppm: int = 0
+    calib_baseline_ns: int = 0
+    calib_recalibs: int = 0
+    calib_probe_busy_ns: int = 0
     devices: list[DeviceSnapshot] = field(default_factory=list)
     procs: list[ProcSnapshot] = field(default_factory=list)
 
@@ -129,6 +150,9 @@ class RegionReader:
             heartbeat_ns=hdr[6], owner_init_ns=hdr[7],
             monitor_heartbeat_ns=hdr[8], gate_timeout_ms=hdr[9],
             gate_blocked_ns=hdr[11], gate_forced_releases=hdr[12],
+            calib_verdict=hdr[13], calib_fallback=hdr[14],
+            calib_ratio_ppm=hdr[15], calib_baseline_ns=hdr[16],
+            calib_recalibs=hdr[17], calib_probe_busy_ns=hdr[18],
         )
         n_dev = min(max(snap.num_devices, 0), MAX_DEVICES)
         for i in range(n_dev):

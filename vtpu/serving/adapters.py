@@ -108,17 +108,6 @@ class TransformerSlotModel:
         else:
             from vtpu.parallel.sharding import shard_params
 
-            # tp serving runs the trunk under GSPMD auto-partitioning; a
-            # pallas_call there cannot be partitioned over the head-sharded
-            # cache (it would gather the full window per chip). "auto"
-            # already routes XLA (r5: the trunk measurements picked it
-            # everywhere), so this guard only needs to catch an explicit
-            # decode_attn="pallas" leaking onto a mesh.
-            if getattr(cfg, "decode_attn", None) == "pallas":
-                raise ValueError(
-                    "decode_attn='pallas' is single-chip only (the kernel "
-                    "cannot GSPMD-partition a head-sharded cache)")
-
             extra = {a: n for a, n in mesh.shape.items() if a != "tp" and n != 1}
             if extra:
                 # decode ticks would replicate across every non-tp axis
