@@ -283,7 +283,16 @@ def tenant_main(a: argparse.Namespace) -> None:
                 # inter-token-latency percentiles
                 "admission_stall_ms", "prefill_batch_hist",
                 "admission_syncs", "batched_admission",
-                "itl_p50_ms", "itl_p99_ms")},
+                "itl_p50_ms", "itl_p99_ms",
+                # KV-memory data plane: the per-tick read-window histogram
+                # (the dense path's global longest-sequence read tax made
+                # visible), the dense-vs-paged HBM estimate whose ratio is
+                # the oversubscription headroom, and — when paging is on —
+                # pool occupancy, blocked-on-pool admissions, and the
+                # zero-copy prefix counters
+                "kv_bucket_hist", "kv_hbm_bytes", "paged",
+                "kv_pool_occupancy", "pool_blocked_admissions",
+                "prefix_blocks_shared", "prefix_install_copies")},
         }), flush=True)
     eng.stop()
     if os.environ.get("VTPU_BENCH_REGISTER") == "1":

@@ -47,6 +47,38 @@ def test_spec_serving_bench_help_parses():
     assert "--quick" in r.stdout and "--batches" in r.stdout
 
 
+def test_paged_kv_bench_help_parses():
+    r = _run([str(ROOT / "benchmarks" / "paged_kv_bench.py"), "--help"])
+    assert r.returncode == 0, r.stderr
+    assert "--quick" in r.stdout and "--page" in r.stdout
+
+
+def test_paged_kv_bench_quick_small_iteration():
+    """paged_kv_bench --quick end to end at smoke scale: the artifact
+    parses, the arms carry the equal-HBM shapes, and the structural
+    acceptance contract holds — the paged prefix microbench performs ZERO
+    full-prefix install copies while sharing blocks (the perf ratio itself
+    is asserted by the bench's own "pass" field on real runs, not by this
+    noisy-CI smoke)."""
+    r = _run([str(ROOT / "benchmarks" / "paged_kv_bench.py"), "--quick",
+              "--hbm-tokens", "256", "--max-seq", "128", "--requests", "6",
+              "--max-new", "12", "--prefix-requests", "3"])
+    assert r.returncode == 0, r.stderr
+    lines = [ln for ln in r.stdout.splitlines() if ln.strip()]
+    artifact = json.loads(lines[0])
+    summary = json.loads(lines[-1])
+    assert artifact["metric"] == "paged_kv_equal_hbm_tokens_per_sec_speedup"
+    arms = {a["arm"]: a for a in artifact["arms"]}
+    assert arms["paged"]["kv_page"] and not arms["dense"]["kv_page"]
+    assert arms["paged"]["slots"] >= arms["dense"]["slots"]
+    assert arms["paged"]["tokens"] == arms["dense"]["tokens"]
+    px = {a["arm"]: a for a in artifact["prefix_microbench"]}
+    assert px["dense"]["prefix_install_copies"] == 3
+    assert px["paged"]["prefix_install_copies"] == 0
+    assert px["paged"]["prefix_blocks_shared"] > 0
+    assert summary["summary"] and summary["prefix_zero_copy"]
+
+
 def test_decode_bench_quick_two_slot_iteration():
     r = _run([str(ROOT / "benchmarks" / "decode_bench.py"), "--quick",
               "--slots", "2", "--steps", "8", "--waves", "1",

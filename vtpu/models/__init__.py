@@ -11,6 +11,8 @@ from vtpu.models.transformer import (
     ModelConfig,
     init_params,
     init_kv_cache,
+    init_paged_kv_cache,
+    kv_bytes_per_token,
     prefill,
     decode_step,
     greedy_generate,
@@ -36,6 +38,8 @@ __all__ = [
     "ModelConfig",
     "init_params",
     "init_kv_cache",
+    "init_paged_kv_cache",
+    "kv_bytes_per_token",
     "prefill",
     "decode_step",
     "greedy_generate",

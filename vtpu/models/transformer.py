@@ -19,7 +19,8 @@ import jax.numpy as jnp
 
 from vtpu.ops import (
     scaled_normal, rms_norm, apply_rope, rope_angles, causal_attention,
-    causal_attention_int8kv, flash_attention,
+    causal_attention_int8kv, flash_attention, paged_causal_attention,
+    paged_causal_attention_int8kv,
 )
 from vtpu.ops.attention import FLASH_MIN_SEQ
 
@@ -89,6 +90,63 @@ def init_kv_cache(cfg: ModelConfig, batch: int) -> dict[str, jax.Array]:
         "v": jnp.zeros(shape, cfg.dtype),
         "len": jnp.zeros((batch,), jnp.int32),
     }
+
+
+def init_paged_kv_cache(
+    cfg: ModelConfig, slots: int, page: int, n_blocks: int
+) -> dict[str, jax.Array]:
+    """Paged KV pool state: logical sequences decoupled from physical KV.
+
+    One shared block pool per k/v plane, [L, n_blocks, page, H, Dh] (int8
+    caches carry [L, n_blocks, page, H] f32 scale pools alongside), plus a
+    per-slot page table [slots, max_pages] int32 mapping slot b's logical
+    page p to a pool block. All shapes static, so every executable stays
+    compile-once exactly like the dense ring. Block 0 is the NULL block —
+    the engine's allocator never hands it out; unmapped table entries point
+    at it so out-of-window gathers and overflow writes land on one shared,
+    always-masked block instead of another slot's memory.
+
+    The payoff over init_kv_cache: a dense pool pins slots * max_seq tokens
+    of HBM whether or not any sequence ever grows that long; a paged pool
+    sized to EXPECTED live tokens holds more concurrent slots in the same
+    bytes (oversubscription, with admission backpressure when the free list
+    runs dry) and lets shared prompt prefixes map the same physical blocks
+    read-only from many slots' tables.
+    """
+    if cfg.max_seq % page:
+        raise ValueError(f"kv page {page} must divide max_seq {cfg.max_seq}")
+    max_pages = cfg.max_seq // page
+    shape = (cfg.n_layers, n_blocks, page, cfg.n_heads, cfg.head_dim)
+    cache: dict[str, jax.Array] = {
+        "table": jnp.zeros((slots, max_pages), jnp.int32),
+        "len": jnp.zeros((slots,), jnp.int32),
+    }
+    if kv_quantized(cfg):
+        cache.update({
+            "k": jnp.zeros(shape, jnp.int8),
+            "v": jnp.zeros(shape, jnp.int8),
+            "k_scale": jnp.zeros(shape[:-1], jnp.float32),
+            "v_scale": jnp.zeros(shape[:-1], jnp.float32),
+        })
+    else:
+        cache.update({
+            "k": jnp.zeros(shape, cfg.dtype),
+            "v": jnp.zeros(shape, cfg.dtype),
+        })
+    return cache
+
+
+def kv_bytes_per_token(cfg) -> int:
+    """HBM bytes one cached token costs across all layers — the unit the
+    paged-vs-dense capacity estimates in ServingEngine.stats() and the
+    paged_kv_bench HBM budgets are denominated in."""
+    per_plane = cfg.n_heads * cfg.head_dim
+    if kv_quantized(cfg):
+        # int8 values + per-token-per-head f32 scales, two planes
+        per_layer = 2 * (per_plane * 1 + cfg.n_heads * 4)
+    else:
+        per_layer = 2 * per_plane * jnp.dtype(cfg.dtype).itemsize
+    return cfg.n_layers * per_layer
 
 
 def kv_quantized(cfg) -> bool:
@@ -386,6 +444,16 @@ def spec_verify_loop(
     ffn = ffn_fn or _mlp_block
     cos, sin = rope_angles(cfg.max_seq, cfg.head_dim)
     lens = cache["len"]
+    # Paged pool ("table" present): reads gather each slot's live pages
+    # through its page-table row instead of slicing a per-slot ring. The
+    # gathered window is positionally identical to the dense prefix
+    # [:, :bucket], so the ragged masks and every numeric below are SHARED
+    # verbatim — paged-vs-dense streams stay token-identical. The caller's
+    # write_kv owns the paged scatter (block id = table[b, pos // page]).
+    table = cache.get("table")
+    if table is not None:
+        page = cache["k"].shape[2]  # [L, n_blocks, page, H, Dh]
+        table_w = table[:, : bucket // page]  # [B, Wp]
     # clip: a slot near the context wall still computes (static shapes) but
     # its out-of-range rows are never written (write_kv masks) nor emitted
     # (the engine caps acceptance); clipping only keeps the rope gather legal
@@ -413,20 +481,30 @@ def spec_verify_loop(
         # is a standalone study in benchmarks/decode_attn_kernel.py: trunk
         # measurement routed every serving cell to XLA (MFU_r05).
         if unroll:
-            view = {key: kv[key][l, :, :bucket] for key in kv_keys}
+            view = {key: kv[key][l] for key in kv_keys}
         else:
             view = {
                 key: jax.lax.dynamic_index_in_dim(
-                    kv[key], l, 0, keepdims=False)[:, :bucket]
+                    kv[key], l, 0, keepdims=False)
                 for key in kv_keys
             }
-        if quant:
+        if table is not None:
+            if quant:
+                attn = paged_causal_attention_int8kv(
+                    q, view["k"], view["k_scale"], view["v"],
+                    view["v_scale"], table_w, kv_len=ragged_len)
+            else:
+                attn = paged_causal_attention(
+                    q, view["k"], view["v"], table_w, kv_len=ragged_len)
+        elif quant:
             attn = causal_attention_int8kv(
-                q, view["k"], view["k_scale"], view["v"], view["v_scale"],
+                q, view["k"][:, :bucket], view["k_scale"][:, :bucket],
+                view["v"][:, :bucket], view["v_scale"][:, :bucket],
                 kv_len=ragged_len)
         else:
             attn = causal_attention(
-                q, view["k"], view["v"], kv_len=ragged_len)
+                q, view["k"][:, :bucket], view["v"][:, :bucket],
+                kv_len=ragged_len)
         x = x + attn.reshape(b, t, cfg.qkv_dim) @ lp["wo"]
         x = x + ffn(lp, x)
         return x, kv
@@ -442,6 +520,11 @@ def spec_verify_loop(
         x, new_kv = jax.lax.fori_loop(0, cfg.n_layers, layer, (x, kv0))
     x = rms_norm(x, params["final_norm"])
     logits = (x @ params["embed"].T).astype(jnp.float32)
+    if table is not None:
+        # the table is read-only inside the trunk (the engine owns it,
+        # updating rows host-side at admission); pass it through so the
+        # returned state pytree matches the input and donation can alias
+        new_kv = {**new_kv, "table": table}
     return logits, new_kv
 
 

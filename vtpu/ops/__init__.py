@@ -14,6 +14,9 @@ from vtpu.ops.attention import (
     causal_attention,
     causal_attention_int8kv,
     flash_attention,
+    gather_kv_pages,
+    paged_causal_attention,
+    paged_causal_attention_int8kv,
 )
 
 __all__ = [
@@ -24,4 +27,7 @@ __all__ = [
     "causal_attention",
     "causal_attention_int8kv",
     "flash_attention",
+    "gather_kv_pages",
+    "paged_causal_attention",
+    "paged_causal_attention_int8kv",
 ]

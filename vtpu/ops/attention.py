@@ -110,6 +110,70 @@ def causal_attention_int8kv(
     return out.astype(q.dtype)
 
 
+def gather_kv_pages(pool: jax.Array, table: jax.Array) -> jax.Array:
+    """Materialize a slot-pooled read window from a paged block pool.
+
+    pool: one layer's plane, [n_blocks, page, ...] (KV values [.., H, Dh] or
+    int8 scales [.., H]); table: [B, Wp] int32 block ids, entry p of row b
+    naming the block holding slot b's logical page p. Returns
+    [B, Wp*page, ...] — positionally IDENTICAL to the dense cache slice
+    [:, :Wp*page], which is what keeps every downstream mask, ragged length,
+    and numeric exactly shared with the dense path: a paged read is a gather
+    plus reshape in front of the same attention.
+
+    Window entries past a slot's live pages carry block id 0 (the engine's
+    reserved null block), so a short slot's padding reads dedupe onto one
+    HBM-resident block instead of streaming distinct dead lines — the
+    per-slot analogue of "pad to the smallest bucket covering THIS slot's
+    length" that a single static-shape dispatch could not otherwise express.
+    Null-block values are garbage by design; every consumer masks reads at
+    kv_len, so they are never observable.
+    """
+    b, wp = table.shape
+    g = pool[table]  # [B, Wp, page, ...]
+    return g.reshape((b, wp * pool.shape[1]) + pool.shape[2:])
+
+
+def paged_causal_attention(
+    q: jax.Array,
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    table: jax.Array,
+    kv_len: jax.Array | None = None,
+) -> jax.Array:
+    """Causal attention over a paged KV window: gather each slot's live
+    pages from the shared block pool, then the reference attention.
+
+    q: [B, Sq, H, Dh]; k_pool, v_pool: [n_blocks, page, H, Dh] (ONE layer's
+    plane of the pool); table: [B, Wp] block ids with Wp*page >= the read
+    window. kv_len exactly as in causal_attention — the gathered window is
+    positionally identical to a dense cache prefix, so the masking contract
+    is unchanged."""
+    k = gather_kv_pages(k_pool, table)
+    v = gather_kv_pages(v_pool, table)
+    return causal_attention(q, k, v, kv_len=kv_len)
+
+
+def paged_causal_attention_int8kv(
+    q: jax.Array,
+    kq_pool: jax.Array,
+    k_scale_pool: jax.Array,
+    vq_pool: jax.Array,
+    v_scale_pool: jax.Array,
+    table: jax.Array,
+    kv_len: jax.Array | None = None,
+) -> jax.Array:
+    """Paged variant of causal_attention_int8kv: int8 value pools
+    [n_blocks, page, H, Dh] plus f32 scale pools [n_blocks, page, H],
+    gathered per slot through the same page table, then the shared
+    int8-window attention (scales applied post-matmul, exactly as dense)."""
+    kq = gather_kv_pages(kq_pool, table)
+    vq = gather_kv_pages(vq_pool, table)
+    k_scale = gather_kv_pages(k_scale_pool, table)
+    v_scale = gather_kv_pages(v_scale_pool, table)
+    return causal_attention_int8kv(q, kq, k_scale, vq, v_scale, kv_len=kv_len)
+
+
 # Below this sequence length the kernel is maintenance without payoff.
 # r5 re-measured with RTT-cancelled timing (two-chain-length difference —
 # the r3/r4 per-call numbers carried ~RTT/k of tunnel transport, which

@@ -1,6 +1,7 @@
 """TPU-native serving engine: continuous batching over a slot-based KV cache."""
 
 from vtpu.serving.engine import (
+    BlockAllocator,
     Request,
     ServingConfig,
     ServingEngine,
@@ -10,6 +11,7 @@ from vtpu.serving.engine import (
 )
 
 __all__ = [
+    "BlockAllocator",
     "Request",
     "ServingConfig",
     "ServingEngine",

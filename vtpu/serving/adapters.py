@@ -99,10 +99,20 @@ class TransformerSlotModel:
 
     supports_kv_buckets = True
 
-    def __init__(self, params: Any, cfg: Any, mesh: Optional[Any] = None):
+    def __init__(self, params: Any, cfg: Any, mesh: Optional[Any] = None,
+                 kv_page: Optional[int] = None,
+                 kv_pool_blocks: Optional[int] = None):
         self.cfg = cfg
         self.mesh = mesh
         self.max_context = cfg.max_seq
+        _init_paged_attrs(self, kv_page, kv_pool_blocks)
+        if kv_page is not None and mesh is not None:
+            # a head-sharded BLOCK pool needs sharded gathers/scatters the
+            # paged trunk doesn't express yet; fail at construction, not
+            # with a wrong-sharding surprise mid-serving
+            raise ValueError(
+                "paged KV (kv_page) does not compose with tensor-parallel "
+                "serving yet")
         if mesh is None:
             self.params = params
         else:
@@ -121,6 +131,8 @@ class TransformerSlotModel:
     def init_state(self, slots: int):
         from vtpu.models.transformer import init_kv_cache
 
+        if self.kv_page is not None:
+            return _init_paged_state(self, slots)
         if self.mesh is None:
             return init_kv_cache(self.cfg, slots)
         from vtpu.models.transformer import kv_quantized
@@ -169,13 +181,41 @@ class TransformerSlotModel:
         )
 
     def prefill_chunk_into_slot(self, params, state, chunk, slot, offset,
-                                new_len, kv_bucket=0, unroll=False):
+                                new_len, kv_bucket=0, unroll=False,
+                                block_ids=None):
         from vtpu.serving.engine import chunked_prefill_into_slot
 
         return chunked_prefill_into_slot(
             params, self.cfg, state, chunk, slot, offset, new_len,
-            kv_bucket=kv_bucket, unroll=unroll,
+            kv_bucket=kv_bucket, unroll=unroll, block_ids=block_ids,
         )
+
+
+def _init_paged_attrs(model: Any, kv_page: Optional[int],
+                      kv_pool_blocks: Optional[int]) -> None:
+    """Shared paged-pool attribute setup for KV-cache adapter families.
+    kv_pool_blocks counts USABLE blocks; n_kv_blocks (resolved at
+    init_state once the slot count is known) includes the reserved null
+    block 0."""
+    model.kv_page = kv_page
+    model.kv_pool_blocks = kv_pool_blocks
+    model.n_kv_blocks = None
+
+
+def _init_paged_state(model: Any, slots: int):
+    from vtpu.models.transformer import init_paged_kv_cache
+
+    max_pages = model.max_context // model.kv_page
+    if model.kv_pool_blocks is not None and model.kv_pool_blocks < 1:
+        # an explicit 0 must never silently become the dense-equivalent
+        # default — the operator asked for a pool that cannot exist
+        raise ValueError(
+            f"kv_pool_blocks must be >= 1, got {model.kv_pool_blocks}")
+    usable = (model.kv_pool_blocks if model.kv_pool_blocks is not None
+              else slots * max_pages)
+    model.n_kv_blocks = usable + 1  # + the reserved null block 0
+    return init_paged_kv_cache(
+        model.cfg, slots, model.kv_page, model.n_kv_blocks)
 
 
 class MoeSlotModel:
@@ -186,14 +226,19 @@ class MoeSlotModel:
 
     supports_kv_buckets = True
 
-    def __init__(self, params: Any, cfg: Any):
+    def __init__(self, params: Any, cfg: Any,
+                 kv_page: Optional[int] = None,
+                 kv_pool_blocks: Optional[int] = None):
         self.params = params
         self.cfg = cfg
         self.max_context = cfg.max_seq
+        _init_paged_attrs(self, kv_page, kv_pool_blocks)
 
     def init_state(self, slots: int):
         from vtpu.models.transformer import init_kv_cache
 
+        if self.kv_page is not None:
+            return _init_paged_state(self, slots)
         return init_kv_cache(self.cfg, slots)
 
     def prefill_into_slot(self, params, state, padded, slot, true_len):
@@ -242,7 +287,8 @@ class MoeSlotModel:
         )
 
     def prefill_chunk_into_slot(self, params, state, chunk, slot, offset,
-                                new_len, kv_bucket=0, unroll=False):
+                                new_len, kv_bucket=0, unroll=False,
+                                block_ids=None):
         from vtpu.models.moe import moe_decode_ffn
         from vtpu.serving.engine import chunked_prefill_into_slot
 
@@ -251,6 +297,7 @@ class MoeSlotModel:
         return chunked_prefill_into_slot(
             params, self.cfg, state, chunk, slot, offset, new_len,
             kv_bucket=kv_bucket, unroll=unroll, ffn_fn=moe_decode_ffn(self.cfg),
+            block_ids=block_ids,
         )
 
 

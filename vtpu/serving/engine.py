@@ -35,6 +35,7 @@ from vtpu.models.transformer import (
     ModelConfig,
     Params,
     decode_layer_loop,
+    kv_bytes_per_token,
     kv_quantized,
     prefill,
     quantize_kv,
@@ -144,6 +145,26 @@ class ServingConfig:
     # prefill chunk, when chunking is on) or admission could starve until
     # the engine drains idle; validated at engine construction.
     prefill_budget: int = 0
+    # --- paged KV cache (the KV-memory data plane) -----------------------
+    # kv_page (tokens per block; None = dense, bit-identical to the classic
+    # per-slot ring) switches the pool state to a SHARED block pool
+    # [L, n_blocks, page, H, Dh] per k/v plane plus a per-slot page table
+    # [slots, max_pages] int32 — logical sequences decoupled from physical
+    # KV storage (the Zorua/vLLM resource-virtualization move). Admission
+    # becomes pool-aware: a request reserves pages covering prompt + its
+    # token budget (not max_seq), parks on the waiting list under pool
+    # exhaustion (backpressure, never OOM), and a registered prefix's
+    # blocks map read-only into many slots' tables (zero-copy sharing;
+    # copy-on-write only for the partial boundary block). kv_page must
+    # divide max_seq and every prefill bucket.
+    kv_page: Optional[int] = None
+    # Pool size in blocks (excluding the reserved null block 0). None =
+    # slots * max_pages — dense-equivalent capacity, no oversubscription.
+    # Sizing it to EXPECTED live tokens instead (concurrency * mean
+    # prompt+generation length) is the whole point: the same HBM holds
+    # materially more concurrent slots, and the free-list backpressure
+    # absorbs the tail instead of an allocator failure.
+    kv_pool_blocks: Optional[int] = None
 
 
 def choose_kv_int8(slots: int, max_window: int) -> bool:
@@ -162,6 +183,82 @@ def choose_kv_int8(slots: int, max_window: int) -> bool:
     server.go:660-673 — this router keeps the same property for the
     shapes it selects.)"""
     return slots >= 16 or max_window <= 1024
+
+
+class BlockAllocator:
+    """Host-side free list + refcounts over the shared KV block pool.
+
+    Block 0 is RESERVED as the null block: unmapped page-table entries
+    point at it, so out-of-window gathers and overflow writes always land
+    on one shared, permanently-masked block instead of memory some other
+    slot owns. The allocator therefore manages ids 1..n_blocks-1.
+
+    Refcounts carry the zero-copy prefix contract: a freshly allocated
+    block starts at refcount 1 (its owner — a slot's private page or the
+    prefix registry's pinned copy); mapping a prefix block read-only into
+    another slot's table is share() (+1); retire/unregister is release()
+    (-1, back on the free list at zero). A block with live mappings
+    survives its prefix's unregistration — exactly the lifecycle the
+    refcount tests pin.
+
+    Thread-safe: admissions allocate on the serving-loop thread while
+    unregister_prefix releases on a caller thread.
+    """
+
+    def __init__(self, n_blocks: int):
+        if n_blocks < 2:
+            raise ValueError(
+                f"kv pool needs >= 2 blocks (null + 1 usable), got {n_blocks}")
+        self.n_blocks = n_blocks
+        # LIFO free list: recently-freed blocks are re-handed first (their
+        # pool pages are the likeliest still resident in any cache level)
+        self._free = list(range(n_blocks - 1, 0, -1))
+        self._ref = [0] * n_blocks
+        self._lock = threading.Lock()
+
+    @property
+    def free_blocks(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    def alloc(self, n: int) -> Optional[list[int]]:
+        """n fresh blocks at refcount 1, or None (all-or-nothing) when the
+        free list can't cover the request — the caller parks the admission
+        instead of partially reserving."""
+        with self._lock:
+            if n > len(self._free):
+                return None
+            out = [self._free.pop() for _ in range(n)]
+            for b in out:
+                self._ref[b] = 1
+            return out
+
+    def share(self, blocks: list[int]) -> None:
+        """Map already-live blocks read-only into one more table (+1)."""
+        with self._lock:
+            for b in blocks:
+                if self._ref[b] <= 0:
+                    # a hard raise, not an assert: under python -O a
+                    # silently revived block would be double-mapped into
+                    # two slots' tables — cross-slot KV corruption with
+                    # no diagnostic
+                    raise RuntimeError(f"share of dead block {b}")
+                self._ref[b] += 1
+
+    def release(self, blocks: list[int]) -> None:
+        """Drop one mapping per block; a block returns to the free list
+        only when its LAST mapping (slot table or prefix registry) goes."""
+        with self._lock:
+            for b in blocks:
+                if self._ref[b] <= 0:
+                    raise RuntimeError(f"double free of block {b}")
+                self._ref[b] -= 1
+                if self._ref[b] == 0:
+                    self._free.append(b)
+
+    def refcount(self, block: int) -> int:
+        with self._lock:
+            return self._ref[block]
 
 
 @dataclasses.dataclass(eq=False)
@@ -224,28 +321,63 @@ def batched_decode_step(
     lens = cache["len"]
     rows = jnp.arange(b)
 
-    def write_kv(l, kv, k, v):
-        # per-slot scatter at (l, row, lens[row]); inactive rows keep old KV
-        out = dict(kv)
-        if "k_scale" in kv:
-            kq, ksc = quantize_kv(k[:, 0])  # [B, H, Dh] -> int8 + [B, H]
-            vq, vsc = quantize_kv(v[:, 0])
-            out["k"] = kv["k"].at[l, rows, lens].set(
-                jnp.where(active[:, None, None], kq, kv["k"][l, rows, lens]))
-            out["v"] = kv["v"].at[l, rows, lens].set(
-                jnp.where(active[:, None, None], vq, kv["v"][l, rows, lens]))
-            out["k_scale"] = kv["k_scale"].at[l, rows, lens].set(
-                jnp.where(active[:, None], ksc, kv["k_scale"][l, rows, lens]))
-            out["v_scale"] = kv["v_scale"].at[l, rows, lens].set(
-                jnp.where(active[:, None], vsc, kv["v_scale"][l, rows, lens]))
+    if "table" in cache:
+        # Paged pool: token t of slot b lands at (table[b, t // page],
+        # t % page). Inactive rows (and any position past the context
+        # wall) get a deliberately out-of-range block id and mode="drop":
+        # a retired slot's STALE table row may name blocks the allocator
+        # has since handed to another slot, so the dense path's
+        # read-modify-where is not merely wasteful here — it would let a
+        # dead slot corrupt a live one's pages.
+        page = cache["k"].shape[2]
+        nb = cache["k"].shape[1]
+        blocks = cache["table"][rows, lens // page]
+        off = lens % page
+        blk_w = jnp.where(active & (lens < cfg.max_seq), blocks, nb)
+
+        def write_kv(l, kv, k, v):
+            out = dict(kv)
+            if "k_scale" in kv:
+                kq, ksc = quantize_kv(k[:, 0])  # [B, H, Dh] -> int8 + [B, H]
+                vq, vsc = quantize_kv(v[:, 0])
+                out["k"] = kv["k"].at[l, blk_w, off].set(kq, mode="drop")
+                out["v"] = kv["v"].at[l, blk_w, off].set(vq, mode="drop")
+                out["k_scale"] = kv["k_scale"].at[l, blk_w, off].set(
+                    ksc, mode="drop")
+                out["v_scale"] = kv["v_scale"].at[l, blk_w, off].set(
+                    vsc, mode="drop")
+                return out
+            out["k"] = kv["k"].at[l, blk_w, off].set(k[:, 0], mode="drop")
+            out["v"] = kv["v"].at[l, blk_w, off].set(v[:, 0], mode="drop")
             return out
-        out["k"] = kv["k"].at[l, rows, lens].set(
-            jnp.where(active[:, None, None], k[:, 0], kv["k"][l, rows, lens])
-        )
-        out["v"] = kv["v"].at[l, rows, lens].set(
-            jnp.where(active[:, None, None], v[:, 0], kv["v"][l, rows, lens])
-        )
-        return out
+    else:
+        def write_kv(l, kv, k, v):
+            # per-slot scatter at (l, row, lens[row]); inactive rows keep
+            # old KV
+            out = dict(kv)
+            if "k_scale" in kv:
+                kq, ksc = quantize_kv(k[:, 0])  # [B, H, Dh] -> int8 + [B, H]
+                vq, vsc = quantize_kv(v[:, 0])
+                out["k"] = kv["k"].at[l, rows, lens].set(
+                    jnp.where(active[:, None, None], kq,
+                              kv["k"][l, rows, lens]))
+                out["v"] = kv["v"].at[l, rows, lens].set(
+                    jnp.where(active[:, None, None], vq,
+                              kv["v"][l, rows, lens]))
+                out["k_scale"] = kv["k_scale"].at[l, rows, lens].set(
+                    jnp.where(active[:, None], ksc,
+                              kv["k_scale"][l, rows, lens]))
+                out["v_scale"] = kv["v_scale"].at[l, rows, lens].set(
+                    jnp.where(active[:, None], vsc,
+                              kv["v_scale"][l, rows, lens]))
+                return out
+            out["k"] = kv["k"].at[l, rows, lens].set(
+                jnp.where(active[:, None, None], k[:, 0],
+                          kv["k"][l, rows, lens]))
+            out["v"] = kv["v"].at[l, rows, lens].set(
+                jnp.where(active[:, None, None], v[:, 0],
+                          kv["v"][l, rows, lens]))
+            return out
 
     logits, new_kv = decode_layer_loop(
         params, cfg, cache, tokens, kv_bucket, write_kv, ffn_fn=ffn_fn,
@@ -292,19 +424,39 @@ def batched_spec_step(
     # between a genuine write at max_seq-1 and a clipped one
     pos_w = jnp.where(active[:, None] & (pos < cfg.max_seq), pos, cfg.max_seq + 7)
 
+    if "table" in cache:
+        # paged scatter: draft position i of slot b lands in block
+        # table[b, pos // page] at offset pos % page; the same drop
+        # sentinel (an out-of-range block id) covers inactive rows AND
+        # positions past the context wall — see batched_decode_step on why
+        # drop (not where) is load-bearing for stale tables
+        page = cache["k"].shape[2]
+        nb = cache["k"].shape[1]
+        blocks = jnp.take_along_axis(
+            cache["table"], jnp.minimum(pos // page,
+                                        cache["table"].shape[1] - 1), axis=1)
+        blk_w = jnp.where(
+            active[:, None] & (pos < cfg.max_seq), blocks, nb)
+        off = pos % page
+        scatter_idx = (blk_w, off)
+    else:
+        scatter_idx = (rows, pos_w)
+
     def write_kv(l, kv, k, v):
-        # k, v: [B, T, H, Dh]; scatter row i at (l, slot, len[slot]+i)
+        # k, v: [B, T, H, Dh]; scatter row i at the slot's position
+        # len[slot]+i — dense: (l, slot, pos); paged: (l, block, offset)
+        i0, i1 = scatter_idx
         out = dict(kv)
         if "k_scale" in kv:
             kq, ksc = quantize_kv(k)
             vq, vsc = quantize_kv(v)
-            out["k"] = kv["k"].at[l, rows, pos_w].set(kq, mode="drop")
-            out["v"] = kv["v"].at[l, rows, pos_w].set(vq, mode="drop")
-            out["k_scale"] = kv["k_scale"].at[l, rows, pos_w].set(ksc, mode="drop")
-            out["v_scale"] = kv["v_scale"].at[l, rows, pos_w].set(vsc, mode="drop")
+            out["k"] = kv["k"].at[l, i0, i1].set(kq, mode="drop")
+            out["v"] = kv["v"].at[l, i0, i1].set(vq, mode="drop")
+            out["k_scale"] = kv["k_scale"].at[l, i0, i1].set(ksc, mode="drop")
+            out["v_scale"] = kv["v_scale"].at[l, i0, i1].set(vsc, mode="drop")
             return out
-        out["k"] = kv["k"].at[l, rows, pos_w].set(k, mode="drop")
-        out["v"] = kv["v"].at[l, rows, pos_w].set(v, mode="drop")
+        out["k"] = kv["k"].at[l, i0, i1].set(k, mode="drop")
+        out["v"] = kv["v"].at[l, i0, i1].set(v, mode="drop")
         return out
 
     logits, new_kv = spec_verify_loop(
@@ -329,6 +481,7 @@ def chunked_prefill_into_slot(
     kv_bucket: int = 0,
     ffn_fn=None,
     unroll: bool = False,
+    block_ids: Optional[jax.Array] = None,
 ) -> tuple[jax.Array, dict[str, jax.Array]]:
     """One [1, C] prompt chunk written into *slot* at positions
     offset..offset+C-1: prefill as a sequence of fixed-size chunk forwards
@@ -354,19 +507,42 @@ def chunked_prefill_into_slot(
     chunks of a long-context model never stream the whole empty cache.
     Returns (logits [1, C, vocab], updated pool cache); only the last
     chunk's logits (at the prompt's final position) are consumed.
+
+    ``block_ids`` ([Wp] int32, Wp = bucket // page) switches to the PAGED
+    pool: the slot's window pages are gathered from the block pool into the
+    same dense [L, 1, bucket] view, the trunk runs unchanged, and the whole
+    window scatters back to those blocks afterwards. The engine passes the
+    slot's mapped blocks padded with the null block 0 — padding writes land
+    on the always-masked null block, so the scatter needs no drop mask. Passing
+    block_ids EXPLICITLY (instead of reading cache["table"][slot]) is what
+    lets register_prefix prefill a prefix into freshly allocated pool
+    blocks with NO slot and NO table row — the zero-copy sharing source.
+    ``slot`` may then be out of range (the engine passes the slot count as
+    a sentinel): the final length write uses mode="drop", so a prefix
+    build never touches any live slot's length.
     """
     c = chunk.shape[1]
     bucket = kv_bucket or cfg.max_seq
     quant = kv_quantized(cfg)
     kv_keys = ("k", "v", "k_scale", "v_scale") if quant else ("k", "v")
-    view = {
-        key: jax.lax.dynamic_slice(
-            cache[key],
-            (0, slot) + (0,) * (cache[key].ndim - 2),
-            (cache[key].shape[0], 1, bucket) + cache[key].shape[3:],
-        )
-        for key in kv_keys
-    }
+    if block_ids is not None:
+        page = cache["k"].shape[2]
+        wp = bucket // page
+        view = {}
+        for key in kv_keys:
+            pool = cache[key]  # [L, n_blocks, page, ...]
+            g = pool[:, block_ids]  # [L, Wp, page, ...]
+            view[key] = g.reshape(
+                (pool.shape[0], 1, wp * page) + pool.shape[3:])
+    else:
+        view = {
+            key: jax.lax.dynamic_slice(
+                cache[key],
+                (0, slot) + (0,) * (cache[key].ndim - 2),
+                (cache[key].shape[0], 1, bucket) + cache[key].shape[3:],
+            )
+            for key in kv_keys
+        }
     view["len"] = jnp.full((1,), offset, jnp.int32)
 
     def write_kv(l, kv, k, v):
@@ -390,6 +566,33 @@ def chunked_prefill_into_slot(
         unroll=unroll,
     )
     out = dict(cache)
+    if block_ids is not None:
+        # Scatter back ONLY the page span [offset, offset + c) can have
+        # touched — ceil(c/page)+1 pages (the +1 absorbs an unaligned
+        # offset straddling a boundary), a STATIC count, sliced at the
+        # dynamic start page. The start is clamped so the value slice and
+        # the block-id slice stay aligned; a clamp only shifts the span
+        # to cover extra ALREADY-CURRENT pages, and rewriting a page with
+        # the view's own content is a value-level no-op (single-writer
+        # loop thread). This keeps a chunk's pool write traffic O(chunk),
+        # not O(window) — the bound the prefill budget is denominated in.
+        page = cache[kv_keys[0]].shape[2]
+        wp = bucket // page
+        span = min(-(-c // page) + 1, wp)
+        p0 = jnp.minimum(offset // page, wp - span)
+        ids_w = jax.lax.dynamic_slice(block_ids, (p0,), (span,))
+        for key in kv_keys:
+            pool = cache[key]
+            pages = new_view[key].reshape(
+                (pool.shape[0], wp, page) + pool.shape[3:])
+            written = jax.lax.dynamic_slice(
+                pages, (0, p0) + (0,) * (pages.ndim - 2),
+                (pool.shape[0], span) + pages.shape[2:])
+            out[key] = pool.at[:, ids_w].set(written)
+        # slot may be the engine's out-of-range sentinel (prefix build):
+        # drop the length write rather than clamp-corrupt the last slot
+        out["len"] = cache["len"].at[slot].set(new_len, mode="drop")
+        return logits, out
     for key in kv_keys:
         shape = new_view[key].shape  # [L, 1, S, H(, Dh)]
         sizes = (shape[0], 1, c) + shape[3:]
@@ -399,6 +602,40 @@ def chunked_prefill_into_slot(
             cache[key], written, (0, slot, offset) + (0,) * (len(shape) - 3))
     out["len"] = cache["len"].at[slot].set(new_len)
     return logits, out
+
+
+def _scatter_prefill_pages(
+    cache: dict[str, jax.Array],
+    seq_cache: dict[str, jax.Array],
+    logits: jax.Array,
+    slots: jax.Array,
+    true_lens: jax.Array,
+    s: int,
+) -> tuple[jax.Array, dict[str, jax.Array]]:
+    """Install N freshly-prefilled rows into a PAGED pool: the dense
+    [L, N, s, ...] per-row KV reshapes to page granularity and scatters
+    into each row's mapped blocks (cache["table"][slots], set by the
+    engine's reservation BEFORE the admission dispatch). Unmapped window
+    entries are the null block 0 — pad pages beyond a short reservation
+    land there, invisible under the length masks. Returns the last-
+    position logits [N, vocab] and the updated pool (len = true_lens)."""
+    page = cache["k"].shape[2]
+    wp = s // page
+    blk = cache["table"][slots, :wp]  # [N, Wp]
+    new_cache = dict(cache)
+    for key in ("k", "v", "k_scale", "v_scale"):
+        if key not in cache:
+            continue
+        pool = cache[key]
+        pages = seq_cache[key][:, :, :s].reshape(
+            (pool.shape[0], slots.shape[0], wp, page) + pool.shape[3:])
+        new_cache[key] = pool.at[:, blk].set(pages)
+    new_cache["len"] = cache["len"].at[slots].set(true_lens)
+    if logits.ndim == 2:
+        last = logits  # prefill_fn already gathered the final positions
+    else:
+        last = logits[jnp.arange(slots.shape[0]), true_lens - 1]
+    return last, new_cache
 
 
 def pad_to_chunks(tokens: jax.Array, n: int, c: int) -> jax.Array:
@@ -452,6 +689,11 @@ def prefill_into_slot(
     # (int8 caches carry k_scale/v_scale alongside; copied the same way)
     s = tokens.shape[1]
     new_cache = dict(cache)
+    if "table" in cache:
+        last, new_cache = _scatter_prefill_pages(
+            cache, seq_cache, logits, jnp.asarray(slot)[None],
+            jnp.asarray(true_len)[None], s)
+        return last[0], new_cache
     for key in ("k", "v", "k_scale", "v_scale"):
         if key in cache:
             new_cache[key] = cache[key].at[:, slot, :s].set(seq_cache[key][:, 0, :s])
@@ -485,6 +727,9 @@ def prefill_into_slots(
     """
     logits, seq_cache = (prefill_fn or prefill)(params, cfg, tokens)
     s = tokens.shape[1]
+    if "table" in cache:
+        return _scatter_prefill_pages(
+            cache, seq_cache, logits, slots, true_lens, s)
     new_cache = dict(cache)
     for key in ("k", "v", "k_scale", "v_scale"):
         if key in cache:
@@ -534,7 +779,9 @@ class ServingEngine:
                 # regression corner (see choose_kv_int8)
                 cfg = dataclasses.replace(
                     cfg, kv_int8=choose_kv_int8(serving.slots, cfg.max_seq))
-            model = TransformerSlotModel(params, cfg, mesh=mesh)
+            model = TransformerSlotModel(
+                params, cfg, mesh=mesh, kv_page=serving.kv_page,
+                kv_pool_blocks=serving.kv_pool_blocks)
         self.model = model
         self.params = model.params
         self.cfg = getattr(model, "cfg", cfg)
@@ -554,6 +801,17 @@ class ServingEngine:
         )
         self.sample = sample or (lambda logits: int(jnp.argmax(logits)))
         b = serving.slots
+        # paged KV pool: page size comes from the MODEL adapter (the single
+        # source of truth — the engine constructs the default adapter from
+        # ServingConfig.kv_page above; an explicitly passed model must have
+        # been built paged itself)
+        self._page = getattr(model, "kv_page", None)
+        if serving.kv_page is not None and self._page != serving.kv_page:
+            raise ValueError(
+                f"ServingConfig.kv_page={serving.kv_page} but the provided "
+                f"model adapter was built with kv_page={self._page}; pass "
+                "kv_page/kv_pool_blocks to the adapter (or just params+cfg)")
+        self._paged = self._page is not None
         self.state = model.init_state(b)
         # Device-side sampling is the default: the sampler is fused into the
         # jitted decode step (adapters.sampled_decode_step), so a tick's
@@ -733,6 +991,56 @@ class ServingEngine:
                     f"admission unit {floor} (largest bucket"
                     + (f" / prefill chunk {self._chunk}" if self._chunk else "")
                     + ")")
+        # --- paged pool bookkeeping (host side of the block pool) --------
+        if self._paged:
+            page = self._page
+            for bkt in self._prefill_buckets:
+                if bkt % page:
+                    raise ValueError(
+                        f"kv_page {page} must divide every prefill bucket "
+                        f"(got {bkt}): admission scatters and decode read "
+                        "windows are page-granular")
+            # total blocks INCLUDING the reserved null block 0, resolved by
+            # the adapter when it allocated the pool state
+            self._n_blocks = model.n_kv_blocks
+            self._max_pages = ctx // page
+            self._alloc = BlockAllocator(self._n_blocks)
+            # blocks currently mapped by each slot's table row (shared
+            # prefix blocks included — release() decrefs, so a shared
+            # block survives until its last mapping retires)
+            self._slot_blocks: list[list[int]] = [[] for _ in range(b)]
+            # one fused device op per admission: table row + base length
+            # (prefix installs set len=base here so an empty-suffix
+            # admission needs no separate device write). Compiled AT INIT
+            # on this thread — never first-use inside the loop.
+            self._set_table_row = jax.jit(
+                lambda state, slot, row, base: {
+                    **state,
+                    "table": state["table"].at[slot].set(row),
+                    "len": state["len"].at[slot].set(base),
+                }, donate_argnums=(0,))
+            # copy-on-write for a prefix's partial boundary block: one
+            # [L, page, ...] block copy per plane, src -> dst
+            planes = tuple(
+                key for key in ("k", "v", "k_scale", "v_scale")
+                if key in self.state)
+
+            def copy_block(state, src, dst):
+                out = dict(state)
+                for key in planes:
+                    out[key] = state[key].at[:, dst].set(state[key][:, src])
+                return out
+
+            self._copy_block = jax.jit(copy_block, donate_argnums=(0,))
+            # prefix builds run ON THE LOOP THREAD (they prefill into pool
+            # blocks, mutating the shared device state a caller thread
+            # must never race): register_prefix parks a work item here and
+            # blocks on its event; _tick_head drains it between ticks
+            self._prefix_work: "queue.Queue[dict]" = queue.Queue()
+        else:
+            self._alloc = None
+            self._slot_blocks = [[] for _ in range(b)]
+            self._prefix_work = None
         self._pending: "queue.Queue[Request]" = queue.Queue()
         # requests pulled off the queue but not yet admitted (budget-
         # deferred or waiting for a free slot); FIFO except that same-bucket
@@ -785,7 +1093,30 @@ class ServingEngine:
                        # of batch size n (index 0 unused)
                        "prefill_batch_hist": [0] * (max(
                            self._admit_sizes) + 1),
-                       "pipelined_ticks": 0}
+                       "pipelined_ticks": 0,
+                       # KV-memory data plane. kv_bucket_hist: read-window
+                       # bucket -> dispatched ticks — on the DENSE path
+                       # this is the global longest-live-sequence read tax
+                       # made visible (one long sequence drags every
+                       # slot's window up). pool_blocked_admissions:
+                       # admissions deferred by pool exhaustion
+                       # (backpressure events, not failures).
+                       # prefix_install_copies: dense full-prefix device
+                       # copies at admission; prefix_blocks_shared:
+                       # pool blocks mapped read-only at admission
+                       # (zero-copy reuse); prefix_cow_copies: partial
+                       # boundary blocks copied on write. read_pages_*:
+                       # per-tick gathered LIVE pages vs window pages —
+                       # the paged read's per-slot padding dedupes onto
+                       # the null block, so live/window is the fraction
+                       # of the window streaming distinct HBM lines.
+                       "kv_bucket_hist": {},
+                       "pool_blocked_admissions": 0,
+                       "prefix_install_copies": 0,
+                       "prefix_blocks_shared": 0,
+                       "prefix_cow_copies": 0,
+                       "read_pages_live": 0, "read_pages_window": 0,
+                       "read_pages_hist": {}}
         # EMA of host bookkeeping ms per delivered tick (the Python work the
         # pipelined loop hides under the next dispatch)
         self._host_ms_ema: Optional[float] = None
@@ -841,6 +1172,40 @@ class ServingEngine:
             raise ValueError(f"prefix length {n} leaves no room for a suffix")
         padded = pad_to_chunks(tokens, n, c)
         pad = padded.shape[1]
+        if self._paged:
+            # Paged: the prefix prefills into POOL BLOCKS once — the
+            # registration is the only time its KV is ever computed or
+            # copied; admissions then map the blocks read-only into slot
+            # tables. The build mutates the shared pool state, so it runs
+            # on the serving-loop thread (a work item drained by
+            # _tick_head); before start() it runs inline — no loop to race.
+            if self._thread is not None and self._thread.is_alive():
+                item: dict = {"tokens": tokens, "padded": padded, "n": n,
+                              "pad": pad, "done": threading.Event(),
+                              "entry": None, "error": None}
+                self._prefix_work.put(item)
+                while not item["done"].wait(0.1):
+                    if self._stop.is_set() or not self._thread.is_alive():
+                        # flag first: if the loop still builds this item,
+                        # _drain_prefix_work releases its blocks instead of
+                        # leaking an entry no one will ever store; if the
+                        # build finished in this instant, release it here
+                        item["abandoned"] = True
+                        if item["done"].is_set() and item["entry"] is not None:
+                            self._alloc.release(item["entry"]["blocks"])
+                            item["entry"] = None
+                        raise RuntimeError(
+                            "engine stopped during register_prefix")
+                if item["error"] is not None:
+                    raise item["error"]
+                entry = item["entry"]
+            else:
+                entry = self._build_prefix_paged(tokens, padded, n, pad)
+            with self._prefix_lock:
+                pid = self._next_prefix_id
+                self._next_prefix_id += 1
+                self._prefixes[pid] = entry
+            return pid
         scratch = self.model.init_state(1)
         for i in range(pad // c):
             off = i * c
@@ -867,6 +1232,74 @@ class ServingEngine:
             }
         return pid
 
+    def _build_prefix_paged(self, tokens, padded, n: int, pad: int) -> dict:
+        """Chunk-prefill a prefix into freshly allocated pool blocks (the
+        once-per-prefix compute + write; admissions map, never copy). Runs
+        on whichever thread owns the pool state right now — the serving
+        loop via the _prefix_work queue, or the caller before start()."""
+        page, c = self._page, self._chunk
+        pages = -(-pad // page)
+        blocks = self._alloc.alloc(pages)
+        if blocks is None:
+            # registration is an admin op: fail loudly rather than park —
+            # parking a prefix build behind tenant traffic would deadlock
+            # a caller holding requests that reference the new id
+            raise RuntimeError(
+                f"kv pool exhausted: prefix needs {pages} blocks, "
+                f"{self._alloc.free_blocks} free")
+        ctx = self.model.max_context
+        logits = None
+        try:
+            for i in range(pad // c):
+                off = i * c
+                kv_bucket = next(
+                    (bkt for bkt in self._kv_buckets if bkt >= off + c), ctx)
+                wp = kv_bucket // page
+                row = np.zeros((wp,), np.int32)
+                m = min(pages, wp)
+                row[:m] = blocks[:m]
+                # slot = the slot count: out of range, so the helper's
+                # length write DROPS — a prefix build must never touch
+                # live slot state
+                logits, self.state = self._prefill_chunk(
+                    self.params, self.state, padded[:, off:off + c],
+                    jnp.int32(self.serving.slots), jnp.int32(off),
+                    jnp.int32(min(off + c, n)),
+                    kv_bucket=kv_bucket, unroll=self._unroll, block_ids=row,
+                )
+        except Exception:
+            # a failed build must not bleed the pool: no registry entry
+            # will ever reference these blocks, so release them here
+            self._alloc.release(blocks)
+            raise
+        last_logits = logits[0, (n - 1) - (pad - c)]
+        return {"tokens": [int(x) for x in tokens.tolist()],
+                "blocks": blocks, "len": n, "pad": pad,
+                "last_logits": last_logits}
+
+    def _drain_prefix_work(self) -> None:
+        """Execute queued paged prefix builds on the loop thread (the pool
+        state's owner). Bounded work: registrations are rare admin ops —
+        one whole prefix builds per item, stalling live streams for its
+        ceil(pad/C) chunks, which is the explicit price of keeping the
+        pool single-writer (admission-path sharing pays zero)."""
+        while True:
+            try:
+                item = self._prefix_work.get_nowait()
+            except queue.Empty:
+                return
+            try:
+                item["entry"] = self._build_prefix_paged(
+                    item["tokens"], item["padded"], item["n"], item["pad"])
+            except Exception as exc:  # surfaced on the caller's thread
+                item["error"] = exc
+            if item.get("abandoned") and item["entry"] is not None:
+                # the registering caller gave up (engine stopping) — no
+                # one will store this entry, so its blocks go straight back
+                self._alloc.release(item["entry"]["blocks"])
+                item["entry"] = None
+            item["done"].set()
+
     def unregister_prefix(self, pid: int) -> None:
         """Drop a registered prefix, releasing its pinned device KV buffers
         ([L,1,pad,H,Dh] per plane). Long-lived engines serving rotating
@@ -877,8 +1310,16 @@ class ServingEngine:
         against *pid* but not yet admitted when this runs retires with an
         end-of-stream instead of killing the serving loop."""
         with self._prefix_lock:
-            if self._prefixes.pop(pid, None) is None:
+            entry = self._prefixes.pop(pid, None)
+            if entry is None:
                 raise ValueError(f"unknown prefix id {pid}")
+            if self._paged:
+                # drop the registry's refcount hold; blocks mapped
+                # read-only into live slots survive until those slots
+                # retire (the allocator frees at refcount zero, never
+                # before). UNDER the lock: _reserve_paged's get+share on
+                # the loop thread must never interleave with this release.
+                self._alloc.release(entry["blocks"])
 
     def _compile_install(self, pad: int, buffers: dict) -> None:
         """AOT-compile the per-padded-length install executable HERE, on the
@@ -928,6 +1369,33 @@ class ServingEngine:
         # validate HERE, on the caller's thread: an oversized prompt must
         # raise to its submitter, not kill the serving loop (which would
         # hang every other client forever)
+        if self._paged:
+            # a request whose WORST-CASE private pages exceed the whole
+            # pool can never admit — backpressure would park it (and, at
+            # the head of the line, everything behind it) forever
+            page = self._page
+            base, pinned = 0, 0
+            if prefix is not None:
+                ent = self._prefixes.get(prefix)
+                if ent is not None:
+                    base = ent["len"]
+                    # while this request waits, ITS prefix must stay
+                    # registered (or the request retires unserved), so the
+                    # registry's hold on the prefix blocks can never free —
+                    # those pages are structurally unavailable to it
+                    pinned = -(-ent["pad"] // page)
+            total = base + int(tokens.shape[0])
+            budget = max_new_tokens or self.serving.max_new_tokens
+            ctx = self.model.max_context
+            if ctx:
+                budget = min(budget, max(ctx - total, 0))
+            need = -(-max(total + budget, 1) // page) - base // page
+            if need > self._n_blocks - 1 - pinned:
+                raise ValueError(
+                    f"request needs {need} private KV blocks at worst case "
+                    f"but the pool only has {self._n_blocks - 1}"
+                    + (f" ({pinned} pinned by its prefix)" if pinned else "")
+                    + "; raise kv_pool_blocks or lower max_new_tokens")
         if prefix is not None:
             entry = self._prefixes.get(prefix)
             if entry is None:
@@ -975,9 +1443,20 @@ class ServingEngine:
         observe the None sentinel, not hang on a dead engine."""
         for slot in range(len(self._slot_req)):
             self._retire(slot)
-        for adm in self._admitting.values():
+        for slot, adm in self._admitting.items():
             adm["req"].out.put(None)
+            self._free_slot_blocks(slot)
         self._admitting.clear()
+        if self._paged:
+            # callers blocked in register_prefix must observe an error,
+            # not hang on a loop that will never drain their work item
+            while True:
+                try:
+                    item = self._prefix_work.get_nowait()
+                except queue.Empty:
+                    break
+                item["error"] = RuntimeError("engine stopped")
+                item["done"].set()
         for req in self._waiting:
             req.out.put(None)
         self._waiting.clear()
@@ -1007,6 +1486,77 @@ class ServingEngine:
                if self._chunk else "")
         )
 
+    def _free_slot_blocks(self, slot: int) -> None:
+        """Return a slot's mapped blocks to the allocator (refcount
+        decrement — shared prefix blocks only free once every mapping and
+        the registry itself have let go)."""
+        if self._paged and self._slot_blocks[slot]:
+            self._alloc.release(self._slot_blocks[slot])
+            self._slot_blocks[slot] = []
+
+    def _reserve_paged(self, slot: int, req: Request) -> bool:
+        """Pool-aware admission: map every page this request can ever touch
+        — prompt + ITS token budget, not max_seq — and set the slot's
+        device table row (plus base length) in one fused op. A prefix-
+        backed request maps the prefix's full blocks READ-ONLY (share():
+        zero device copies) and pays one block copy only for a partial
+        boundary block, which upcoming suffix/decode writes would otherwise
+        scribble into memory other slots are reading. Returns False with
+        nothing reserved when the free list can't cover the private pages:
+        the caller leaves the request parked on the waiting list, and a
+        later retire's release() unblocks it — backpressure, never OOM."""
+        if req.prefix is not None:
+            # the lookup, the share() of the prefix's full blocks, and the
+            # COW-source read below must be ATOMIC against a caller-thread
+            # unregister_prefix (whose release also runs under this lock):
+            # a release landing between get() and share() would hand the
+            # blocks back to the free list — share() would then revive a
+            # dead block, or a concurrent admission's alloc could double-
+            # map it into another slot's table
+            with self._prefix_lock:
+                entry = self._prefixes.get(req.prefix)
+                if entry is None:
+                    return True  # unregistered: _admit retires it, no pages
+                return self._reserve_paged_locked(slot, req, entry)
+        return self._reserve_paged_locked(slot, req, None)
+
+    def _reserve_paged_locked(self, slot: int, req: Request,
+                              entry: Optional[dict]) -> bool:
+        page = self._page
+        n = int(req.tokens.shape[0])
+        base = entry["len"] if entry is not None else 0
+        ctx = self.model.max_context
+        total = base + n
+        budget = req.max_new_tokens or self.serving.max_new_tokens
+        if ctx:
+            budget = min(budget, ctx - total)
+        reserve = -(-max(total + max(budget, 0), 1) // page)
+        full = base // page  # whole prefix pages, shareable as-is
+        shared = entry["blocks"][:full] if entry is not None else []
+        need_priv = reserve - full
+        priv = self._alloc.alloc(need_priv) if need_priv > 0 else []
+        if priv is None:
+            self._stats["pool_blocked_admissions"] += 1
+            return False
+        if shared:
+            self._alloc.share(shared)
+            self._stats["prefix_blocks_shared"] += len(shared)
+        row_blocks = list(shared) + priv
+        if base % page:
+            # copy-on-write: logical page `full` starts as a copy of the
+            # prefix's partial boundary block (priv[0] sits at exactly
+            # that table index)
+            self.state = self._copy_block(
+                self.state, jnp.int32(entry["blocks"][full]),
+                jnp.int32(priv[0]))
+            self._stats["prefix_cow_copies"] += 1
+        self._slot_blocks[slot] = row_blocks
+        trow = np.zeros((self._max_pages,), np.int32)
+        trow[:len(row_blocks)] = row_blocks
+        self.state = self._set_table_row(
+            self.state, jnp.int32(slot), trow, jnp.int32(base))
+        return True
+
     def _admit(self, slot: int, req: Request) -> None:
         """Admit ONE request into *slot*. Prefix-cached and chunked prompts
         route the same way in both admission modes (install/park); a
@@ -1019,12 +1569,22 @@ class ServingEngine:
             entry = self._prefixes.get(req.prefix)
             if entry is None:
                 # unregister_prefix raced with this submit: fail just this
-                # request (end-of-stream), never the loop serving everyone
+                # request (end-of-stream), never the loop serving everyone.
+                # Pages reserved for it (the unregister may have landed
+                # between reservation and here) go straight back.
                 log.warning("request references unregistered prefix %s; "
                             "retiring it unserved", req.prefix)
+                self._free_slot_blocks(slot)
                 req.out.put(None)
                 return
-            self._install_prefix(slot, entry)
+            if self._paged:
+                # zero-copy: _reserve_paged already mapped the prefix's
+                # blocks into this slot's table (and COW'd the boundary);
+                # there is no install copy to perform
+                pass
+            else:
+                self._install_prefix(slot, entry)
+                self._stats["prefix_install_copies"] += 1
             base = entry["len"]
             if n == 0:
                 # no suffix: the first token comes straight from the
@@ -1148,6 +1708,8 @@ class ServingEngine:
             if head.prefix is not None or self._bucket(n_head) is None:
                 # chunked routes park and pay their prompt tokens from the
                 # budget as their chunks advance (see _advance_admissions)
+                if self._paged and not self._reserve_paged(free[0], head):
+                    break  # pool exhausted: head parks (backpressure)
                 self._waiting.pop(0)
                 self._admit(free.pop(0), head)
                 admitted = True
@@ -1156,6 +1718,8 @@ class ServingEngine:
             if not self._async_admission:
                 if bucket > budget:
                     break
+                if self._paged and not self._reserve_paged(free[0], head):
+                    break  # pool exhausted: head parks (backpressure)
                 self._waiting.pop(0)
                 self._admit(free.pop(0), head)
                 budget -= bucket
@@ -1178,11 +1742,28 @@ class ServingEngine:
                 break  # budget exhausted for the head-of-line bucket
             n = max(fit)
             batch = group[:n]
+            if self._paged:
+                # pool-aware batch: reserve per member in FIFO order; the
+                # first member the free list can't cover truncates the
+                # batch (nothing younger jumps it — same head-of-line
+                # discipline as the budget), shrunk to a WARMED size with
+                # the overshoot's reservations rolled back
+                ok = 0
+                for j, req in enumerate(batch):
+                    if not self._reserve_paged(free[j], req):
+                        break
+                    ok += 1
+                if ok == 0:
+                    break  # head blocked on pool: stays parked in waiting
+                m = max(s for s in self._admit_sizes if s <= ok)
+                for j in range(m, ok):
+                    self._free_slot_blocks(free[j])
+                batch = batch[:m]
             for req in batch:
                 self._waiting.remove(req)
             slots = [free.pop(0) for _ in batch]
             self._admit_batch(slots, batch, bucket)
-            budget -= n * bucket
+            budget -= len(batch) * bucket
             admitted = True
         return admitted, budget
 
@@ -1202,6 +1783,7 @@ class ServingEngine:
             req, n, off, base = adm["req"], adm["n"], adm["off"], adm["base"]
             if req.cancelled:
                 del self._admitting[slot]
+                self._free_slot_blocks(slot)
                 req.out.put(None)
                 continue
             c = self._chunk
@@ -1214,11 +1796,21 @@ class ServingEngine:
                 (bkt for bkt in self._kv_buckets if bkt >= need),
                 self.model.max_context,
             )
+            extra = {}
+            if self._paged:
+                # the slot's mapped blocks, window-sized and null-padded:
+                # chunk gathers/scatters are page-granular over the pool
+                wp = kv_bucket // self._page
+                row = np.zeros((wp,), np.int32)
+                blocks = self._slot_blocks[slot]
+                m = min(len(blocks), wp)
+                row[:m] = blocks[:m]
+                extra["block_ids"] = row
             logits, self.state = self._prefill_chunk(
                 self.params, self.state, adm["padded"][:, off:off + c],
                 jnp.int32(slot), jnp.int32(base + off),
                 jnp.int32(min(base + off + c, n)),
-                kv_bucket=kv_bucket, unroll=self._unroll,
+                kv_bucket=kv_bucket, unroll=self._unroll, **extra,
             )
             adm["off"] = off + c
             budget -= c
@@ -1280,6 +1872,26 @@ class ServingEngine:
         self._admission_ms_ema = (
             ms if self._admission_ms_ema is None
             else 0.9 * self._admission_ms_ema + 0.1 * ms)
+
+    def _note_kv_window(self, kv_bucket: int, lens: list[int]) -> None:
+        """Per-dispatch read-window telemetry. kv_bucket_hist surfaces the
+        global read tax: every dispatched tick's window, set by the LONGEST
+        live sequence — on the dense path that window is streamed verbatim
+        for every slot. ``lens`` carries each dispatched slot's device-side
+        length THIS tick will read up to (exclusive of the +1 applied
+        here); under paging the live-page counters quantify how much of
+        the window each slot actually maps (the rest dedupes onto the null
+        block instead of streaming distinct lines)."""
+        hist = self._stats["kv_bucket_hist"]
+        key = int(kv_bucket) or int(self.model.max_context or 0)
+        hist[key] = hist.get(key, 0) + 1
+        if self._paged and lens:
+            page = self._page
+            live = sum(-(-(ln + 1) // page) for ln in lens)
+            self._stats["read_pages_live"] += live
+            self._stats["read_pages_window"] += (key // page) * len(lens)
+            rh = self._stats["read_pages_hist"]
+            rh[live] = rh.get(live, 0) + 1
 
     def _note_itl(self, slot: int, now: float) -> None:
         """Record one inter-token gap for *slot* (first token after
@@ -1440,6 +2052,8 @@ class ServingEngine:
         s = dict(self._stats)
         s["spec_emitted_hist"] = list(s["spec_emitted_hist"])
         s["prefill_batch_hist"] = list(s["prefill_batch_hist"])
+        s["kv_bucket_hist"] = dict(s["kv_bucket_hist"])
+        s["read_pages_hist"] = dict(s["read_pages_hist"])
         s["mean_emitted_per_spec_tick"] = round(
             s["spec_emitted"] / s["spec_slot_ticks"], 3
         ) if s["spec_slot_ticks"] else None
@@ -1480,6 +2094,41 @@ class ServingEngine:
         s["device_sampling"] = self._device_sampling
         s["pipelined"] = self._pipeline
         s["batched_admission"] = self._async_admission
+        # KV-memory data plane: what sequence memory actually costs. The
+        # dense estimate is the worst-case pin (slots * max_seq — what the
+        # classic ring allocates no matter the traffic); the paged figure
+        # is the pool's real footprint. Their ratio at equal slot count is
+        # the oversubscription headroom the driver artifacts audit.
+        s["paged"] = self._paged
+        s["kv_page"] = self._page
+        cfg = self.cfg
+        # SSM configs have no attention geometry (no KV cache to estimate)
+        bpt = (kv_bytes_per_token(cfg)
+               if cfg is not None and hasattr(cfg, "head_dim") else None)
+        ctx = self.model.max_context
+        s["kv_hbm_bytes"] = {
+            "dense": (self.serving.slots * ctx * bpt
+                      if bpt and ctx else None),
+            "paged": (self._n_blocks * self._page * bpt
+                      if self._paged and bpt else None),
+        }
+        if self._paged:
+            usable = self._n_blocks - 1  # minus the reserved null block
+            free = self._alloc.free_blocks
+            s["kv_pool_blocks"] = usable
+            s["kv_pool_free"] = free
+            s["kv_pool_used"] = usable - free
+            s["kv_pool_occupancy"] = round(
+                (usable - free) / usable, 4) if usable else None
+            s["read_pages_ratio"] = (
+                round(s["read_pages_live"] / s["read_pages_window"], 4)
+                if s["read_pages_window"] else None)
+        else:
+            s["kv_pool_blocks"] = None
+            s["kv_pool_free"] = None
+            s["kv_pool_used"] = None
+            s["kv_pool_occupancy"] = None
+            s["read_pages_ratio"] = None
         return s
 
     def _retire(self, slot: int) -> None:
@@ -1492,6 +2141,11 @@ class ServingEngine:
         self._history[slot] = []
         self._itl_last[slot] = None
         self._admit_mask[slot] = False
+        # paged: the slot's pages go back to the pool — this release is
+        # what un-parks a pool-blocked admission on the next tick. The
+        # device table row stays stale (inactive reads are masked, writes
+        # drop) and is overwritten wholesale at the next reservation.
+        self._free_slot_blocks(slot)
 
     def _warm_executables(self) -> None:
         """Compile every decode and prefill bucket before serving: a
@@ -1576,12 +2230,26 @@ class ServingEngine:
             # unaligned offsets (need = base + off + C), so needs are not
             # just multiples of C
             for bkt in [x for x in self._kv_buckets if x >= self._chunk]:
+                extra = (
+                    {"block_ids": np.zeros((bkt // self._page,), np.int32)}
+                    if self._paged else {})
                 _, self.state = self._prefill_chunk(
                     self.params, self.state,
                     jnp.zeros((1, self._chunk), jnp.int32),
                     jnp.int32(0), jnp.int32(0), jnp.int32(1),
-                    kv_bucket=bkt, unroll=self._unroll,
+                    kv_bucket=bkt, unroll=self._unroll, **extra,
                 )
+        if self._paged:
+            # the per-admission table-row install and the boundary-block
+            # COW copy: trivial ops, but their first-use compile must not
+            # land inside the loop (the _warm_executables invariant). The
+            # table-row warm doubles as cleanup: slot 0's warm-time junk
+            # length resets to 0.
+            self.state = self._set_table_row(
+                self.state, jnp.int32(0),
+                np.zeros((self._max_pages,), np.int32), jnp.int32(0))
+            self.state = self._copy_block(
+                self.state, jnp.int32(0), jnp.int32(0))
 
     def _loop(self) -> None:
         try:
@@ -1608,6 +2276,8 @@ class ServingEngine:
         first: finishing an admission frees its head-of-line latency and
         its budget claim. Returns whether any admission happened."""
         t0 = time.perf_counter()
+        if self._paged:
+            self._drain_prefix_work()
         while True:
             try:
                 self._waiting.append(self._pending.get_nowait())
@@ -1745,6 +2415,10 @@ class ServingEngine:
                     )
                 else:
                     kv_bucket = 0
+                self._note_kv_window(
+                    kv_bucket,
+                    [self._slot_len[i] + (1 if fed[i] else 0)
+                     for i in dispatch])
                 tok_d, lp_d, self.state, self._rng = self._decode_sampled(
                     self.params, self.state, tokens, active, self._rng,
                     kv_bucket, unroll=self._unroll,
@@ -1835,6 +2509,9 @@ class ServingEngine:
                 )
             else:
                 kv_bucket = 0
+            self._note_kv_window(
+                kv_bucket,
+                [self._slot_len[i] + chunk - 1 for i in active_slots])
             if drafts is not None:
                 draft = jnp.asarray(
                     [
