@@ -287,10 +287,13 @@ def tenant_main(a: argparse.Namespace) -> None:
                 # KV-memory data plane: the per-tick read-window histogram
                 # (the dense path's global longest-sequence read tax made
                 # visible), the dense-vs-paged HBM estimate whose ratio is
-                # the oversubscription headroom, and — when paging is on —
-                # pool occupancy, blocked-on-pool admissions, and the
-                # zero-copy prefix counters
-                "kv_bucket_hist", "kv_hbm_bytes", "paged",
+                # the oversubscription headroom — PER CHIP under a tp mesh
+                # (kv_hbm_bytes_per_chip is the figure that maps onto the
+                # per-container TPU_DEVICE_MEMORY_LIMIT_<i> cap) — and,
+                # when paging is on, pool occupancy, blocked-on-pool
+                # admissions, and the zero-copy prefix counters
+                "kv_bucket_hist", "kv_hbm_bytes", "kv_hbm_bytes_per_chip",
+                "tp", "paged",
                 "kv_pool_occupancy", "pool_blocked_admissions",
                 "prefix_blocks_shared", "prefix_install_copies")},
         }), flush=True)

@@ -16,26 +16,39 @@ the concurrency it affords under the shared budget) differs:
 
 Headline: aggregate tokens/sec ratio over a fixed request trace.
 
+``--tp N`` (ISSUE 5 tentpole) runs BOTH arms tensor-parallel on an
+N-virtual-device ('tp',) mesh: weights column/row-sharded, the dense cache
+and the paged block pool head-sharded, page tables replicated.
+--hbm-tokens is then the PER-CHIP budget (what the per-container
+TPU_DEVICE_MEMORY_LIMIT_<i> cap actually bounds) — the head shard divides
+uniformly, so each arm's global capacity is budget * tp and the equal-HBM
+discipline is enforced chip by chip. The headline is dense-TP vs paged-TP
+at equal per-chip HBM; full --tp runs gate >= 2x in the exit code.
+
 A second phase microbenches SHARED-PREFIX admission: both arms register a
 system-prompt prefix and admit M suffix requests against it. The dense path
 device-copies the full prefix KV into the slot per admission
 (prefix_install_copies == M); the paged path maps the prefix's pool blocks
 read-only into each slot's table (install copies == 0, blocks_shared > 0,
-one boundary-block COW per admission when the prefix is page-unaligned).
+one boundary-block COW per admission when the prefix is page-unaligned) —
+under --tp the blocks being shared are the head-sharded pool's.
 
-Usage:  python benchmarks/paged_kv_bench.py [--quick] [--hbm-tokens N]
-            [--page P] [--requests K] [--prompt-len N] [--max-new N] [--out F]
+Usage:  python benchmarks/paged_kv_bench.py [--quick] [--tp N]
+            [--hbm-tokens N] [--page P] [--requests K] [--prompt-len N]
+            [--max-new N] [--out F]
 Emits:  full artifact JSON on stdout line 1, then the compact one-line
         headline summary (metric/value/verdict — the PR-3 driver-artifact
         convention) as the FINAL stdout line; human notes on stderr.
         --out also writes the artifact to a file (default PAGED_KV_r07.json
-        for full runs; quick runs only write when --out is given).
+        for full single-chip runs, PAGED_KV_TP_r08.json for full --tp
+        runs; quick runs only write when --out is given).
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 from pathlib import Path
@@ -47,8 +60,18 @@ def main() -> None:
     ap = argparse.ArgumentParser("paged-kv-bench")
     ap.add_argument("--quick", action="store_true",
                     help="CI mode: lighter trace, same A/B shape")
-    ap.add_argument("--hbm-tokens", type=int, default=512,
-                    help="simulated KV HBM budget, in cached tokens")
+    ap.add_argument("--tp", type=int, default=1,
+                    help="tensor-parallel width: run BOTH arms on a "
+                         "('tp',) mesh of N virtual CPU devices with the "
+                         "KV plane head-sharded; --hbm-tokens becomes the "
+                         "PER-CHIP budget")
+    ap.add_argument("--hbm-tokens", type=int, default=None,
+                    help="simulated KV HBM budget, in cached tokens — "
+                         "PER CHIP when --tp > 1. Default 512 // tp: the "
+                         "same 512-token GLOBAL budget at every tp, split "
+                         "over the head shards, so the tp arms measure "
+                         "'same total HBM, more chips' (per-chip pressure "
+                         "at its highest — the regime paged pays off in)")
     ap.add_argument("--page", type=int, default=16,
                     help="paged arm block size (tokens)")
     ap.add_argument("--max-seq", type=int, default=512,
@@ -69,10 +92,20 @@ def main() -> None:
                     help="artifact path (default PAGED_KV_r07.json on full "
                          "runs; quick runs only write when set)")
     a = ap.parse_args()
+    if a.hbm_tokens is None:
+        a.hbm_tokens = 512 // a.tp
     if a.quick:
         a.requests = min(a.requests, 12)
         a.max_new = min(a.max_new, 24)
         a.prefix_requests = min(a.prefix_requests, 4)
+    if a.tp > 1 and "xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        # the mesh needs tp virtual CPU devices; must be set before jax
+        # imports (argparse runs first precisely so this can work)
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={max(a.tp, 2)}"
+        ).strip()
 
     import jax
     import jax.numpy as jnp
@@ -81,6 +114,16 @@ def main() -> None:
     from vtpu.models.transformer import kv_bytes_per_token
     from vtpu.serving import ServingConfig, ServingEngine
 
+    mesh = None
+    if a.tp > 1:
+        from vtpu.parallel.mesh import make_axis_mesh
+
+        if len(jax.devices()) < a.tp:
+            print(f"need {a.tp} devices, have {len(jax.devices())}",
+                  file=sys.stderr)
+            sys.exit(2)
+        mesh = make_axis_mesh("tp", a.tp)
+
     # Tiny on purpose, and smaller than decode_bench's model: a CPU tick
     # must be dominated by FIXED dispatch overhead, not by compute that
     # scales with batch — that is the regime where concurrency converts to
@@ -88,14 +131,18 @@ def main() -> None:
     # latency-bound (the MXU runs batch 1 and batch 8 in the same time).
     # The A/B then isolates what the budget-capped concurrency costs: the
     # dense arm needs ~slots-ratio more ticks to drain the same trace.
+    # n_heads scales with tp (the head axis must divide over the mesh).
     cfg = ModelConfig(
-        vocab=128, d_model=32, n_heads=2, n_layers=1, d_ff=64,
+        vocab=128, d_model=32, n_heads=max(2, a.tp), n_layers=1, d_ff=64,
         max_seq=a.max_seq, head_dim=16, dtype=jnp.float32, use_pallas=False,
     )
     params = init_params(jax.random.key(0), cfg)
     bucket = max(16, a.page)
-    dense_slots = max(a.hbm_tokens // a.max_seq, 1)
-    pool_blocks = a.hbm_tokens // a.page
+    # --hbm-tokens is per chip; the head shard divides uniformly, so the
+    # GLOBAL token capacity both arms spend is budget * tp
+    hbm_global = a.hbm_tokens * a.tp
+    dense_slots = max(hbm_global // a.max_seq, 1)
+    pool_blocks = hbm_global // a.page
     per_req_pages = -(-(a.prompt_len + a.max_new) // a.page)
     # cap the paged pool at 8 slots: on the CPU rig per-tick cost grows
     # with batch past ~8 faster than the tick count shrinks (a TPU's
@@ -107,7 +154,7 @@ def main() -> None:
             jax.random.key(seed), (n,), 1, cfg.vocab, jnp.int32)]
 
     def run_trace(name: str, serving: ServingConfig) -> dict:
-        eng = ServingEngine(params, cfg, serving)
+        eng = ServingEngine(params, cfg, serving, mesh=mesh)
         eng.start()
         try:
             # warmup wave (compiles + steady thread state), then the trace
@@ -140,6 +187,8 @@ def main() -> None:
             "kv_bucket_hist": {str(k): v for k, v in sorted(
                 stats["kv_bucket_hist"].items())},
             "kv_hbm_bytes": stats["kv_hbm_bytes"],
+            "kv_hbm_bytes_per_chip": stats["kv_hbm_bytes_per_chip"],
+            "tp": stats["tp"],
             "pool_blocked_admissions": stats["pool_blocked_admissions"],
             "kv_pool_occupancy_final": stats["kv_pool_occupancy"],
             "read_pages_ratio": stats["read_pages_ratio"],
@@ -150,7 +199,7 @@ def main() -> None:
         return out
 
     def run_prefix(name: str, serving: ServingConfig) -> dict:
-        eng = ServingEngine(params, cfg, serving)
+        eng = ServingEngine(params, cfg, serving, mesh=mesh)
         eng.start()
         try:
             pid = eng.register_prefix(prompt(7, a.prefix_len))
@@ -196,14 +245,25 @@ def main() -> None:
     zero_copy = (paged_px["prefix_install_copies"] == 0
                  and paged_px["prefix_blocks_shared"] > 0)
 
-    ok = bool(ratio and ratio >= 1.5 and zero_copy)
+    # the tp arms carry a stronger bar: the tentpole's acceptance is >= 2x
+    # aggregate tokens/sec over dense-TP at equal per-chip HBM
+    bar = 2.0 if a.tp > 1 else 1.5
+    ok = bool(ratio and ratio >= bar and zero_copy)
     artifact = {
-        "metric": "paged_kv_equal_hbm_tokens_per_sec_speedup",
+        "metric": ("paged_kv_tp_equal_per_chip_hbm_tokens_per_sec_speedup"
+                   if a.tp > 1 else
+                   "paged_kv_equal_hbm_tokens_per_sec_speedup"),
         "value": ratio and round(ratio, 3),
-        "unit": "x_aggregate_tokens_per_sec_vs_dense",
+        "unit": ("x_aggregate_tokens_per_sec_vs_dense_tp" if a.tp > 1
+                 else "x_aggregate_tokens_per_sec_vs_dense"),
         "pass": ok,
-        "hbm_budget_tokens": a.hbm_tokens,
-        "hbm_budget_bytes": a.hbm_tokens * kv_bytes_per_token(cfg),
+        "bar": bar,
+        "tp": a.tp,
+        # a.hbm_tokens is already per chip; a token's bytes split over the
+        # head shard, so its per-chip cost is bpt/tp — per-chip bytes =
+        # (hbm_tokens * tp global tokens) * bpt / tp = hbm_tokens * bpt
+        "hbm_budget_tokens_per_chip": a.hbm_tokens,
+        "hbm_budget_bytes_per_chip": a.hbm_tokens * kv_bytes_per_token(cfg),
         "page": a.page,
         "dense_slots": dense_slots,
         "paged_slots": paged_slots,
@@ -212,11 +272,14 @@ def main() -> None:
         "max_new": a.max_new,
         "quick": a.quick,
         "model": {"vocab": cfg.vocab, "d_model": cfg.d_model,
-                  "n_layers": cfg.n_layers, "max_seq": cfg.max_seq},
+                  "n_heads": cfg.n_heads, "n_layers": cfg.n_layers,
+                  "max_seq": cfg.max_seq},
         "arms": [dense, paged],
         "prefix_microbench": [dense_px, paged_px],
     }
-    out_path = a.out or (None if a.quick else "PAGED_KV_r07.json")
+    default_out = ("PAGED_KV_TP_r08.json" if a.tp > 1 else
+                   "PAGED_KV_r07.json")
+    out_path = a.out or (None if a.quick else default_out)
     if out_path:
         Path(out_path).write_text(json.dumps(artifact, indent=2) + "\n")
     print(json.dumps(artifact))

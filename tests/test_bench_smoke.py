@@ -79,6 +79,36 @@ def test_paged_kv_bench_quick_small_iteration():
     assert summary["summary"] and summary["prefix_zero_copy"]
 
 
+def test_paged_kv_bench_quick_tp2_iteration():
+    """paged_kv_bench --quick --tp 2 end to end: both arms run tensor-
+    parallel on a 2-virtual-device mesh with the pool head-sharded, the
+    artifact carries the per-chip HBM framing, and the zero-copy prefix
+    contract holds under the mesh (the >= 2x perf bar is asserted by the
+    bench's own exit code on full runs, not by this noisy-CI smoke)."""
+    r = _run([str(ROOT / "benchmarks" / "paged_kv_bench.py"), "--quick",
+              "--tp", "2", "--hbm-tokens", "64", "--max-seq", "128",
+              "--requests", "4", "--max-new", "8", "--prefix-requests", "2"])
+    assert r.returncode == 0, r.stderr
+    lines = [ln for ln in r.stdout.splitlines() if ln.strip()]
+    artifact = json.loads(lines[0])
+    summary = json.loads(lines[-1])
+    assert artifact["metric"] == \
+        "paged_kv_tp_equal_per_chip_hbm_tokens_per_sec_speedup"
+    assert artifact["tp"] == 2
+    arms = {a["arm"]: a for a in artifact["arms"]}
+    assert arms["paged"]["tp"] == 2 and arms["dense"]["tp"] == 2
+    assert arms["paged"]["kv_page"] and not arms["dense"]["kv_page"]
+    assert arms["paged"]["tokens"] == arms["dense"]["tokens"]
+    # per-chip figures are global/tp: the paged pool's per-chip bytes must
+    # sit at (or under) the dense arm's per-chip pin for the equal-HBM
+    # discipline to mean anything
+    assert arms["paged"]["kv_hbm_bytes_per_chip"]["paged"] is not None
+    px = {a["arm"]: a for a in artifact["prefix_microbench"]}
+    assert px["paged"]["prefix_install_copies"] == 0
+    assert px["paged"]["prefix_blocks_shared"] > 0
+    assert summary["summary"] and summary["prefix_zero_copy"]
+
+
 def test_decode_bench_quick_two_slot_iteration():
     r = _run([str(ROOT / "benchmarks" / "decode_bench.py"), "--quick",
               "--slots", "2", "--steps", "8", "--waves", "1",

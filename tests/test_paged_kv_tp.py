@@ -1,0 +1,430 @@
+"""Tensor-parallel paged KV: the head-sharded block pool (ISSUE 5 tentpole).
+
+Fast (non-slow) tier. The contract under test, layered like the change:
+
+- the paged pool allocates DIRECTLY head-sharded over the tp mesh (a pool
+  that would not fit one chip must never materialize unsharded), tables and
+  lengths replicated;
+- paged+TP streams are token-equal to dense+TP and to paged single-chip
+  (the gathered window is positionally identical to the dense prefix, and
+  the head shard splits attention exactly like the dense TP path), for the
+  exact-KV, int8-KV, and MoE families — plus a teacher-forced per-step
+  logits check that would catch divergence greedy equality can hide;
+- the KV gather/scatter path introduces NO collectives beyond the dense TP
+  path's (asserted on compiled HLO: per-kind collective counts are equal);
+- zero-copy prefix sharing survives the mesh (prefix_install_copies == 0);
+- pool backpressure and cancel-mid-batch behave identically under a mesh;
+- tp that does not divide the head axis is rejected at construction with
+  the offending dimension named.
+
+conftest forces --xla_force_host_platform_device_count=8, so tp in {2, 4}
+runs on CPU CI exactly like the dense TP suite.
+"""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from vtpu.models import ModelConfig, init_params
+from vtpu.parallel.mesh import make_axis_mesh
+from vtpu.serving import ServingConfig, ServingEngine
+from vtpu.serving.adapters import TransformerSlotModel
+
+# n_heads=4 so both tp=2 and tp=4 divide the head axis; f32 keeps CPU math
+# deterministic (the cross-partitioning stream equality below relies on it)
+CFG = ModelConfig(
+    vocab=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+    max_seq=32, head_dim=8, dtype=jnp.float32, use_pallas=False,
+)
+CFG_INT8 = ModelConfig(
+    vocab=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+    max_seq=32, head_dim=8, dtype=jnp.float32, use_pallas=False,
+    kv_int8=True,
+)
+PAGE = 8
+needs_devices = pytest.mark.skipif(
+    len(jax.devices()) < 4, reason="needs 4 virtual devices")
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(jax.random.key(0), CFG)
+
+
+@pytest.fixture(scope="module")
+def params_int8():
+    return init_params(jax.random.key(0), CFG_INT8)
+
+
+def _prompt(seed, n, lo=0):
+    return [int(t) for t in jax.random.randint(
+        jax.random.key(seed), (n,), lo, CFG.vocab, jnp.int32)]
+
+
+def _serving(kv_page=None, **kw):
+    base = dict(slots=2, prefill_buckets=(8,), max_new_tokens=6,
+                kv_page=kv_page)
+    base.update(kw)
+    return ServingConfig(**base)
+
+
+def _run(params, serving, prompts, mesh=None, steps=6, cfg=CFG):
+    eng = ServingEngine(params, cfg, serving, mesh=mesh)
+    eng.start()
+    try:
+        reqs = [eng.submit(p, max_new_tokens=steps) for p in prompts]
+        streams = [list(r.stream()) for r in reqs]
+        stats = eng.stats()
+    finally:
+        eng.stop()
+    return streams, stats
+
+
+# ----------------------------------------------- token equality under tp
+
+
+@needs_devices
+@pytest.mark.parametrize("tp", [2, 4])
+def test_paged_tp_streams_match_dense_tp_and_single_chip(params, tp):
+    """The acceptance bar: paged+TP streams equal dense+TP streams AND the
+    paged single-chip streams, request for request (three prompts through
+    two slots also covers slot recycling over reallocated blocks under the
+    mesh). The paged pool must be born head-sharded and drain fully free."""
+    mesh = make_axis_mesh("tp", tp)
+    prompts = [_prompt(1, 5), _prompt(2, 7), _prompt(3, 3)]
+    dense_tp, _ = _run(params, _serving(), prompts, mesh=mesh)
+    paged_1c, _ = _run(params, _serving(kv_page=PAGE), prompts)
+    paged_tp, stats = _run(params, _serving(kv_page=PAGE), prompts, mesh=mesh)
+    assert paged_tp == dense_tp
+    assert paged_tp == paged_1c
+    assert stats["tp"] == tp
+    assert stats["kv_pool_free"] == stats["kv_pool_blocks"]
+    assert stats["pool_blocked_admissions"] == 0
+
+
+@needs_devices
+def test_paged_tp_streams_match_dense_tp_bf16():
+    """The flagship dtype: bf16 paged-TP streams equal bf16 dense-TP
+    streams (the gathered window carries bit-identical values into the
+    same attention, so the equality is exact even where bf16 rounding
+    bites). Cross-partitioning equality is f32-only — bf16 reduction-order
+    noise could legitimately fork an argmax between tp widths."""
+    cfg = ModelConfig(
+        vocab=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        max_seq=32, head_dim=8, dtype=jnp.bfloat16, use_pallas=False)
+    p = init_params(jax.random.key(0), cfg)
+    mesh = make_axis_mesh("tp", 2)
+    prompts = [_prompt(11, 5), _prompt(12, 6)]
+    dense_tp, _ = _run(p, _serving(), prompts, mesh=mesh, cfg=cfg)
+    paged_tp, stats = _run(p, _serving(kv_page=PAGE), prompts, mesh=mesh,
+                           cfg=cfg)
+    assert paged_tp == dense_tp
+    assert stats["kv_pool_free"] == stats["kv_pool_blocks"]
+
+
+@needs_devices
+@pytest.mark.parametrize("tp", [2, 4])
+def test_paged_tp_int8_streams_match_dense_tp(params_int8, tp):
+    """int8-KV under the mesh: the scale pools shard their head axis
+    alongside the values, and paged int8 TP streams equal dense int8 TP
+    streams and the single-chip paged int8 streams."""
+    mesh = make_axis_mesh("tp", tp)
+    prompts = [_prompt(4, 5), _prompt(5, 6)]
+    dense_tp, _ = _run(params_int8, _serving(), prompts, mesh=mesh,
+                       cfg=CFG_INT8)
+    paged_1c, _ = _run(params_int8, _serving(kv_page=PAGE), prompts,
+                       cfg=CFG_INT8)
+    paged_tp, stats = _run(params_int8, _serving(kv_page=PAGE), prompts,
+                           mesh=mesh, cfg=CFG_INT8)
+    assert paged_tp == dense_tp == paged_1c
+    assert stats["kv_pool_free"] == stats["kv_pool_blocks"]
+
+
+@needs_devices
+def test_moe_paged_tp_streams_match_dense_tp():
+    """The MoE family through the shared trunk under tp=2: attention heads
+    column-sharded, experts E-sharded over the same devices, paged pool
+    head-sharded — streams equal the dense-TP MoE engine's and the
+    single-chip paged MoE engine's."""
+    from vtpu.models.moe import MoEConfig, init_moe_params
+    from vtpu.serving.adapters import MoeSlotModel
+
+    cfg = MoEConfig(vocab=96, d_model=64, n_heads=2, n_layers=2, d_ff=64,
+                    n_experts=4, top_k=2, max_seq=32, head_dim=32,
+                    dtype=jnp.float32)
+    mparams = init_moe_params(jax.random.key(5), cfg)
+    serving = ServingConfig(slots=2, prefill_buckets=(8,), max_new_tokens=5)
+    mesh = make_axis_mesh("tp", 2)
+    prompts = [[t % cfg.vocab for t in _prompt(21, 5)],
+               [t % cfg.vocab for t in _prompt(22, 7)]]
+
+    def run(model):
+        eng = ServingEngine(serving=serving, model=model)
+        eng.start()
+        try:
+            reqs = [eng.submit(p, max_new_tokens=5) for p in prompts]
+            return [list(r.stream()) for r in reqs], eng.stats()
+        finally:
+            eng.stop()
+
+    dense_tp, _ = run(MoeSlotModel(mparams, cfg, mesh=mesh))
+    paged_1c, _ = run(MoeSlotModel(mparams, cfg, kv_page=PAGE))
+    paged_tp, stats = run(MoeSlotModel(mparams, cfg, mesh=mesh, kv_page=PAGE))
+    assert paged_tp == dense_tp == paged_1c
+    assert stats["kv_pool_free"] == stats["kv_pool_blocks"]
+
+
+@needs_devices
+def test_teacher_forced_decode_logits_match_across_layouts(params):
+    """Teacher-forced per-step check: force the SAME token stream through
+    the paged-TP, dense-TP, and paged single-chip caches and compare the
+    per-step logits — catches divergence free-running greedy equality can
+    hide behind an argmax fork. Also pins that the paged-TP pool is never
+    rebuilt unsharded across steps (donated state keeps its layout)."""
+    from vtpu.parallel.sharding import paged_kv_shardings
+
+    mesh = make_axis_mesh("tp", 2)
+    prompt = _prompt(7, 9, lo=1)
+    forced = _prompt(8, 4, lo=1)
+    want = paged_kv_shardings(mesh)["k"]
+
+    def arm(mesh_, kv_page):
+        model = TransformerSlotModel(params, CFG, mesh=mesh_, kv_page=kv_page)
+        state = model.init_state(2)
+        if kv_page is not None:
+            # the engine's reservation maps the slot's pages before any
+            # prefill scatter; mirror it here (slot 0 -> blocks 1..4)
+            state = dict(state)
+            state["table"] = state["table"].at[0].set(
+                jnp.arange(1, state["table"].shape[1] + 1, dtype=jnp.int32))
+        padded = jnp.zeros((1, 16), jnp.int32).at[0, :9].set(
+            jnp.asarray(prompt, jnp.int32))
+        prefill_j = jax.jit(model.prefill_into_slot)
+        step_j = jax.jit(model.decode_step,
+                         static_argnames=("kv_bucket", "unroll"))
+        _, state = prefill_j(model.params, state, padded, jnp.int32(0),
+                             jnp.int32(9))
+        out = []
+        act = jnp.asarray([True, False])
+        for t in forced:
+            logits, state = step_j(
+                model.params, state, jnp.asarray([t, 0], jnp.int32), act,
+                16, unroll=True)
+            out.append(np.asarray(logits[0]))
+            if kv_page is not None and mesh_ is not None:
+                # is_equivalent_to: a jit round-trip may normalize away
+                # trailing replicated axes in the spec
+                assert state["k"].sharding.is_equivalent_to(
+                    want, state["k"].ndim)
+        return out
+
+    paged_tp = arm(mesh, PAGE)
+    dense_tp = arm(mesh, None)
+    paged_1c = arm(None, PAGE)
+    for a, b, c in zip(paged_tp, dense_tp, paged_1c):
+        np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(a, c, rtol=2e-4, atol=2e-4)
+
+
+# ------------------------------------------- no collectives on the KV path
+
+
+_COLLECTIVE_KINDS = ("all-reduce", "all-gather", "all-to-all",
+                     "collective-permute", "reduce-scatter")
+
+
+def _decode_collective_counts(params, cfg, mesh, kv_page):
+    """Per-kind collective-op counts in the compiled HLO of one decode
+    step under *mesh* — the evidence behind the no-new-collectives bar."""
+    model = TransformerSlotModel(params, cfg, mesh=mesh, kv_page=kv_page)
+    state = model.init_state(2)
+    fn = jax.jit(model.decode_step, static_argnames=("kv_bucket", "unroll"))
+    hlo = fn.lower(
+        model.params, state, jnp.zeros((2,), jnp.int32),
+        jnp.ones((2,), bool), 16, unroll=True,
+    ).compile().as_text()
+    return {k: len(re.findall(rf"\b{k}\b", hlo)) for k in _COLLECTIVE_KINDS}
+
+
+@needs_devices
+def test_no_new_collectives_on_kv_gather_scatter_path(params):
+    """The paged pool's gathers/scatters must be chip-local on the head
+    shard: compiled-HLO collective counts (per kind) for the paged-TP
+    decode step equal the dense-TP step's exactly — collectives remain
+    only where the dense TP path already has them (the per-block
+    all-reduce after wo and the logits reduction)."""
+    mesh = make_axis_mesh("tp", 2)
+    assert (_decode_collective_counts(params, CFG, mesh, PAGE)
+            == _decode_collective_counts(params, CFG, mesh, None))
+
+
+@needs_devices
+def test_int8_no_new_collectives_on_kv_path(params_int8):
+    """Same HLO contract for the int8 pools: four gathers (values + scales)
+    per layer, still zero collectives beyond the dense int8 TP path."""
+    mesh = make_axis_mesh("tp", 2)
+    assert (_decode_collective_counts(params_int8, CFG_INT8, mesh, PAGE)
+            == _decode_collective_counts(params_int8, CFG_INT8, mesh, None))
+
+
+# ------------------------------------------------- pool allocation layout
+
+
+@needs_devices
+def test_pool_allocates_directly_sharded(params):
+    """The pools (and int8 scale pools) are BORN with the head-sharded
+    NamedSharding from paged_kv_shardings — never materialized unsharded —
+    and tables/lengths replicate."""
+    from vtpu.parallel.sharding import paged_kv_shardings
+
+    mesh = make_axis_mesh("tp", 2)
+    model = TransformerSlotModel(params, CFG, mesh=mesh, kv_page=PAGE)
+    state = model.init_state(2)
+    want = paged_kv_shardings(mesh)
+    assert state["k"].sharding == want["k"]
+    assert state["v"].sharding == want["v"]
+    assert state["table"].sharding.is_fully_replicated
+    assert state["len"].sharding.is_fully_replicated
+
+    model8 = TransformerSlotModel(
+        init_params(jax.random.key(0), CFG_INT8), CFG_INT8, mesh=mesh,
+        kv_page=PAGE)
+    state8 = model8.init_state(2)
+    want8 = paged_kv_shardings(mesh, quantized=True)
+    assert state8["k_scale"].sharding == want8["k_scale"]
+    assert state8["v_scale"].sharding == want8["v_scale"]
+
+
+# --------------------------------------------------- validation precision
+
+
+@needs_devices
+def test_tp_must_divide_heads_named_error(params):
+    """tp=8 against n_heads=4: rejected at construction, naming the head
+    dimension — paged and dense alike (the old blanket 'does not compose'
+    rejection is gone)."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    mesh = make_axis_mesh("tp", 8)
+    with pytest.raises(ValueError, match=r"n_heads=4"):
+        TransformerSlotModel(params, CFG, mesh=mesh, kv_page=PAGE)
+    with pytest.raises(ValueError, match=r"n_heads=4"):
+        TransformerSlotModel(params, CFG, mesh=mesh)
+
+
+@needs_devices
+def test_paged_tp_composes_at_construction(params):
+    """The PR-4 rejection is gone: a paged adapter under a legal tp mesh
+    constructs (and non-tp mesh axes still fail the tp-only check)."""
+    from vtpu.parallel.mesh import make_mesh
+
+    mesh = make_axis_mesh("tp", 2)
+    TransformerSlotModel(params, CFG, mesh=mesh, kv_page=PAGE)  # no raise
+    with pytest.raises(ValueError, match="tp-only"):
+        TransformerSlotModel(params, CFG, mesh=make_mesh(8, tp=2),
+                             kv_page=PAGE)
+
+
+# --------------------------------------------- zero-copy prefixes under tp
+
+
+@needs_devices
+def test_prefix_zero_copy_under_tp(params):
+    """Satellite: a registered prefix prefills into the SHARDED pool once;
+    admissions under tp>1 map its blocks read-only with ZERO install
+    copies (the acceptance counter), COW only the boundary block, and the
+    streams equal a from-scratch full-prompt admission on the same mesh."""
+    mesh = make_axis_mesh("tp", 2)
+    serving = _serving(kv_page=PAGE, prefill_chunk=8)
+    pre = [5, 6, 7, 8, 9, 5, 6, 7, 8, 9]  # 10 tokens: 1 full page + partial
+    suf = [1, 2, 3]
+    eng = ServingEngine(params, CFG, serving, mesh=mesh)
+    eng.start()
+    try:
+        pid = eng.register_prefix(pre)
+        got = list(eng.submit(suf, max_new_tokens=6, prefix=pid).stream())
+        got2 = list(eng.submit(suf, max_new_tokens=6, prefix=pid).stream())
+        stats = eng.stats()
+    finally:
+        eng.stop()
+    want, _ = _run(params, serving, [pre + suf], mesh=mesh)
+    assert got == got2 == want[0]
+    assert stats["prefix_install_copies"] == 0
+    assert stats["prefix_blocks_shared"] == 2   # 1 full page x 2 admissions
+    assert stats["prefix_cow_copies"] == 2      # boundary block x 2
+
+
+# --------------------------------------- backpressure + cancel under a mesh
+
+
+@needs_devices
+def test_pool_backpressure_under_tp(params):
+    """A pool covering one request at a time serializes a 3-burst through
+    backpressure on the mesh exactly as on one chip: full streams,
+    blocked-admission events counted, pool drains free."""
+    mesh = make_axis_mesh("tp", 2)
+    serving = _serving(kv_page=PAGE, kv_pool_blocks=2)
+    streams, stats = _run(params, serving,
+                          [_prompt(i + 10, 5) for i in range(3)], mesh=mesh)
+    assert [len(s) for s in streams] == [6, 6, 6]
+    assert stats["pool_blocked_admissions"] > 0
+    assert stats["admissions"] == 3
+    assert stats["kv_pool_free"] == 2
+
+
+@needs_devices
+def test_cancel_mid_batched_prefill_under_tp(params):
+    """Cancel one request after its batched paged prefill dispatched on the
+    mesh but before first-token delivery: the victim's blocks free at
+    retire, the survivors stream normally, the pool drains fully free."""
+    mesh = make_axis_mesh("tp", 2)
+    serving = ServingConfig(slots=3, prefill_buckets=(8,), max_new_tokens=4,
+                            prefill_batch_sizes=(3,), kv_page=PAGE)
+    eng = ServingEngine(params, CFG, serving, mesh=mesh)
+    step0 = eng._admit_step
+    cell: dict = {}
+
+    def wrapped(params_, state, buf, tokens, *rest):
+        out = step0(params_, state, buf, tokens, *rest)
+        if "victim" in cell and bool((tokens != 0).any()):
+            cell.pop("victim").cancel()
+        return out
+
+    eng._admit_step = wrapped
+    reqs = [eng.submit(_prompt(40 + i, 5, lo=1), max_new_tokens=4)
+            for i in range(3)]
+    cell["victim"] = reqs[1]
+    eng.start()
+    try:
+        streams = [list(r.stream()) for r in reqs]
+        stats = eng.stats()
+    finally:
+        eng.stop()
+    assert streams[1] == []
+    assert len(streams[0]) == 4 and len(streams[2]) == 4
+    assert stats["kv_pool_free"] == stats["kv_pool_blocks"]
+
+
+# ----------------------------------------------------- per-chip accounting
+
+
+@needs_devices
+def test_stats_report_per_chip_bytes_under_mesh(params):
+    """Satellite: kv_hbm_bytes maps onto the per-container
+    TPU_DEVICE_MEMORY_LIMIT_<i> cap, which is a PER-CHIP number — under a
+    tp mesh the figures are global/tp (the head shard divides uniformly),
+    and kv_hbm_bytes_per_chip carries them explicitly."""
+    prompts = [_prompt(1, 5)]
+    _, s1 = _run(params, _serving(kv_page=PAGE), prompts)
+    _, s2 = _run(params, _serving(kv_page=PAGE), prompts,
+                 mesh=make_axis_mesh("tp", 2))
+    assert s1["tp"] == 1 and s2["tp"] == 2
+    assert s2["kv_hbm_bytes"]["paged"] * 2 == s1["kv_hbm_bytes"]["paged"]
+    assert s2["kv_hbm_bytes"]["dense"] * 2 == s1["kv_hbm_bytes"]["dense"]
+    assert s2["kv_hbm_bytes_per_chip"] == s2["kv_hbm_bytes"]
+    # occupancy is a per-chip-accurate ratio already: every chip holds the
+    # same head slice of the same blocks
+    assert s2["kv_pool_occupancy"] == s1["kv_pool_occupancy"]

@@ -377,6 +377,7 @@ def decode_layer_loop(
     write_kv,
     ffn_fn=None,
     unroll: bool = False,
+    mesh=None,
 ) -> tuple[jax.Array, dict[str, jax.Array]]:
     """Shared decode-step body: a fori_loop carrying the STACKED cache (not a
     scan stacking fresh per-layer outputs), so the cache write — supplied by
@@ -391,10 +392,11 @@ def decode_layer_loop(
     ``unroll`` trades compile time for a STATIC layer index (see
     spec_verify_loop, which owns the single implementation — one decode
     token is a T=1 verify chunk, so plain-decode and speculative-verify
-    numerics can never drift apart). Returns (logits [B, vocab], new kv)."""
+    numerics can never drift apart). ``mesh`` marks a head-sharded paged
+    pool (see spec_verify_loop). Returns (logits [B, vocab], new kv)."""
     logits, new_kv = spec_verify_loop(
         params, cfg, cache, token[:, None], kv_bucket, write_kv,
-        ffn_fn=ffn_fn, unroll=unroll,
+        ffn_fn=ffn_fn, unroll=unroll, mesh=mesh,
     )
     return logits[:, 0], new_kv
 
@@ -408,6 +410,7 @@ def spec_verify_loop(
     write_kv,
     ffn_fn=None,
     unroll: bool = False,
+    mesh=None,
 ) -> tuple[jax.Array, dict[str, jax.Array]]:
     """Verify pass for speculative decoding: one forward over a [B, T] draft
     chunk whose row-i query sits at cache position len[b] + i.
@@ -437,6 +440,14 @@ def spec_verify_loop(
     a loop-carried l, which XLA materializes as a slice copy before
     attention; unrolled, ks[l][:, :bucket] is a static view that fuses into
     the attention reads (the r2 decode-inversion exhibit in mfu_bench).
+
+    ``mesh`` (a ('tp',) Mesh, paged caches only) marks the pool as
+    HEAD-SHARDED: the page gathers are pinned chip-local on the head shard
+    (ops/attention.py gather_kv_pages) — tables are replicated and every
+    chip holds its head slice of every block, so paged reads and writes
+    introduce no collectives beyond the per-block all-reduce the dense TP
+    path already pays after wo. None (the default) is the single-chip
+    path, bit-identical to before the mesh existed.
     """
     b, t = draft.shape
     bucket = kv_bucket or cfg.max_seq
@@ -492,10 +503,11 @@ def spec_verify_loop(
             if quant:
                 attn = paged_causal_attention_int8kv(
                     q, view["k"], view["k_scale"], view["v"],
-                    view["v_scale"], table_w, kv_len=ragged_len)
+                    view["v_scale"], table_w, kv_len=ragged_len, mesh=mesh)
             else:
                 attn = paged_causal_attention(
-                    q, view["k"], view["v"], table_w, kv_len=ragged_len)
+                    q, view["k"], view["v"], table_w, kv_len=ragged_len,
+                    mesh=mesh)
         elif quant:
             attn = causal_attention_int8kv(
                 q, view["k"][:, :bucket], view["k_scale"][:, :bucket],

@@ -110,7 +110,8 @@ def causal_attention_int8kv(
     return out.astype(q.dtype)
 
 
-def gather_kv_pages(pool: jax.Array, table: jax.Array) -> jax.Array:
+def gather_kv_pages(pool: jax.Array, table: jax.Array,
+                    mesh=None) -> jax.Array:
     """Materialize a slot-pooled read window from a paged block pool.
 
     pool: one layer's plane, [n_blocks, page, ...] (KV values [.., H, Dh] or
@@ -128,10 +129,23 @@ def gather_kv_pages(pool: jax.Array, table: jax.Array) -> jax.Array:
     length" that a single static-shape dispatch could not otherwise express.
     Null-block values are garbage by design; every consumer masks reads at
     kv_len, so they are never observable.
+
+    ``mesh`` (a ('tp',) Mesh) marks a HEAD-SHARDED pool: every chip holds
+    its head slice of every block, the table is replicated, so the gather
+    is chip-local by construction — the sharding constraint pins the
+    gathered window to the pool's own head shard (H sits at axis 2 of the
+    window for value planes and scale planes alike) so the partitioner can
+    never "help" by all-gathering the pool first.
     """
     b, wp = table.shape
     g = pool[table]  # [B, Wp, page, ...]
-    return g.reshape((b, wp * pool.shape[1]) + pool.shape[2:])
+    out = g.reshape((b, wp * pool.shape[1]) + pool.shape[2:])
+    if mesh is not None:
+        from vtpu.parallel.sharding import head_sharding
+
+        out = jax.lax.with_sharding_constraint(
+            out, head_sharding(mesh, out.ndim, 2))
+    return out
 
 
 def paged_causal_attention(
@@ -140,6 +154,7 @@ def paged_causal_attention(
     v_pool: jax.Array,
     table: jax.Array,
     kv_len: jax.Array | None = None,
+    mesh=None,
 ) -> jax.Array:
     """Causal attention over a paged KV window: gather each slot's live
     pages from the shared block pool, then the reference attention.
@@ -148,9 +163,11 @@ def paged_causal_attention(
     plane of the pool); table: [B, Wp] block ids with Wp*page >= the read
     window. kv_len exactly as in causal_attention — the gathered window is
     positionally identical to a dense cache prefix, so the masking contract
-    is unchanged."""
-    k = gather_kv_pages(k_pool, table)
-    v = gather_kv_pages(v_pool, table)
+    is unchanged. ``mesh`` marks head-sharded pools (tensor-parallel
+    serving): the gathers stay chip-local on the head shard and the
+    attention runs on each chip's heads, exactly like the dense TP path."""
+    k = gather_kv_pages(k_pool, table, mesh=mesh)
+    v = gather_kv_pages(v_pool, table, mesh=mesh)
     return causal_attention(q, k, v, kv_len=kv_len)
 
 
@@ -162,15 +179,18 @@ def paged_causal_attention_int8kv(
     v_scale_pool: jax.Array,
     table: jax.Array,
     kv_len: jax.Array | None = None,
+    mesh=None,
 ) -> jax.Array:
     """Paged variant of causal_attention_int8kv: int8 value pools
     [n_blocks, page, H, Dh] plus f32 scale pools [n_blocks, page, H],
     gathered per slot through the same page table, then the shared
-    int8-window attention (scales applied post-matmul, exactly as dense)."""
-    kq = gather_kv_pages(kq_pool, table)
-    vq = gather_kv_pages(vq_pool, table)
-    k_scale = gather_kv_pages(k_scale_pool, table)
-    v_scale = gather_kv_pages(v_scale_pool, table)
+    int8-window attention (scales applied post-matmul, exactly as dense).
+    ``mesh`` as in paged_causal_attention — the scale pools shard their
+    head axis alongside their values, so all four gathers are chip-local."""
+    kq = gather_kv_pages(kq_pool, table, mesh=mesh)
+    vq = gather_kv_pages(vq_pool, table, mesh=mesh)
+    k_scale = gather_kv_pages(k_scale_pool, table, mesh=mesh)
+    v_scale = gather_kv_pages(v_scale_pool, table, mesh=mesh)
     return causal_attention_int8kv(q, kq, k_scale, vq, v_scale, kv_len=kv_len)
 
 

@@ -71,3 +71,93 @@ def shard_kv_cache(cache: dict[str, jax.Array], mesh: Mesh) -> dict[str, jax.Arr
     return jax.tree.map(
         jax.device_put, cache,
         kv_cache_shardings(mesh, quantized="k_scale" in cache))
+
+
+# Head-axis position per paged-cache plane, counted from the END so the same
+# rule covers the pool layout ([L, n_blocks, page, H, Dh] / scale
+# [L, n_blocks, page, H]) and every derived view (gathered window
+# [B, W, H, Dh], single-slot chunk view [L, 1, S, H, Dh], ...): KV value
+# planes carry a trailing Dh, scale planes end at H.
+_PAGED_HEAD_AXIS = {"k": -2, "v": -2, "k_scale": -1, "v_scale": -1}
+
+
+def head_sharding(mesh: Mesh, ndim: int, head_axis: int) -> NamedSharding:
+    """NamedSharding putting one axis (negative indices allowed) on 'tp' and
+    replicating the rest — the single rule every paged-KV plane follows."""
+    spec = [None] * ndim
+    spec[head_axis] = "tp"
+    return NamedSharding(mesh, P(*spec))
+
+
+def paged_kv_shardings(mesh: Mesh, quantized: bool = False) -> dict[str, NamedSharding]:
+    """Paged KV pool [L, n_blocks, page, H, Dh]: heads over 'tp' (matching
+    the q/k/v column shards, exactly like the dense cache), block/page axes
+    replicated — every chip holds its head slice of EVERY block, so a page
+    table lookup never implies cross-chip traffic. The per-slot page table
+    and lengths are replicated: they are host-authored control state, tiny
+    next to the pools, and both the gather and the scatter consume them on
+    every chip. ``quantized`` adds the int8 scale pools [L, n_blocks, page,
+    H], head-sharded alongside their values."""
+    out = {
+        "k": head_sharding(mesh, 5, -2),
+        "v": head_sharding(mesh, 5, -2),
+        "table": NamedSharding(mesh, P()),
+        "len": NamedSharding(mesh, P()),
+    }
+    if quantized:
+        out["k_scale"] = head_sharding(mesh, 4, -1)
+        out["v_scale"] = head_sharding(mesh, 4, -1)
+    return out
+
+
+def constrain_paged_kv(state: dict[str, jax.Array], mesh: Mesh) -> dict[str, jax.Array]:
+    """Pin a paged cache pytree (pool OR any single-slot/window view of it)
+    to its head shards inside a jitted step: k/v planes shard the head axis
+    (ndim-2), scale planes theirs (ndim-1), table/len replicated. Applied at
+    every step boundary by the serving adapters so the compiler can never
+    drift a donated pool through an unsharded (single-chip-OOM) layout."""
+    out = {}
+    for key, arr in state.items():
+        ax = _PAGED_HEAD_AXIS.get(key)
+        if ax is None:
+            sh = NamedSharding(mesh, P())
+        else:
+            sh = head_sharding(mesh, arr.ndim, ax)
+        out[key] = jax.lax.with_sharding_constraint(arr, sh)
+    return out
+
+
+def moe_tp_param_shardings(mesh: Mesh, n_experts: int) -> dict[str, Any]:
+    """PartitionSpec pytree for vtpu.models.moe.init_moe_params under a
+    tp-only serving mesh: the attention trunk shards exactly like the dense
+    transformer (heads column-sharded, wo row-sharded — one all-reduce per
+    block), the router stays replicated (tiny, numerically load-bearing),
+    and the expert stacks shard their E axis over 'tp' when it divides
+    (expert parallelism riding the serving mesh; the combine einsum's
+    expert contraction becomes the block's all-reduce) — replicated
+    otherwise, trading memory for zero routing collectives."""
+    ep = "tp" if n_experts % mesh.shape["tp"] == 0 else None
+    expert = NamedSharding(mesh, P(None, ep, None, None))
+    return {
+        "embed": NamedSharding(mesh, P(None, "tp")),
+        "layers": {
+            "wq": NamedSharding(mesh, P(None, None, "tp")),
+            "wk": NamedSharding(mesh, P(None, None, "tp")),
+            "wv": NamedSharding(mesh, P(None, None, "tp")),
+            "wo": NamedSharding(mesh, P(None, "tp", None)),
+            "router": NamedSharding(mesh, P(None, None, None)),
+            "w_gate": expert,
+            "w_up": expert,
+            "w_down": expert,
+            "attn_norm": NamedSharding(mesh, P(None, None)),
+            "mlp_norm": NamedSharding(mesh, P(None, None)),
+        },
+        "final_norm": NamedSharding(mesh, P(None)),
+    }
+
+
+def shard_moe_params(params: Any, mesh: Mesh, n_experts: int) -> Any:
+    """Place a host pytree of MoE params onto the mesh per
+    moe_tp_param_shardings."""
+    return jax.tree.map(
+        jax.device_put, params, moe_tp_param_shardings(mesh, n_experts))

@@ -19,7 +19,10 @@ import functools
 
 import jax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+try:
+    from jax import shard_map
+except ImportError:  # jax < 0.5 exports it under experimental only
+    from jax.experimental.shard_map import shard_map
 
 from vtpu.ops.attention import causal_attention
 

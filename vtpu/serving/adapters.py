@@ -94,7 +94,11 @@ class TransformerSlotModel:
 
     With ``mesh`` (a ('tp',) Mesh), weights are tensor-parallel and the KV
     cache shards its head axis — multi-chip serving with the same slot
-    machinery; XLA places the per-layer all-reduces on ICI.
+    machinery; XLA places the per-layer all-reduces on ICI. The paged block
+    pool (``kv_page``) composes: pools allocate head-sharded over 'tp'
+    (paged_kv_shardings), page tables and the allocator stay host-side and
+    replicated, and every page gather/scatter is chip-local on the head
+    shard — no collectives beyond the dense TP path's.
     """
 
     supports_kv_buckets = True
@@ -106,26 +110,12 @@ class TransformerSlotModel:
         self.mesh = mesh
         self.max_context = cfg.max_seq
         _init_paged_attrs(self, kv_page, kv_pool_blocks)
-        if kv_page is not None and mesh is not None:
-            # a head-sharded BLOCK pool needs sharded gathers/scatters the
-            # paged trunk doesn't express yet; fail at construction, not
-            # with a wrong-sharding surprise mid-serving
-            raise ValueError(
-                "paged KV (kv_page) does not compose with tensor-parallel "
-                "serving yet")
         if mesh is None:
             self.params = params
         else:
             from vtpu.parallel.sharding import shard_params
 
-            extra = {a: n for a, n in mesh.shape.items() if a != "tp" and n != 1}
-            if extra:
-                # decode ticks would replicate across every non-tp axis
-                # (dp, slice, ...) with zero throughput gain; slots are the
-                # batch axis and stay local
-                raise ValueError(
-                    f"serving mesh must be tp-only, got extra axes {extra}"
-                )
+            _validate_serving_mesh(mesh, cfg)
             self.params = shard_params(params, mesh)
 
     def init_state(self, slots: int):
@@ -149,7 +139,10 @@ class TransformerSlotModel:
     def prefill_into_slot(self, params, state, padded, slot, true_len):
         from vtpu.serving.engine import prefill_into_slot
 
-        return prefill_into_slot(params, self.cfg, state, padded, slot, true_len)
+        logits, new = prefill_into_slot(
+            params, self.cfg, _constrain_paged(self, state), padded, slot,
+            true_len, mesh=self.mesh)
+        return logits, _constrain_paged(self, new)
 
     def prefill_into_slots(self, params, state, padded, slots, true_lens):
         from vtpu.models.transformer import prefill
@@ -157,38 +150,86 @@ class TransformerSlotModel:
 
         # logits_at: gather each row's final position before the vocab
         # projection — the [N, bucket, vocab] intermediate never exists
-        return prefill_into_slots(
-            params, self.cfg, state, padded, slots, true_lens,
+        logits, new = prefill_into_slots(
+            params, self.cfg, _constrain_paged(self, state), padded, slots,
+            true_lens,
             prefill_fn=lambda p, c, t: prefill(p, c, t, logits_at=true_lens - 1),
+            mesh=self.mesh,
         )
+        return logits, _constrain_paged(self, new)
 
     def decode_step(self, params, state, tokens, active, kv_bucket,
                     unroll=False):
         from vtpu.serving.engine import batched_decode_step
 
-        return batched_decode_step(
-            cfg=self.cfg, params=params, cache=state, tokens=tokens,
-            active=active, kv_bucket=kv_bucket, unroll=unroll,
+        logits, new = batched_decode_step(
+            cfg=self.cfg, params=params, cache=_constrain_paged(self, state),
+            tokens=tokens, active=active, kv_bucket=kv_bucket, unroll=unroll,
+            mesh=self.mesh,
         )
+        return logits, _constrain_paged(self, new)
 
     def spec_step(self, params, state, draft, active, cap, kv_bucket,
                   unroll=False):
         from vtpu.serving.engine import batched_spec_step
 
-        return batched_spec_step(
-            cfg=self.cfg, params=params, cache=state, draft=draft,
-            active=active, cap=cap, kv_bucket=kv_bucket, unroll=unroll,
+        pred, count, new = batched_spec_step(
+            cfg=self.cfg, params=params, cache=_constrain_paged(self, state),
+            draft=draft, active=active, cap=cap, kv_bucket=kv_bucket,
+            unroll=unroll, mesh=self.mesh,
         )
+        return pred, count, _constrain_paged(self, new)
 
     def prefill_chunk_into_slot(self, params, state, chunk, slot, offset,
                                 new_len, kv_bucket=0, unroll=False,
                                 block_ids=None):
         from vtpu.serving.engine import chunked_prefill_into_slot
 
-        return chunked_prefill_into_slot(
-            params, self.cfg, state, chunk, slot, offset, new_len,
-            kv_bucket=kv_bucket, unroll=unroll, block_ids=block_ids,
+        logits, new = chunked_prefill_into_slot(
+            params, self.cfg, _constrain_paged(self, state), chunk, slot,
+            offset, new_len, kv_bucket=kv_bucket, unroll=unroll,
+            block_ids=block_ids, mesh=self.mesh,
         )
+        return logits, _constrain_paged(self, new)
+
+
+def _validate_serving_mesh(mesh: Any, cfg: Any) -> None:
+    """Construction-time checks for a tensor-parallel serving mesh — every
+    rejection names the offending dimension, so a bad pairing fails loudly
+    here instead of as a wrong-sharding surprise (or an XLA shape error)
+    mid-serving. Shared by the transformer and MoE adapter families."""
+    from vtpu.models.transformer import kv_quantized
+
+    extra = {a: n for a, n in mesh.shape.items() if a != "tp" and n != 1}
+    if extra:
+        # decode ticks would replicate across every non-tp axis
+        # (dp, slice, ...) with zero throughput gain; slots are the
+        # batch axis and stay local
+        raise ValueError(
+            f"serving mesh must be tp-only, got extra axes {extra}"
+        )
+    tp = int(mesh.shape.get("tp", 1))
+    if cfg.n_heads % tp:
+        # per-token-per-head int8 scales share the head axis, so one check
+        # covers both planes — the message names each offending dimension
+        raise ValueError(
+            f"tp={tp} must divide the attention head count "
+            f"(n_heads={cfg.n_heads}): q/k/v and the KV cache/pool shard "
+            "their head axis over 'tp'"
+            + (f", as do the int8 k_scale/v_scale pool head groups "
+               f"(= n_heads = {cfg.n_heads})" if kv_quantized(cfg) else ""))
+
+
+def _constrain_paged(model: Any, state: Any) -> Any:
+    """Pin a paged pool pytree to its head shards at the step boundary
+    (no-op for dense caches or single-chip pools). Applied on entry AND
+    exit of every adapter step so the donated pool can never round-trip
+    through an unsharded layout the compiler picked for itself."""
+    if model.mesh is None or getattr(model, "kv_page", None) is None:
+        return state
+    from vtpu.parallel.sharding import constrain_paged_kv
+
+    return constrain_paged_kv(state, model.mesh)
 
 
 def _init_paged_attrs(model: Any, kv_page: Optional[int],
@@ -203,7 +244,7 @@ def _init_paged_attrs(model: Any, kv_page: Optional[int],
 
 
 def _init_paged_state(model: Any, slots: int):
-    from vtpu.models.transformer import init_paged_kv_cache
+    from vtpu.models.transformer import init_paged_kv_cache, kv_quantized
 
     max_pages = model.max_context // model.kv_page
     if model.kv_pool_blocks is not None and model.kv_pool_blocks < 1:
@@ -214,32 +255,70 @@ def _init_paged_state(model: Any, slots: int):
     usable = (model.kv_pool_blocks if model.kv_pool_blocks is not None
               else slots * max_pages)
     model.n_kv_blocks = usable + 1  # + the reserved null block 0
-    return init_paged_kv_cache(
-        model.cfg, slots, model.kv_page, model.n_kv_blocks)
+    if model.mesh is None:
+        return init_paged_kv_cache(
+            model.cfg, slots, model.kv_page, model.n_kv_blocks)
+    from vtpu.parallel.sharding import paged_kv_shardings
+
+    # allocate the pool directly head-sharded (the same out_shardings
+    # discipline as the dense sharded cache above): a pool sized past one
+    # chip's HBM must never exist unsharded, not even for a device_put
+    return jax.jit(
+        lambda: init_paged_kv_cache(
+            model.cfg, slots, model.kv_page, model.n_kv_blocks),
+        out_shardings=paged_kv_shardings(
+            model.mesh, quantized=kv_quantized(model.cfg)),
+    )()
 
 
 class MoeSlotModel:
     """Expert-parallel MoE (vtpu/models/moe): the transformer attention
     trunk with routed experts as the post-attention block, so it shares the
     slot-KV-cache machinery (including bounded decode read windows) and only
-    swaps the FFN into the shared decode loop."""
+    swaps the FFN into the shared decode loop.
+
+    With ``mesh`` (a ('tp',) Mesh) the attention trunk goes tensor-parallel
+    exactly like the dense family (heads column-sharded, KV cache/pool
+    head-sharded) and the expert stacks shard their E axis over the same
+    'tp' devices when it divides (vtpu/parallel/sharding.py
+    moe_tp_param_shardings — not expert.py's ep-axis moe_param_shardings)
+    — the serving mesh carries both parallelisms.
+    """
 
     supports_kv_buckets = True
 
-    def __init__(self, params: Any, cfg: Any,
+    def __init__(self, params: Any, cfg: Any, mesh: Optional[Any] = None,
                  kv_page: Optional[int] = None,
                  kv_pool_blocks: Optional[int] = None):
-        self.params = params
         self.cfg = cfg
+        self.mesh = mesh
         self.max_context = cfg.max_seq
         _init_paged_attrs(self, kv_page, kv_pool_blocks)
+        if mesh is None:
+            self.params = params
+        else:
+            from vtpu.parallel.sharding import shard_moe_params
+
+            _validate_serving_mesh(mesh, cfg)
+            self.params = shard_moe_params(params, mesh, cfg.n_experts)
 
     def init_state(self, slots: int):
         from vtpu.models.transformer import init_kv_cache
 
         if self.kv_page is not None:
             return _init_paged_state(self, slots)
-        return init_kv_cache(self.cfg, slots)
+        if self.mesh is None:
+            return init_kv_cache(self.cfg, slots)
+        from vtpu.models.transformer import kv_quantized
+        from vtpu.parallel.sharding import kv_cache_shardings
+
+        # same direct-sharded allocation as the dense family: never
+        # materialize a multi-chip cache unsharded
+        return jax.jit(
+            lambda: init_kv_cache(self.cfg, slots),
+            out_shardings=kv_cache_shardings(
+                self.mesh, quantized=kv_quantized(self.cfg)),
+        )()
 
     def prefill_into_slot(self, params, state, padded, slot, true_len):
         from vtpu.models.moe import moe_prefill
@@ -247,10 +326,13 @@ class MoeSlotModel:
 
         # Forward true_len so pads are masked out of routing and capacity
         # follows the cf formula instead of the full bucket (moe_prefill).
-        return prefill_into_slot(
-            params, self.cfg, state, padded, slot, true_len,
+        logits, new = prefill_into_slot(
+            params, self.cfg, _constrain_paged(self, state), padded, slot,
+            true_len,
             prefill_fn=lambda p, c, t: moe_prefill(p, c, t, true_len=true_len),
+            mesh=self.mesh,
         )
+        return logits, _constrain_paged(self, new)
 
     def prefill_into_slots(self, params, state, padded, slots, true_lens):
         from vtpu.models.moe import moe_prefill
@@ -259,32 +341,37 @@ class MoeSlotModel:
         # moe_prefill natively takes [B] true_len (per-row routing masks);
         # the full [N, bucket, vocab] logits come back and the engine
         # gathers the final positions (rank-3 path)
-        return prefill_into_slots(
-            params, self.cfg, state, padded, slots, true_lens,
+        logits, new = prefill_into_slots(
+            params, self.cfg, _constrain_paged(self, state), padded, slots,
+            true_lens,
             prefill_fn=lambda p, c, t: moe_prefill(p, c, t, true_len=true_lens),
+            mesh=self.mesh,
         )
+        return logits, _constrain_paged(self, new)
 
     def decode_step(self, params, state, tokens, active, kv_bucket,
                     unroll=False):
         from vtpu.models.moe import moe_decode_ffn
         from vtpu.serving.engine import batched_decode_step
 
-        return batched_decode_step(
-            cfg=self.cfg, params=params, cache=state, tokens=tokens,
-            active=active, kv_bucket=kv_bucket,
-            ffn_fn=moe_decode_ffn(self.cfg), unroll=unroll,
+        logits, new = batched_decode_step(
+            cfg=self.cfg, params=params, cache=_constrain_paged(self, state),
+            tokens=tokens, active=active, kv_bucket=kv_bucket,
+            ffn_fn=moe_decode_ffn(self.cfg), unroll=unroll, mesh=self.mesh,
         )
+        return logits, _constrain_paged(self, new)
 
     def spec_step(self, params, state, draft, active, cap, kv_bucket,
                   unroll=False):
         from vtpu.models.moe import moe_decode_ffn
         from vtpu.serving.engine import batched_spec_step
 
-        return batched_spec_step(
-            cfg=self.cfg, params=params, cache=state, draft=draft,
-            active=active, cap=cap, kv_bucket=kv_bucket,
-            ffn_fn=moe_decode_ffn(self.cfg), unroll=unroll,
+        pred, count, new = batched_spec_step(
+            cfg=self.cfg, params=params, cache=_constrain_paged(self, state),
+            draft=draft, active=active, cap=cap, kv_bucket=kv_bucket,
+            ffn_fn=moe_decode_ffn(self.cfg), unroll=unroll, mesh=self.mesh,
         )
+        return pred, count, _constrain_paged(self, new)
 
     def prefill_chunk_into_slot(self, params, state, chunk, slot, offset,
                                 new_len, kv_bucket=0, unroll=False,
@@ -294,11 +381,13 @@ class MoeSlotModel:
 
         # moe_decode_ffn's capacity >= tokens guarantee covers chunk pads
         # the same way it covers retired slots' garbage: nothing can drop
-        return chunked_prefill_into_slot(
-            params, self.cfg, state, chunk, slot, offset, new_len,
-            kv_bucket=kv_bucket, unroll=unroll, ffn_fn=moe_decode_ffn(self.cfg),
-            block_ids=block_ids,
+        logits, new = chunked_prefill_into_slot(
+            params, self.cfg, _constrain_paged(self, state), chunk, slot,
+            offset, new_len, kv_bucket=kv_bucket, unroll=unroll,
+            ffn_fn=moe_decode_ffn(self.cfg), block_ids=block_ids,
+            mesh=self.mesh,
         )
+        return logits, _constrain_paged(self, new)
 
 
 class SsmSlotModel:

@@ -302,6 +302,7 @@ def batched_decode_step(
     kv_bucket: int = 0,
     ffn_fn=None,
     unroll: bool = False,
+    mesh=None,
 ) -> tuple[jax.Array, dict[str, jax.Array]]:
     """One decode tick for the whole slot pool.
 
@@ -316,6 +317,12 @@ def batched_decode_step(
     wastes bandwidth proportional to max_seq / actual length, so the engine
     passes the smallest bucket covering its longest live sequence. Writes
     still target the full cache — only the read view shrinks.
+
+    ``mesh`` (paged caches under tensor-parallel serving) threads down to
+    the trunk so page gathers stay chip-local on the head shard; the paged
+    scatter below is head-sharded by propagation (blk_w/off index the
+    replicated block/page axes, the written values carry the q/k/v column
+    shard).
     """
     b = tokens.shape[0]
     lens = cache["len"]
@@ -381,7 +388,7 @@ def batched_decode_step(
 
     logits, new_kv = decode_layer_loop(
         params, cfg, cache, tokens, kv_bucket, write_kv, ffn_fn=ffn_fn,
-        unroll=unroll,
+        unroll=unroll, mesh=mesh,
     )
     return logits, {**new_kv, "len": jnp.where(active, lens + 1, lens)}
 
@@ -396,6 +403,7 @@ def batched_spec_step(
     kv_bucket: int = 0,
     ffn_fn=None,
     unroll: bool = False,
+    mesh=None,
 ) -> tuple[jax.Array, jax.Array, dict[str, jax.Array]]:
     """One speculative tick for the slot pool: verify a [B, T] draft chunk
     (column 0 is each slot's pending next token, columns 1..T-1 the
@@ -461,7 +469,7 @@ def batched_spec_step(
 
     logits, new_kv = spec_verify_loop(
         params, cfg, cache, draft, kv_bucket, write_kv, ffn_fn=ffn_fn,
-        unroll=unroll,
+        unroll=unroll, mesh=mesh,
     )
     pred = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, T]
     match = (draft[:, 1:] == pred[:, :-1]).astype(jnp.int32)
@@ -482,6 +490,7 @@ def chunked_prefill_into_slot(
     ffn_fn=None,
     unroll: bool = False,
     block_ids: Optional[jax.Array] = None,
+    mesh=None,
 ) -> tuple[jax.Array, dict[str, jax.Array]]:
     """One [1, C] prompt chunk written into *slot* at positions
     offset..offset+C-1: prefill as a sequence of fixed-size chunk forwards
@@ -520,6 +529,10 @@ def chunked_prefill_into_slot(
     ``slot`` may then be out of range (the engine passes the slot count as
     a sentinel): the final length write uses mode="drop", so a prefix
     build never touches any live slot's length.
+
+    ``mesh`` (paged pools under tensor parallelism): the gathered window
+    view and the page scatter-back are pinned to the pool's head shard —
+    the per-chunk pool traffic stays chip-local exactly like decode's.
     """
     c = chunk.shape[1]
     bucket = kv_bucket or cfg.max_seq
@@ -534,6 +547,10 @@ def chunked_prefill_into_slot(
             g = pool[:, block_ids]  # [L, Wp, page, ...]
             view[key] = g.reshape(
                 (pool.shape[0], 1, wp * page) + pool.shape[3:])
+        if mesh is not None:
+            from vtpu.parallel.sharding import constrain_paged_kv
+
+            view = constrain_paged_kv(view, mesh)
     else:
         view = {
             key: jax.lax.dynamic_slice(
@@ -563,7 +580,7 @@ def chunked_prefill_into_slot(
 
     logits, new_view = spec_verify_loop(
         params, cfg, view, chunk, bucket, write_kv, ffn_fn=ffn_fn,
-        unroll=unroll,
+        unroll=unroll, mesh=mesh,
     )
     out = dict(cache)
     if block_ids is not None:
@@ -611,6 +628,7 @@ def _scatter_prefill_pages(
     slots: jax.Array,
     true_lens: jax.Array,
     s: int,
+    mesh=None,
 ) -> tuple[jax.Array, dict[str, jax.Array]]:
     """Install N freshly-prefilled rows into a PAGED pool: the dense
     [L, N, s, ...] per-row KV reshapes to page granularity and scatters
@@ -618,7 +636,10 @@ def _scatter_prefill_pages(
     engine's reservation BEFORE the admission dispatch). Unmapped window
     entries are the null block 0 — pad pages beyond a short reservation
     land there, invisible under the length masks. Returns the last-
-    position logits [N, vocab] and the updated pool (len = true_lens)."""
+    position logits [N, vocab] and the updated pool (len = true_lens).
+    ``mesh``: head-sharded pool — the freshly-prefilled rows already carry
+    the head shard (q/k/v column split), so the page scatter is chip-local;
+    the constraint pins the updated pool to its allocation layout."""
     page = cache["k"].shape[2]
     wp = s // page
     blk = cache["table"][slots, :wp]  # [N, Wp]
@@ -631,6 +652,10 @@ def _scatter_prefill_pages(
             (pool.shape[0], slots.shape[0], wp, page) + pool.shape[3:])
         new_cache[key] = pool.at[:, blk].set(pages)
     new_cache["len"] = cache["len"].at[slots].set(true_lens)
+    if mesh is not None:
+        from vtpu.parallel.sharding import constrain_paged_kv
+
+        new_cache = constrain_paged_kv(new_cache, mesh)
     if logits.ndim == 2:
         last = logits  # prefill_fn already gathered the final positions
     else:
@@ -675,6 +700,7 @@ def prefill_into_slot(
     slot: jax.Array,
     true_len: jax.Array,
     prefill_fn=None,
+    mesh=None,
 ) -> tuple[jax.Array, dict[str, jax.Array]]:
     """Prefill a [1, bucket] (right-padded) prompt and install it in *slot*.
 
@@ -692,7 +718,7 @@ def prefill_into_slot(
     if "table" in cache:
         last, new_cache = _scatter_prefill_pages(
             cache, seq_cache, logits, jnp.asarray(slot)[None],
-            jnp.asarray(true_len)[None], s)
+            jnp.asarray(true_len)[None], s, mesh=mesh)
         return last[0], new_cache
     for key in ("k", "v", "k_scale", "v_scale"):
         if key in cache:
@@ -710,6 +736,7 @@ def prefill_into_slots(
     slots: jax.Array,
     true_lens: jax.Array,
     prefill_fn=None,
+    mesh=None,
 ) -> tuple[jax.Array, dict[str, jax.Array]]:
     """Batched admission: prefill N right-padded [N, bucket] prompts in ONE
     dispatch and scatter each row's KV into its own slot — a K-prompt
@@ -729,7 +756,7 @@ def prefill_into_slots(
     s = tokens.shape[1]
     if "table" in cache:
         return _scatter_prefill_pages(
-            cache, seq_cache, logits, slots, true_lens, s)
+            cache, seq_cache, logits, slots, true_lens, s, mesh=mesh)
     new_cache = dict(cache)
     for key in ("k", "v", "k_scale", "v_scale"):
         if key in cache:
@@ -1325,7 +1352,10 @@ class ServingEngine:
         """AOT-compile the per-padded-length install executable HERE, on the
         registering caller's thread (jax.jit's own shape-keyed cache would
         compile lazily inside the serving loop instead, stalling live
-        streams mid-serving)."""
+        streams mid-serving). Under a tp mesh the avals carry the live
+        arrays' NamedShardings — an executable lowered from bare shapes
+        would compile single-device and reject the sharded state at its
+        first (mid-serving) call."""
         if pad in self._install_jits:
             return
 
@@ -1336,8 +1366,15 @@ class ServingEngine:
             out["len"] = state["len"].at[slot].set(new_len)
             return out
 
-        shape_of = lambda t: jax.tree_util.tree_map(  # noqa: E731
-            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), t)
+        from jax.sharding import NamedSharding
+
+        def aval(x):
+            sh = getattr(x, "sharding", None)
+            if isinstance(sh, NamedSharding):
+                return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sh)
+            return jax.ShapeDtypeStruct(x.shape, x.dtype)
+
+        shape_of = lambda t: jax.tree_util.tree_map(aval, t)  # noqa: E731
         self._install_jits[pad] = (
             jax.jit(install, donate_argnums=(0,))
             .lower(shape_of(self.state), shape_of(buffers),
@@ -2106,12 +2143,23 @@ class ServingEngine:
         bpt = (kv_bytes_per_token(cfg)
                if cfg is not None and hasattr(cfg, "head_dim") else None)
         ctx = self.model.max_context
+        # Under a tp mesh the cache/pool shards its head axis, so each chip
+        # holds 1/tp of the global bytes — and the per-container
+        # TPU_DEVICE_MEMORY_LIMIT_<i> cap the operator sizes against is a
+        # PER-CHIP number. kv_hbm_bytes therefore reports per-chip bytes
+        # under a mesh (global == per-chip on one chip, so the single-chip
+        # figures are unchanged); kv_hbm_bytes_per_chip carries the same
+        # numbers explicitly for audits that must not care about the mesh.
+        mesh = getattr(self.model, "mesh", None)
+        tp = int(mesh.shape.get("tp", 1)) if mesh is not None else 1
+        s["tp"] = tp
         s["kv_hbm_bytes"] = {
-            "dense": (self.serving.slots * ctx * bpt
+            "dense": (self.serving.slots * ctx * bpt // tp
                       if bpt and ctx else None),
-            "paged": (self._n_blocks * self._page * bpt
+            "paged": (self._n_blocks * self._page * bpt // tp
                       if self._paged and bpt else None),
         }
+        s["kv_hbm_bytes_per_chip"] = dict(s["kv_hbm_bytes"])
         if self._paged:
             usable = self._n_blocks - 1  # minus the reserved null block
             free = self._alloc.free_blocks
