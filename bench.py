@@ -295,7 +295,17 @@ def tenant_main(a: argparse.Namespace) -> None:
                 "kv_bucket_hist", "kv_hbm_bytes", "kv_hbm_bytes_per_chip",
                 "tp", "paged",
                 "kv_pool_occupancy", "pool_blocked_admissions",
-                "prefix_blocks_shared", "prefix_install_copies")},
+                "prefix_blocks_shared", "prefix_install_copies",
+                # KV overcommit: pool high-water vs capacity, parked
+                # population, host-tier swap traffic, and the faults the
+                # recompute path absorbed — the counters the ROADMAP's
+                # oversubscription story is audited by
+                "kv_pool_used_hwm", "parked_sessions", "kv_swap",
+                "parks", "resumes", "evicted_blocks",
+                "swap_out_bytes", "swap_in_bytes",
+                "swap_faults", "fault_recomputes",
+                "pool_blocked_resumes",
+                "swap_host_blocks", "swap_host_free")},
         }), flush=True)
     eng.stop()
     if os.environ.get("VTPU_BENCH_REGISTER") == "1":

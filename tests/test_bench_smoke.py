@@ -109,6 +109,40 @@ def test_paged_kv_bench_quick_tp2_iteration():
     assert summary["summary"] and summary["prefix_zero_copy"]
 
 
+def test_overcommit_bench_help_parses():
+    r = _run([str(ROOT / "benchmarks" / "overcommit_bench.py"), "--help"])
+    assert r.returncode == 0, r.stderr
+    assert "--quick" in r.stdout and "--ratios" in r.stdout
+
+
+def test_overcommit_bench_quick_small_iteration():
+    """overcommit_bench --quick at smoke scale: 4x oversubscription end to
+    end — every parked-then-resumed stream token-equal to the
+    unconstrained reference, BOTH restore paths exercised (nonzero swap
+    bytes and fault recomputes), and the decode tick transfer contract
+    intact (the swap path performs no fetch on the tick path). The resume
+    latency itself is asserted by the bench's own full-run gate, not by
+    this noisy-CI smoke."""
+    r = _run([str(ROOT / "benchmarks" / "overcommit_bench.py"), "--quick",
+              "--slots", "2", "--prompt-len", "8", "--max-new", "8",
+              "--ratios", "4"])
+    assert r.returncode == 0, r.stderr
+    lines = [ln for ln in r.stdout.splitlines() if ln.strip()]
+    artifact = json.loads(lines[0])
+    summary = json.loads(lines[-1])
+    assert artifact["metric"] == "kv_overcommit_resume_p99_ms_at_top_ratio"
+    row = artifact["sweep"][-1]
+    assert row["ratio"] == 4
+    assert row["parked_pages_total"] >= 4 * row["pool_blocks"]
+    assert row["token_equal_vs_unconstrained"]
+    assert row["all_sessions_complete"]
+    assert row["swap_out_bytes"] > 0 and row["swap_in_bytes"] > 0
+    assert row["fault_recomputes"] > 0
+    assert row["device_gets_per_tick"] == 1.0
+    assert row["resume_p99_ms"] is not None
+    assert summary["summary"] and summary["verdict"] == "pass"
+
+
 def test_decode_bench_quick_two_slot_iteration():
     r = _run([str(ROOT / "benchmarks" / "decode_bench.py"), "--quick",
               "--slots", "2", "--steps", "8", "--waves", "1",

@@ -5,6 +5,7 @@ from vtpu.serving.engine import (
     Request,
     ServingConfig,
     ServingEngine,
+    WaitQueue,
     batched_decode_step,
     prefill_into_slot,
     prefill_into_slots,
@@ -15,6 +16,7 @@ __all__ = [
     "Request",
     "ServingConfig",
     "ServingEngine",
+    "WaitQueue",
     "batched_decode_step",
     "prefill_into_slot",
     "prefill_into_slots",

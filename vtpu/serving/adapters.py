@@ -89,6 +89,52 @@ def batched_admission_step(model: Any, temperature: float, top_k: int,
     return step
 
 
+def swap_page_gather(model: Any):
+    """KV-overcommit D2H staging source: gather up to W pool blocks (ids
+    [W] int32, padded with the null block 0) into a contiguous snapshot —
+    one plane dict of [L, W, page, ...] arrays, a fresh buffer independent
+    of the pool, so the engine can release (and even re-use) the blocks the
+    same tick while copy_to_host_async drains the snapshot. Under a tp mesh
+    the snapshot is constrained to the pool's head shard: the gather is
+    chip-local and the host copy that follows is the per-chip shard
+    transfer. Family-agnostic — the planes come from the state itself."""
+
+    def gather(state, ids):
+        out = {}
+        for key in ("k", "v", "k_scale", "v_scale"):
+            if key not in state:
+                continue
+            g = state[key][:, ids]  # [L, W, page, ...]
+            if model.mesh is not None:
+                from vtpu.parallel.sharding import head_sharding
+
+                g = jax.lax.with_sharding_constraint(
+                    g, head_sharding(
+                        model.mesh, g.ndim,
+                        -2 if key in ("k", "v") else -1))
+            out[key] = g
+        return out
+
+    return gather
+
+
+def swap_page_scatter(model: Any):
+    """KV-overcommit H2D staging sink: scatter W staged blocks (the same
+    [L, W, page, ...] plane dict the gather produced, uploaded from the
+    pinned host pool) back into pool blocks *ids* (padded ids write the
+    always-masked null block). The pool state is donated by the engine's
+    jit and pinned back to its head shards on exit, so a swap-in can never
+    drift the pool through an unsharded layout."""
+
+    def scatter(state, ids, pages):
+        out = dict(state)
+        for key, val in pages.items():
+            out[key] = state[key].at[:, ids].set(val)
+        return _constrain_paged(model, out)
+
+    return scatter
+
+
 class TransformerSlotModel:
     """Dense transformer with a slot-pooled KV cache (vtpu/models/transformer).
 

@@ -165,6 +165,33 @@ class ServingConfig:
     # materially more concurrent slots, and the free-list backpressure
     # absorbs the tail instead of an allocator failure.
     kv_pool_blocks: Optional[int] = None
+    # --- KV overcommit (eviction + host-RAM swap + recompute-on-fault) ---
+    # kv_swap (host swap tier capacity, in BLOCKS; None = overcommit off,
+    # bit-identical to the plain paged pool) turns pool exhaustion into
+    # backpressure-WITH-EVICTION: park(request) takes a conversation out
+    # of the decode batch while its pages stay pool-resident, and when an
+    # admission (or a resume) would otherwise park on the free list, the
+    # engine evicts parked sessions' PRIVATE pages — lowest QoS priority
+    # first, least-recently-parked within a priority — spilling them to a
+    # preallocated pinned host pool via async D2H (the gather snapshot is
+    # dispatched and the host copy completes off the tick path; the tick
+    # loop never blocks on a swap transfer). resume(request) swaps the
+    # pages back with async H2D and remaps the slot's table row before the
+    # slot re-enters the decode batch. Blocks with live decode mappings or
+    # shared prefix refcounts (> 1) are never evicted. kv_swap=0 is legal:
+    # no host tier — every eviction drops the pages and resume rebuilds
+    # the KV through the prefill path (recompute-only overcommit).
+    kv_swap: Optional[int] = None
+    # D2H/H2D staging width in blocks: one compiled gather/scatter shape
+    # moves up to this many blocks per dispatch (entries larger than the
+    # stage issue multiple dispatches — still async, still compile-once).
+    kv_swap_stage_blocks: int = 8
+    # Recompute-vs-swap crossover, in cached tokens: a resuming session at
+    # or under this length rebuilds its KV through the (chunked) prefill
+    # path even when its host pages exist — re-prefilling a short sequence
+    # is cheaper than a swap-in round trip. 0 = recompute only on a fault
+    # (pages dropped because the host tier was full).
+    kv_swap_recompute_tokens: int = 0
 
 
 def choose_kv_int8(slots: int, max_window: int) -> bool:
@@ -214,12 +241,20 @@ class BlockAllocator:
         # pool pages are the likeliest still resident in any cache level)
         self._free = list(range(n_blocks - 1, 0, -1))
         self._ref = [0] * n_blocks
+        self._min_free = n_blocks - 1  # lifetime low-water of the free list
         self._lock = threading.Lock()
 
     @property
     def free_blocks(self) -> int:
         with self._lock:
             return len(self._free)
+
+    @property
+    def used_hwm(self) -> int:
+        """Lifetime high-water mark of simultaneously-allocated blocks —
+        the pool-sizing number an operator tunes kv_pool_blocks against."""
+        with self._lock:
+            return self.n_blocks - 1 - self._min_free
 
     def alloc(self, n: int) -> Optional[list[int]]:
         """n fresh blocks at refcount 1, or None (all-or-nothing) when the
@@ -231,6 +266,8 @@ class BlockAllocator:
             out = [self._free.pop() for _ in range(n)]
             for b in out:
                 self._ref[b] = 1
+            if len(self._free) < self._min_free:
+                self._min_free = len(self._free)
             return out
 
     def share(self, blocks: list[int]) -> None:
@@ -261,6 +298,70 @@ class BlockAllocator:
             return self._ref[block]
 
 
+class WaitQueue:
+    """FIFO admission queue built for park/resume churn at oversubscription
+    scale: a deque plus a live-membership set, so removal from anywhere in
+    the line is an O(1) tombstone (set discard) instead of the old list's
+    O(n) ``remove`` scan, and the repeated ``pop(0)`` head pops stay O(1)
+    amortized (tombstoned heads compact lazily). Requests compare by
+    IDENTITY (dataclass eq=False keeps object.__hash__), so membership is
+    identity membership — the same semantics the list version's ``is``-based
+    lifecycle relied on. Iteration yields live entries in FIFO order off a
+    snapshot, so callers may tombstone entries mid-iteration (the batch
+    coalescing path does exactly that). Single-thread (serving loop) use."""
+
+    __slots__ = ("_q", "_live")
+
+    def __init__(self):
+        self._q: "collections.deque" = collections.deque()
+        self._live: set = set()
+
+    def append(self, req) -> None:
+        self._q.append(req)
+        self._live.add(req)
+
+    def remove(self, req) -> None:
+        """Tombstone *req* wherever it sits in the line (O(1))."""
+        self._live.discard(req)
+
+    def _compact(self) -> None:
+        q = self._q
+        while q and q[0] not in self._live:
+            q.popleft()
+
+    def head(self):
+        """The oldest live entry, or None (does not pop)."""
+        self._compact()
+        return self._q[0] if self._q else None
+
+    def popleft(self):
+        self._compact()
+        req = self._q.popleft()
+        self._live.discard(req)
+        return req
+
+    def clear(self) -> None:
+        self._q.clear()
+        self._live.clear()
+
+    def __contains__(self, req) -> bool:
+        return req in self._live
+
+    def __len__(self) -> int:
+        return len(self._live)
+
+    def __iter__(self):
+        # dedupe: remove-then-append (the park-waiting/resume cycle)
+        # leaves a stale copy in the deque alongside the re-added live
+        # one; yielding it twice would let batch coalescing admit one
+        # request into two slots
+        seen = set()
+        for r in list(self._q):
+            if r in self._live and r not in seen:
+                seen.add(r)
+                yield r
+
+
 @dataclasses.dataclass(eq=False)
 class Request:
     # eq=False: requests compare by IDENTITY. The engine's lifecycle checks
@@ -271,6 +372,11 @@ class Request:
     tokens: Any  # [S] int32 prompt (the SUFFIX when prefix is set)
     max_new_tokens: int = 0  # 0: serving config default
     prefix: Optional[int] = None  # id from ServingEngine.register_prefix
+    # QoS tier for the overcommit eviction policy: when the pool runs dry,
+    # parked sessions evict lowest priority first (LRU within a tier) — a
+    # priority-0 batch conversation spills to host RAM before a priority-9
+    # interactive one does
+    priority: int = 0
     out: "queue.Queue[Optional[int]]" = dataclasses.field(default_factory=queue.Queue)
     cancelled: bool = False
     # per-token log p under the engine's sampling distribution, appended at
@@ -1068,11 +1174,107 @@ class ServingEngine:
             self._alloc = None
             self._slot_blocks = [[] for _ in range(b)]
             self._prefix_work = None
+        # leading blocks of each slot's table row that are SHARED prefix
+        # mappings (refcounts held elsewhere too) — the split the overcommit
+        # eviction policy needs: only a slot's private tail is ever swapped
+        self._slot_shared = [0] * b
+        # --- KV overcommit: eviction + host swap tier + park/resume ------
+        self._swap_enabled = serving.kv_swap is not None
+        if self._swap_enabled and not self._paged:
+            raise ValueError(
+                "kv_swap requires the paged pool (set kv_page): the dense "
+                "ring has no block granularity to evict or swap")
+        # park/resume commands from client threads, drained by the loop;
+        # _wake lets an idle loop block on BOTH queues at once (submit and
+        # park/resume set it after enqueueing) — no busy-poll while parked
+        self._lifecycle_q: "queue.Queue[tuple[str, Request]]" = queue.Queue()
+        self._wake = threading.Event()
+        self._want_park: set = set()
+        # park commands whose request was found nowhere for one pass (see
+        # _process_lifecycle: may still be in _pending — grace of one tick)
+        self._park_unseen: set = set()
+        self._want_resume: list[Request] = []
+        # parked sessions, insertion-ordered (= park order, the LRU axis);
+        # each entry owns its blocks/host pages until resume or cancel
+        self._parked: "collections.OrderedDict[Request, dict]" = (
+            collections.OrderedDict())
+        self._park_seq = 0
+        self._swap_pending: list[dict] = []  # entries with in-flight D2H
+        if self._swap_enabled:
+            stage = max(int(serving.kv_swap_stage_blocks), 1)
+            self._swap_stage = stage
+            self._swap_planes = tuple(
+                key for key in ("k", "v", "k_scale", "v_scale")
+                if key in self.state)
+            # the pinned host pool: one [L, kv_swap, page, ...] plane per
+            # KV plane, preallocated ONCE (numpy host memory stands in for
+            # pinned buffers on the CPU rig) + a host-block free list
+            self._swap_host_blocks = int(serving.kv_swap)
+            self._host_pool = {
+                key: np.zeros(
+                    (self.state[key].shape[0], self._swap_host_blocks)
+                    + tuple(self.state[key].shape[2:]),
+                    self.state[key].dtype)
+                for key in self._swap_planes
+            } if self._swap_host_blocks else {}
+            self._host_free = list(range(self._swap_host_blocks))
+            # bytes one pool block holds across layers/planes (global — the
+            # unit swap_out_bytes/swap_in_bytes are denominated in)
+            self._block_bytes = sum(
+                int(np.prod((self.state[key].shape[0],)
+                            + tuple(self.state[key].shape[2:])))
+                * self.state[key].dtype.itemsize
+                for key in self._swap_planes)
+            from vtpu.serving.adapters import (
+                swap_page_gather, swap_page_scatter)
+
+            # compile-once staging ops: gather W blocks into a contiguous
+            # snapshot (the async-D2H source) / scatter W staged blocks
+            # back into the pool (the async-H2D sink); ids pad with the
+            # null block 0, whose reads are always masked and whose writes
+            # are the established junk sink. kv_swap=0 (recompute-only
+            # tier) can never spill or swap in, so it skips both compiles.
+            if self._swap_host_blocks:
+                self._swap_gather = jax.jit(swap_page_gather(model))
+                self._swap_scatter = jax.jit(
+                    swap_page_scatter(model), donate_argnums=(0,))
+            else:
+                self._swap_gather = None
+                self._swap_scatter = None
+            # an explicitly-passed adapter carries its own mesh; the ctor
+            # arg only covers the default-constructed transformer
+            mesh = getattr(model, "mesh", mesh)
+            if mesh is not None and self._swap_host_blocks:
+                from vtpu.parallel.sharding import head_sharding
+
+                # H2D staging lands PRE-SHARDED on the head axis, so the
+                # upload is the per-chip shard transfer, never a
+                # replicate-then-reshard round trip
+                self._stage_shardings = {
+                    key: head_sharding(
+                        mesh, self.state[key].ndim,
+                        -2 if key in ("k", "v") else -1)
+                    for key in self._swap_planes
+                }
+            else:
+                self._stage_shardings = {}
+        else:
+            self._swap_stage = 0
+            self._swap_planes = ()
+            self._swap_host_blocks = 0
+            self._host_pool = {}
+            self._host_free = []
+            self._block_bytes = 0
+            self._swap_gather = None
+            self._swap_scatter = None
+            self._stage_shardings = {}
         self._pending: "queue.Queue[Request]" = queue.Queue()
         # requests pulled off the queue but not yet admitted (budget-
         # deferred or waiting for a free slot); FIFO except that same-bucket
-        # prompts coalesce into one batched prefill dispatch
-        self._waiting: list[Request] = []
+        # prompts coalesce into one batched prefill dispatch. WaitQueue:
+        # O(1) tombstone removal, so park/resume churn at oversubscription
+        # scale never turns admission quadratic.
+        self._waiting: WaitQueue = WaitQueue()
         self._slot_req: list[Optional[Request]] = [None] * b
         self._slot_budget = [0] * b
         self._tokens = [0] * b  # next token per slot (host-side)
@@ -1080,6 +1282,11 @@ class ServingEngine:
         # per-slot token history (prompt + emitted) feeding prompt-lookup
         # drafts; only maintained while speculation is on
         self._history: list[list[int]] = [[] for _ in range(b)]
+        # whether the slot's history is an EXACT cache-contents mirror: a
+        # prefix unregistered in the admission window loses its tokens, so
+        # that slot pads placeholders (swap still works — content-based)
+        # but must never be rebuilt from history (recompute-on-fault off)
+        self._slot_hist_exact = [True] * b
         # slots mid-chunked-admission: slot -> {req, padded, n, off, base};
         # the loop advances one chunk per iteration between decode ticks
         self._admitting: dict[int, dict] = {}
@@ -1090,6 +1297,11 @@ class ServingEngine:
         # array and the (slot, req, row-index) rows the next batched fetch
         # delivers (the dispatch-side copies live in _admit_buf/_admit_mask)
         self._pending_firsts: list[dict] = []
+        # slots with a dispatched-but-undelivered tick (pipelined loop
+        # lookahead): a park must wait until its slot leaves this set, or
+        # the in-flight token would be lost and the saved length would lag
+        # the device
+        self._inflight_slots: set = set()
         # adaptive-speculation state: the probe EMA starts a LITTLE above
         # breakeven — a fresh engine (or a re-probe) gets a handful of
         # ticks to prove itself, then shuts back off; resetting to the
@@ -1143,7 +1355,28 @@ class ServingEngine:
                        "prefix_blocks_shared": 0,
                        "prefix_cow_copies": 0,
                        "read_pages_live": 0, "read_pages_window": 0,
-                       "read_pages_hist": {}}
+                       "read_pages_hist": {},
+                       # KV overcommit: parks/resumes are lifecycle events;
+                       # evicted_blocks counts pool blocks reclaimed from
+                       # parked sessions; swap_out/in_bytes are the D2H/H2D
+                       # traffic through the host tier; swap_faults counts
+                       # resumes whose pages were NOT pool-resident (the
+                       # restore had to swap in or recompute);
+                       # fault_recomputes is the subset rebuilt through the
+                       # prefill path (pages dropped, or under the
+                       # recompute crossover)
+                       # pool_blocked_resumes: per-tick retries of a
+                       # resume the pool could not yet cover — kept apart
+                       # from pool_blocked_admissions so resume
+                       # backpressure never reads as admission blocking
+                       "parks": 0, "resumes": 0, "evicted_blocks": 0,
+                       "swap_out_bytes": 0, "swap_in_bytes": 0,
+                       "swap_faults": 0, "fault_recomputes": 0,
+                       "pool_blocked_resumes": 0}
+        # per-slot token history (prompt + emitted) is maintained for
+        # speculation drafts AND for overcommit (a parked session's cache
+        # contents must be recomputable from tokens when its pages fault)
+        self._track_history = bool(self._spec_tokens or self._swap_enabled)
         # EMA of host bookkeeping ms per delivered tick (the Python work the
         # pipelined loop hides under the next dispatch)
         self._host_ms_ema: Optional[float] = None
@@ -1266,7 +1499,10 @@ class ServingEngine:
         loop via the _prefix_work queue, or the caller before start()."""
         page, c = self._page, self._chunk
         pages = -(-pad // page)
-        blocks = self._alloc.alloc(pages)
+        # runs on the pool owner's thread, so the overcommit reclaim is
+        # safe here too: a prefix registration under parked pressure
+        # evicts idle sessions before failing
+        blocks = self._alloc_reclaim(pages)
         if blocks is None:
             # registration is an admin op: fail loudly rather than park —
             # parking a prefix build behind tenant traffic would deadlock
@@ -1393,7 +1629,7 @@ class ServingEngine:
             jnp.int32(entry["len"]))
 
     def submit(self, tokens, max_new_tokens: int = 0,
-               prefix: Optional[int] = None) -> Request:
+               prefix: Optional[int] = None, priority: int = 0) -> Request:
         if self._stop.is_set():
             raise RuntimeError("ServingEngine is stopped")
         if self._thread is None:
@@ -1448,8 +1684,10 @@ class ServingEngine:
         else:
             self._bucket(int(tokens.shape[0]))
         req = Request(tokens=tokens, prefix=prefix,
-                      max_new_tokens=max_new_tokens or self.serving.max_new_tokens)
+                      max_new_tokens=max_new_tokens or self.serving.max_new_tokens,
+                      priority=priority)
         self._pending.put(req)
+        self._wake.set()
         if self._stop.is_set():
             # raced with stop(): its drain may have missed this request; an
             # extra end-of-stream sentinel is harmless, a missing one hangs
@@ -1457,12 +1695,43 @@ class ServingEngine:
             req.out.put(None)
         return req
 
+    def park(self, req: Request) -> None:
+        """Take a live request out of the decode batch without ending its
+        stream: token production pauses, the slot frees for other traffic,
+        and the session's KV pages stay pool-resident until admission
+        pressure evicts them (host-RAM swap, or drop + recompute-on-fault).
+        Thread-safe and asynchronous: the serving loop performs the park at
+        the next tick boundary where the slot has no in-flight token, so a
+        token already dispatched is still delivered — a park never loses or
+        reorders stream tokens. Parking a request still waiting for
+        admission defers it (resume re-queues it); parking a finished or
+        unknown request is a no-op. Requires kv_swap (the overcommit
+        subsystem owns the parked lifecycle)."""
+        if not self._swap_enabled:
+            raise ValueError("park() requires ServingConfig.kv_swap")
+        self._lifecycle_q.put(("park", req))
+        self._wake.set()
+
+    def resume(self, req: Request) -> None:
+        """Bring a parked request back into the decode batch: its pages are
+        swapped in from the host tier (async H2D) — or its KV rebuilt
+        through the prefill path when the pages were dropped or the
+        sequence sits under the recompute crossover — its page table row is
+        remapped, and the stream continues from exactly the token after the
+        last one delivered. Thread-safe; resuming a request that is not
+        parked is a no-op."""
+        if not self._swap_enabled:
+            raise ValueError("resume() requires ServingConfig.kv_swap")
+        self._lifecycle_q.put(("resume", req))
+        self._wake.set()
+
     def start(self) -> None:
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
     def stop(self) -> None:
         self._stop.set()
+        self._wake.set()  # an idle loop notices the stop immediately
         if self._thread:
             self._thread.join(timeout=10)
             # _loop's finally owns the slot/queue cleanup; touching its state
@@ -1484,6 +1753,12 @@ class ServingEngine:
             adm["req"].out.put(None)
             self._free_slot_blocks(slot)
         self._admitting.clear()
+        for req in list(self._parked):
+            self._release_parked(self._parked.pop(req))
+            req.out.put(None)
+        self._want_park.clear()
+        self._park_unseen.clear()
+        self._want_resume.clear()
         if self._paged:
             # callers blocked in register_prefix must observe an error,
             # not hang on a loop that will never drain their work item
@@ -1530,6 +1805,7 @@ class ServingEngine:
         if self._paged and self._slot_blocks[slot]:
             self._alloc.release(self._slot_blocks[slot])
             self._slot_blocks[slot] = []
+        self._slot_shared[slot] = 0
 
     def _reserve_paged(self, slot: int, req: Request) -> bool:
         """Pool-aware admission: map every page this request can ever touch
@@ -1571,7 +1847,10 @@ class ServingEngine:
         full = base // page  # whole prefix pages, shareable as-is
         shared = entry["blocks"][:full] if entry is not None else []
         need_priv = reserve - full
-        priv = self._alloc.alloc(need_priv) if need_priv > 0 else []
+        # overcommit: a dry free list first evicts parked sessions' private
+        # pages (QoS-then-LRU) before this admission is allowed to park —
+        # pool exhaustion is backpressure-with-eviction, not a hard park
+        priv = self._alloc_reclaim(need_priv) if need_priv > 0 else []
         if priv is None:
             self._stats["pool_blocked_admissions"] += 1
             return False
@@ -1588,10 +1867,507 @@ class ServingEngine:
                 jnp.int32(priv[0]))
             self._stats["prefix_cow_copies"] += 1
         self._slot_blocks[slot] = row_blocks
+        self._slot_shared[slot] = len(shared)
         trow = np.zeros((self._max_pages,), np.int32)
         trow[:len(row_blocks)] = row_blocks
         self.state = self._set_table_row(
             self.state, jnp.int32(slot), trow, jnp.int32(base))
+        return True
+
+    # ------------------------------------------------ KV overcommit core
+
+    def _alloc_reclaim(self, n: int, exclude: Optional[Request] = None):
+        """BlockAllocator.alloc with the overcommit extension: when the
+        free list can't cover *n*, count the RECLAIMABLE blocks (parked
+        sessions' evictable private pages) before giving up — if free +
+        reclaimable covers the request, evict until it fits and retry.
+        ``exclude`` protects the entry being resumed from evicting itself.
+        Returns the blocks or None (nothing reserved) exactly like alloc."""
+        got = self._alloc.alloc(n)
+        if got is not None or not self._swap_enabled:
+            return got
+        if self._alloc.free_blocks + self._reclaimable(exclude) < n:
+            return None
+        self._reclaim(n, exclude)
+        return self._alloc.alloc(n)
+
+    def _reclaimable(self, exclude: Optional[Request] = None) -> int:
+        return sum(
+            len(e["priv"]) for r, e in self._parked.items()
+            if r is not exclude and e["priv"] and self._evictable(e))
+
+    def _evictable(self, e: dict) -> bool:
+        """Can this parked entry's private pages leave the pool? Either the
+        host tier has room for them, or the sequence is rebuildable through
+        the prefill path (drop + recompute-on-fault). Shared prefix blocks
+        are never part of the question — they are pinned by their refcounts
+        and stay resident."""
+        return (len(e["priv"]) <= len(self._host_free)
+                or e["recompute_ok"])
+
+    def _reclaim(self, need: int, exclude: Optional[Request] = None) -> None:
+        """Evict parked sessions until the free list covers *need* blocks
+        (or nothing evictable remains). Order is QoS-then-LRU within the
+        tick: lowest Request.priority first, least-recently-parked within a
+        tier — an interactive session outlives a batch one, and among equals
+        the longest-idle spills first."""
+        # O(parked log parked) per dry-list miss: fine to the ~1e3-session
+        # scale the bench drives; a 1e5+-session deployment would keep a
+        # (priority, seq) heap plus a running reclaimable counter instead
+        # of rescanning (the WaitQueue move, applied to the parked side)
+        order = sorted(
+            (r for r, e in self._parked.items()
+             if r is not exclude and e["priv"] and self._evictable(e)),
+            key=lambda r: (self._parked[r]["priority"],
+                           self._parked[r]["seq"]))
+        for req in order:
+            if self._alloc.free_blocks >= need:
+                return
+            e = self._parked[req]
+            if not self._evictable(e):
+                # earlier evictions in this pass consumed the host room
+                # this entry's snapshot check relied on; an unrecomputable
+                # entry must stay resident, never be dropped
+                continue
+            self._evict_entry(e)
+
+    def _evict_entry(self, e: dict) -> None:
+        """Reclaim one parked session's private pages. With host-tier room
+        the pages spill: a compiled gather snapshots up to stage_blocks at a
+        time into fresh device buffers (pure async dispatch), the host copy
+        is STARTED (copy_to_host_async) and completes off the tick path
+        (_drain_swap_outs), and the pool blocks release immediately — the
+        snapshot, not the pool, feeds the host copy, so a new admission can
+        overwrite the blocks the same tick. Without room the pages drop and
+        resume recomputes (the _evictable gate guaranteed it can)."""
+        priv = e["priv"]
+        m = len(priv)
+        if m <= len(self._host_free) and self._swap_host_blocks:
+            e["host"] = [self._host_free.pop() for _ in range(m)]
+            snaps = []
+            w = self._swap_stage
+            for i in range(0, m, w):
+                grp = priv[i:i + w]
+                ids = np.zeros((w,), np.int32)
+                ids[:len(grp)] = grp
+                snap = self._swap_gather(self.state, ids)
+                for leaf in jax.tree_util.tree_leaves(snap):
+                    start = getattr(leaf, "copy_to_host_async", None)
+                    if start is not None:
+                        start()
+                snaps.append((snap, len(grp)))
+            e["pend"] = snaps
+            self._swap_pending.append(e)
+            self._stats["swap_out_bytes"] += m * self._block_bytes
+        elif e["recompute_ok"]:
+            e["dropped"] = True
+        else:
+            # neither spillable nor rebuildable: the pages MUST stay
+            # resident (dropping them would wedge the resume) — correct
+            # backpressure, enforced here as the last line even if a
+            # caller's evictability snapshot went stale
+            return
+        self._stats["evicted_blocks"] += m
+        self._alloc.release(priv)
+        e["priv"] = []
+
+    def _drain_swap_outs(self) -> None:
+        """Land completed D2H snapshots in the pinned host pool —
+        opportunistic: only snapshots whose transfers report ready, so the
+        tick path never blocks on a swap. A resume that needs its pages
+        before they report ready finalizes its own entry directly
+        (_swap_in -> _finalize_swap_out); shutdown releases pending
+        entries without landing them (_release_parked)."""
+        for e in list(self._swap_pending):
+            if not all(
+                    getattr(leaf, "is_ready", lambda: True)()
+                    for snap, _ in e["pend"]
+                    for leaf in jax.tree_util.tree_leaves(snap)):
+                continue
+            self._finalize_swap_out(e)
+
+    def _finalize_swap_out(self, e: dict) -> None:
+        off = 0
+        for snap, cnt in e["pend"]:
+            hbs = e["host"][off:off + cnt]
+            for key in self._swap_planes:
+                # one fancy-indexed copy per plane (this runs on the tick
+                # path — no per-block Python slice loop)
+                self._host_pool[key][:, hbs] = np.asarray(snap[key])[:, :cnt]
+            off += cnt
+        e["pend"] = None
+        self._swap_pending.remove(e)
+
+    def _release_parked(self, e: dict) -> None:
+        """Return EVERYTHING a parked entry owns: held prefix shares,
+        still-resident private blocks, host-tier pages, in-flight
+        snapshots. The cancel-while-parked / cancel-mid-swap / shutdown
+        sweep — nothing a dead session held may leak."""
+        if e in self._swap_pending:
+            e["pend"] = None
+            self._swap_pending.remove(e)
+        if e["shared"]:
+            self._alloc.release(e["shared"])
+            e["shared"] = []
+        if e["priv"]:
+            self._alloc.release(e["priv"])
+            e["priv"] = []
+        if e["host"] is not None:
+            self._host_free.extend(e["host"])
+            e["host"] = None
+
+    def _can_recompute(self, seq_len: int) -> bool:
+        """A sequence is rebuildable when a prefill bucket covers it or
+        chunked prefill is configured (any length up to the context)."""
+        return (any(b >= seq_len for b in self._prefill_buckets)
+                or self._prefill_chunk is not None)
+
+    def _seed_history(self, slot: int, req: Request, n: int) -> None:
+        """Seed a slot's token history as a cache-contents mirror of the
+        *n* installed positions: prefix tokens + prompt. If the prefix was
+        unregistered in the admission window its tokens are gone — under
+        overcommit the gap pads with placeholders so the length invariant
+        (_parkable) holds and the slot stays parkable, but it is flagged
+        inexact: such a session may swap (content-based) yet must never be
+        rebuilt from history."""
+        entry = (self._prefixes.get(req.prefix)
+                 if req.prefix is not None else None)
+        pre = entry["tokens"] if entry else []
+        toks = [int(x) for x in req.tokens.tolist()]
+        miss = n - len(pre) - len(toks)
+        self._slot_hist_exact[slot] = miss <= 0
+        if miss > 0 and self._swap_enabled:
+            pre = list(pre) + [0] * miss
+        self._history[slot] = list(pre) + toks
+
+    def _parkable(self, slot: int) -> bool:
+        """A slot can park once at least one token has been DELIVERED for
+        it (the pending-token invariant: history holds cache contents plus
+        exactly the one delivered-but-unwritten token) and no token is in
+        flight for it (the pipelined loop's lookahead must settle first —
+        dispatch exclusion makes that happen within one tick)."""
+        return (slot not in self._inflight_slots
+                and len(self._history[slot]) == self._slot_len[slot] + 1)
+
+    def _do_park(self, slot: int) -> None:
+        req = self._slot_req[slot]
+        nshared = self._slot_shared[slot]
+        blocks = self._slot_blocks[slot]
+        self._parked[req] = {
+            "req": req,
+            # cache contents by construction: history minus the pending
+            # token (whose KV lands only when a decode tick consumes it)
+            "tokens": list(self._history[slot][:-1]),
+            "pending": self._tokens[slot],
+            "budget": self._slot_budget[slot],
+            "seq_len": self._slot_len[slot],
+            "n_pages": len(blocks),
+            "shared": blocks[:nshared],  # refcount holds kept while parked
+            "priv": blocks[nshared:],    # evictable: this session's own KV
+            "host": None, "pend": None, "dropped": False,
+            # an inexact history (placeholder prefix tokens after an
+            # unregister race) can never rebuild this cache: swap-only
+            "recompute_ok": (self._can_recompute(self._slot_len[slot])
+                             and self._slot_hist_exact[slot]),
+            "hist_exact": self._slot_hist_exact[slot],
+            "priority": req.priority,
+            "seq": self._park_seq,
+        }
+        self._park_seq += 1
+        # free the slot WITHOUT releasing blocks (the entry owns them now);
+        # the device table row goes stale exactly like a retire's (reads
+        # masked, writes drop, overwritten wholesale at the next mapping)
+        self._slot_req[slot] = None
+        self._slot_budget[slot] = 0
+        self._slot_len[slot] = 0
+        self._slot_blocks[slot] = []
+        self._slot_shared[slot] = 0
+        self._history[slot] = []
+        self._slot_hist_exact[slot] = True
+        self._itl_last[slot] = None
+        self._admit_mask[slot] = False
+        self._stats["parks"] += 1
+
+    def _process_lifecycle(self) -> None:
+        """Drain park/resume commands from client threads and apply the
+        parks whose slots have settled; also sweep cancelled parked
+        sessions (their client walked away — everything they hold goes
+        back, exactly like a live slot's cancel)."""
+        while True:
+            try:
+                kind, req = self._lifecycle_q.get_nowait()
+            except queue.Empty:
+                break
+            if kind == "park":
+                if req in self._parked and req in self._want_resume:
+                    # park overtook a still-queued (possibly
+                    # backpressured) resume: drop the resume and leave
+                    # the session parked — symmetric with the
+                    # resume-cancels-pending-park case below
+                    self._want_resume.remove(req)
+                else:
+                    self._want_park.add(req)
+            elif req in self._want_park:
+                # resume overtook a park that never settled: they cancel
+                # out — the session just keeps decoding (dropping the
+                # resume instead would strand a parked client forever)
+                self._want_park.discard(req)
+            elif req in self._parked and req not in self._want_resume:
+                self._want_resume.append(req)
+        for req in list(self._want_park):
+            if req.cancelled or req in self._parked:
+                self._want_park.discard(req)
+                self._park_unseen.discard(req)
+                continue
+            if req in self._waiting:
+                # not yet admitted: park it unstarted — resume re-queues
+                # through normal admission, no pages to save
+                self._park_unseen.discard(req)
+                self._waiting.remove(req)
+                self._parked[req] = {
+                    "req": req, "unstarted": True, "tokens": [],
+                    "pending": None, "budget": 0, "seq_len": 0,
+                    "n_pages": 0, "shared": [], "priv": [], "host": None,
+                    "pend": None, "dropped": False, "recompute_ok": True,
+                    "hist_exact": True, "priority": req.priority,
+                    "seq": self._park_seq,
+                }
+                self._park_seq += 1
+                self._want_park.discard(req)
+                self._stats["parks"] += 1
+                continue
+            try:
+                slot = self._slot_req.index(req)
+            except ValueError:
+                # mid-chunked-admission (parks once admitted) — or nowhere
+                # to be found. "Nowhere" is ambiguous for ONE pass: the
+                # submit may still sit in _pending (put there after this
+                # tick's pending drain but before its command drain), so
+                # the command survives one miss and is only discarded on
+                # the second consecutive one — by then the next pending
+                # drain has certainly run and a vanished request is
+                # genuinely finished
+                if not any(adm["req"] is req
+                           for adm in self._admitting.values()):
+                    if req in self._park_unseen:
+                        self._want_park.discard(req)
+                        self._park_unseen.discard(req)
+                    else:
+                        self._park_unseen.add(req)
+                continue
+            self._park_unseen.discard(req)
+            if self._parkable(slot):
+                self._want_park.discard(req)
+                self._do_park(slot)
+        for req in [r for r, e in self._parked.items() if r.cancelled]:
+            self._release_parked(self._parked.pop(req))
+            req.out.put(None)
+
+    def _advance_resumes(self, budget: float = float("inf")) -> float:
+        """Bring resumed sessions back into slots, FIFO over resume order,
+        ahead of new admissions (they are older traffic). Three paths per
+        entry: still-resident pages remap in one fused table write;
+        swapped pages allocate (evicting if needed), async-H2D through the
+        staging shape, and remap; dropped pages — or sequences under the
+        recompute crossover — rebuild through the prefill path (bucketed
+        in one dispatch, chunked across ticks for long sequences). A
+        bucketed rebuild spends its bucket from the per-tick prompt-token
+        ``budget`` exactly like an admission would — a resume wave
+        degrades live streams by the configured bound, never a stall. A
+        full pool, full slot set, or spent budget leaves the entry queued
+        for the next tick: resume backpressure, never a loss. Returns the
+        remaining budget."""
+        while self._want_resume:
+            req = self._want_resume[0]
+            e = self._parked.get(req)
+            if e is None or req.cancelled:
+                # cancel raced the resume: the parked sweep (or a prior
+                # pass) already cleaned up / will clean up
+                self._want_resume.pop(0)
+                continue
+            if e.get("unstarted"):
+                self._want_resume.pop(0)
+                del self._parked[req]
+                self._waiting.append(req)
+                self._stats["resumes"] += 1
+                continue
+            slot = next(
+                (i for i in range(self.serving.slots)
+                 if self._slot_req[i] is None and i not in self._admitting),
+                None)
+            if slot is None:
+                break  # no slot to resume into: wait for a retire
+            if e["priv"]:
+                # resident fast path FIRST: pages never left the pool, so
+                # one fused table-row remap beats both restore paths — the
+                # recompute crossover only arbitrates swap-in vs rebuild,
+                # never a free remap (and recomputing here would leak the
+                # resident blocks)
+                self._finish_resume_slot(slot, e)
+            elif e["dropped"] or (
+                    e["seq_len"] <= self.serving.kv_swap_recompute_tokens
+                    and e["recompute_ok"]):
+                bkt = next((b for b in self._prefill_buckets
+                            if b >= e["seq_len"]), None)
+                if bkt is not None and bkt > budget:
+                    break  # budget spent: the rebuild waits one tick
+                if not self._begin_recompute(slot, e):
+                    break  # pool can't cover it yet: stays parked
+                if bkt is not None:
+                    budget -= bkt
+            else:
+                if not self._swap_in(slot, e):
+                    break
+            self._want_resume.pop(0)
+        return budget
+
+    def _swap_in(self, slot: int, e: dict) -> bool:
+        """Restore a swapped session: allocate private blocks (reclaiming
+        if the free list is dry — the entry itself is excluded), upload the
+        host pages through the compiled staging scatter (device_put is an
+        async H2D; under a mesh the staging lands pre-sharded on the head
+        axis so each chip uploads only its shard), remap the table row, and
+        restore the slot. No blocking host sync anywhere on this path."""
+        need = e["n_pages"] - len(e["shared"])
+        priv = self._alloc_reclaim(need, exclude=e["req"])
+        if priv is None:
+            self._stats["pool_blocked_resumes"] += 1
+            return False
+        if e["pend"] is not None:
+            self._finalize_swap_out(e)  # rare: resume raced its own D2H
+        w = self._swap_stage
+        for i in range(0, need, w):
+            grp = priv[i:i + w]
+            hgrp = e["host"][i:i + w]
+            ids = np.zeros((w,), np.int32)
+            ids[:len(grp)] = grp
+            pages = {}
+            for key in self._swap_planes:
+                buf = np.zeros(
+                    (self._host_pool[key].shape[0], w)
+                    + self._host_pool[key].shape[2:],
+                    self._host_pool[key].dtype)
+                # one fancy-indexed gather per plane — the resume-latency
+                # critical path pays no per-block Python loop
+                buf[:, :len(hgrp)] = self._host_pool[key][:, hgrp]
+                sh = self._stage_shardings.get(key)
+                pages[key] = (jax.device_put(buf, sh) if sh is not None
+                              else buf)
+            self.state = self._swap_scatter(self.state, ids, pages)
+        self._host_free.extend(e["host"])
+        e["host"] = None
+        e["priv"] = priv
+        self._stats["swap_in_bytes"] += need * self._block_bytes
+        self._stats["swap_faults"] += 1
+        self._finish_resume_slot(slot, e)
+        return True
+
+    def _finish_resume_slot(self, slot: int, e: dict) -> None:
+        """Remap a restored entry's table row and put the session back in
+        its slot: the next decode tick feeds its pending token exactly as
+        if the park never happened."""
+        row_blocks = e["shared"] + e["priv"]
+        self._slot_blocks[slot] = row_blocks
+        self._slot_shared[slot] = len(e["shared"])
+        e["shared"] = []
+        e["priv"] = []
+        trow = np.zeros((self._max_pages,), np.int32)
+        trow[:len(row_blocks)] = row_blocks
+        self.state = self._set_table_row(
+            self.state, jnp.int32(slot), trow, jnp.int32(e["seq_len"]))
+        self._restore_slot(slot, e)
+
+    def _restore_slot(self, slot: int, e: dict) -> None:
+        req = e["req"]
+        self._slot_req[slot] = req
+        self._slot_budget[slot] = e["budget"]
+        self._tokens[slot] = e["pending"]
+        self._slot_len[slot] = e["seq_len"]
+        if self._track_history:
+            self._history[slot] = list(e["tokens"]) + [e["pending"]]
+        self._slot_hist_exact[slot] = e.get("hist_exact", True)
+        self._itl_last[slot] = None  # the resume gap is not an ITL sample
+        if req in self._parked:
+            del self._parked[req]
+            self._stats["resumes"] += 1
+
+    def _begin_recompute(self, slot: int, e: dict) -> bool:
+        """Rebuild a faulted (or crossover-short) session's KV through the
+        prefill path. The whole sequence goes PRIVATE — held prefix shares
+        release and the prefix positions recompute like any others (the
+        trunk is deterministic, so the rebuilt pool content matches what
+        decode wrote). Short sequences take one bucketed dispatch (via the
+        warmed batched-admission step — its sampled token is discarded, the
+        pending token is already on the host); longer ones ride the
+        chunked-admission machinery, budget-bounded across ticks."""
+        req = e["req"]
+        n = e["seq_len"]
+        need = e["n_pages"]
+        if e["priv"]:
+            # defensive: callers route resident entries to the remap fast
+            # path, but a rebuild must never strand still-held blocks —
+            # and once the content is released the entry IS dropped, so a
+            # failed alloc below leaves it in a consistent
+            # retry-as-recompute state instead of routing to _swap_in
+            self._alloc.release(e["priv"])
+            e["priv"] = []
+            e["dropped"] = True
+        priv = self._alloc_reclaim(need, exclude=req)
+        if priv is None:
+            self._stats["pool_blocked_resumes"] += 1
+            return False
+        if e["shared"]:
+            self._alloc.release(e["shared"])
+            e["shared"] = []
+        if e["host"] is not None:
+            if e["pend"] is not None:
+                e["pend"] = None
+                self._swap_pending.remove(e)
+            self._host_free.extend(e["host"])
+            e["host"] = None
+        self._slot_blocks[slot] = priv
+        self._slot_shared[slot] = 0
+        trow = np.zeros((self._max_pages,), np.int32)
+        trow[:need] = priv
+        self.state = self._set_table_row(
+            self.state, jnp.int32(slot), trow, jnp.int32(0))
+        self._stats["swap_faults"] += 1
+        self._stats["fault_recomputes"] += 1
+        toks = e["tokens"]
+        bucket = next((b for b in self._prefill_buckets if b >= n), None)
+        if bucket is not None:
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :n] = toks
+            if self._admit_step is not None:
+                # the warmed (1, bucket) admission executable doubles as
+                # the recompute prefill; its sampled first token lands in
+                # _admit_buf but the mask stays False, so it is never
+                # merged — the pending token is the real next input
+                keys = jax.random.split(self._admit_key, 2)
+                self._admit_key = keys[0]
+                _, self._admit_buf, self.state = self._admit_step(
+                    self.params, self.state, self._admit_buf, padded,
+                    np.asarray([slot], np.int32),
+                    np.asarray([n], np.int32), keys[1:])
+            else:
+                _, self.state = self._prefill(
+                    self.params, self.state, padded, jnp.int32(slot),
+                    jnp.int32(n))
+            self._restore_slot(slot, e)
+            return True
+        # chunked rebuild: rides _advance_admissions one [1, C] chunk per
+        # tick; the final chunk restores the slot instead of sampling
+        self._admitting[slot] = {
+            "req": req,
+            "padded": pad_to_chunks(jnp.asarray(toks, jnp.int32), n,
+                                    self._chunk),
+            "n": n, "off": 0, "base": 0,
+            "resume": {"req": req, "pending": e["pending"],
+                       "budget": e["budget"], "seq_len": n,
+                       "tokens": toks},
+        }
+        del self._parked[req]
+        self._stats["resumes"] += 1
         return True
 
     def _admit(self, slot: int, req: Request) -> None:
@@ -1705,6 +2481,11 @@ class ServingEngine:
         self._slot_budget[slot] = budget - 1
         self._slot_len[slot] = n
         self._itl_last[slot] = None
+        if self._track_history:
+            # cache-contents mirror (prefix + prompt; the first token joins
+            # at delivery via _emit_first) — what a park must save and a
+            # recompute-on-fault rebuilds
+            self._seed_history(slot, req, n)
         self._stats["admissions"] += 1
 
     def _begin_slot_async(self, slot: int, req: Request, logits_row,
@@ -1736,9 +2517,9 @@ class ServingEngine:
         free = [i for i in range(self.serving.slots)
                 if self._slot_req[i] is None and i not in self._admitting]
         while self._waiting and free:
-            head = self._waiting[0]
+            head = self._waiting.head()
             if head.cancelled:
-                self._waiting.pop(0)
+                self._waiting.popleft()
                 head.out.put(None)
                 continue
             n_head = int(head.tokens.shape[0])
@@ -1747,7 +2528,7 @@ class ServingEngine:
                 # budget as their chunks advance (see _advance_admissions)
                 if self._paged and not self._reserve_paged(free[0], head):
                     break  # pool exhausted: head parks (backpressure)
-                self._waiting.pop(0)
+                self._waiting.popleft()
                 self._admit(free.pop(0), head)
                 admitted = True
                 continue
@@ -1757,7 +2538,7 @@ class ServingEngine:
                     break
                 if self._paged and not self._reserve_paged(free[0], head):
                     break  # pool exhausted: head parks (backpressure)
-                self._waiting.pop(0)
+                self._waiting.popleft()
                 self._admit(free.pop(0), head)
                 budget -= bucket
                 admitted = True
@@ -1767,7 +2548,9 @@ class ServingEngine:
             # slots and the remaining budget
             cap = min(len(free), max(self._admit_sizes))
             group = [head]
-            for req in self._waiting[1:]:
+            for req in self._waiting:
+                if req is head:
+                    continue
                 if len(group) >= cap:
                     break
                 if (not req.cancelled and req.prefix is None
@@ -1854,6 +2637,12 @@ class ServingEngine:
             self._stats["prefill_chunks"] += 1
             if adm["off"] >= adm["padded"].shape[1]:  # final chunk
                 del self._admitting[slot]
+                if adm.get("resume") is not None:
+                    # chunked recompute-on-fault: the cache is rebuilt and
+                    # the pending token was delivered BEFORE the park —
+                    # restore the slot, sample and emit nothing
+                    self._restore_slot(slot, adm["resume"])
+                    continue
                 pad = adm["padded"].shape[1]
                 last_row = logits[0, (n - base - 1) - (pad - c)]
                 if self._async_admission:
@@ -1967,6 +2756,8 @@ class ServingEngine:
         it, exactly like the legacy path)."""
         req = self._slot_req[slot]
         self._tokens[slot] = tok
+        if self._track_history:
+            self._history[slot].append(tok)
         self._itl_last[slot] = time.perf_counter()
         req.out.put(tok)
         self._stats["generated_tokens"] += 1
@@ -2033,7 +2824,7 @@ class ServingEngine:
         req.out.put(tok)
         self._stats["generated_tokens"] += 1
         self._slot_budget[slot] -= 1
-        if self._spec_tokens:
+        if self._track_history:
             self._history[slot].append(tok)
         if self._slot_budget[slot] <= 0 or tok == self.serving.eos_token:
             self._retire(slot)
@@ -2046,15 +2837,14 @@ class ServingEngine:
         self._slot_budget[slot] = budget - 1
         self._tokens[slot] = first
         self._slot_len[slot] = n
-        if self._spec_tokens:
-            # .get: the prefix may have been unregistered after this
-            # request's KV was installed — its copied cache stays valid,
-            # only the draft history loses the (optional) prefix tokens
-            entry = (self._prefixes.get(req.prefix)
-                     if req.prefix is not None else None)
-            pre = entry["tokens"] if entry else []
-            self._history[slot] = (
-                pre + [int(x) for x in req.tokens.tolist()] + [first])
+        if self._track_history:
+            # _seed_history's .get tolerates the prefix having been
+            # unregistered after this request's KV was installed — the
+            # copied cache stays valid; the history pads placeholders
+            # (flagged inexact) under overcommit, or simply loses the
+            # optional prefix tokens for speculation drafts
+            self._seed_history(slot, req, n)
+            self._history[slot].append(first)
         self._stats["admissions"] += 1
         self._stats["generated_tokens"] += 1
         self._itl_last[slot] = time.perf_counter()
@@ -2171,12 +2961,24 @@ class ServingEngine:
             s["read_pages_ratio"] = (
                 round(s["read_pages_live"] / s["read_pages_window"], 4)
                 if s["read_pages_window"] else None)
+            s["kv_pool_used_hwm"] = self._alloc.used_hwm
         else:
             s["kv_pool_blocks"] = None
             s["kv_pool_free"] = None
             s["kv_pool_used"] = None
             s["kv_pool_occupancy"] = None
             s["read_pages_ratio"] = None
+            s["kv_pool_used_hwm"] = None
+        # KV overcommit: parked population and the host swap tier's state
+        # (capacity/free in blocks); the flow counters — parks/resumes,
+        # evicted_blocks, swap_out/in_bytes, swap_faults, fault_recomputes
+        # — ride the _stats copy above
+        s["kv_swap"] = self.serving.kv_swap if self._swap_enabled else None
+        s["parked_sessions"] = len(self._parked)
+        s["swap_host_blocks"] = (
+            self._swap_host_blocks if self._swap_enabled else None)
+        s["swap_host_free"] = (
+            len(self._host_free) if self._swap_enabled else None)
         return s
 
     def _retire(self, slot: int) -> None:
@@ -2187,6 +2989,7 @@ class ServingEngine:
         self._slot_budget[slot] = 0
         self._slot_len[slot] = 0
         self._history[slot] = []
+        self._slot_hist_exact[slot] = True
         self._itl_last[slot] = None
         self._admit_mask[slot] = False
         # paged: the slot's pages go back to the pool — this release is
@@ -2298,6 +3101,23 @@ class ServingEngine:
                 np.zeros((self._max_pages,), np.int32), jnp.int32(0))
             self.state = self._copy_block(
                 self.state, jnp.int32(0), jnp.int32(0))
+        if self._swap_enabled and self._swap_host_blocks:
+            # the swap staging pair: one gather and one scatter executable
+            # at the staging width (all-null ids — reads and writes land on
+            # the always-masked null block). First-use compiles of the swap
+            # path must never land inside the loop, same invariant as every
+            # other executable here. (kv_swap=0 has no staging to warm.)
+            ids = np.zeros((self._swap_stage,), np.int32)
+            snap = self._swap_gather(self.state, ids)
+            pages = {
+                key: (jax.device_put(np.zeros(snap[key].shape,
+                                              snap[key].dtype),
+                                     self._stage_shardings[key])
+                      if key in self._stage_shardings
+                      else np.zeros(snap[key].shape, snap[key].dtype))
+                for key in self._swap_planes
+            }
+            self.state = self._swap_scatter(self.state, ids, pages)
 
     def _loop(self) -> None:
         try:
@@ -2331,11 +3151,25 @@ class ServingEngine:
                 self._waiting.append(self._pending.get_nowait())
             except queue.Empty:
                 break
+        if self._swap_enabled:
+            # overcommit housekeeping, all non-blocking: apply settled
+            # parks, land READY swap-out transfers in the host pool (a
+            # still-in-flight one waits — the tick never blocks on D2H)
+            self._process_lifecycle()
+            self._drain_swap_outs()
         decoding = any(r is not None for r in self._slot_req)
         budget = (
             float(self.serving.prefill_budget)
             if self.serving.prefill_budget and decoding else float("inf"))
         budget = self._advance_admissions(budget)
+        if self._swap_enabled:
+            # resumes slot in ahead of NEW admissions (older traffic) but
+            # draw from the SAME per-tick prompt-token budget: a bucketed
+            # recompute is a full prefill dispatch, and a resume wave must
+            # degrade live streams' ITL by the configured bound, not stall
+            # them (chunked rebuilds ride the budgeted
+            # _advance_admissions path above on subsequent ticks)
+            budget = self._advance_resumes(budget)
         admitted, _ = self._admit_waiting(budget)
         for slot in range(self.serving.slots):
             req = self._slot_req[slot]
@@ -2354,8 +3188,16 @@ class ServingEngine:
         guard implied every slot was free; see the regression test)."""
         if self._admitting or admitted:
             return
+        # block on the shared wake event, not the pending queue alone: a
+        # resume command arrives on the lifecycle queue, and an idle
+        # engine full of parked sessions must neither busy-poll nor floor
+        # resume latency at this sleep (submit/park/resume all set _wake
+        # AFTER enqueueing, so a consumed wake always finds its item on
+        # the next _tick_head drain)
+        if self._wake.wait(timeout=0.05):
+            self._wake.clear()
         try:
-            self._waiting.append(self._pending.get(timeout=0.05))
+            self._waiting.append(self._pending.get_nowait())
         except queue.Empty:
             return
 
@@ -2411,6 +3253,7 @@ class ServingEngine:
             dispatch = [
                 i for i in range(b)
                 if self._slot_req[i] is not None
+                and self._slot_req[i] not in self._want_park
                 and self._slot_budget[i] - (1 if fed[i] else 0) > 0
             ]
             if not dispatch and inflight is None:
@@ -2487,6 +3330,12 @@ class ServingEngine:
                 # one standalone batched fetch for the whole admission wave
                 self._deliver_firsts(firsts)
             inflight = new_inflight
+            # what the NEXT _tick_head must treat as in flight: a park for
+            # one of these slots defers until its lookahead token lands
+            # (dispatch exclusion above guarantees that within one tick)
+            self._inflight_slots = (
+                {i for i in range(b) if inflight["reqs"][i] is not None}
+                if inflight is not None else set())
         if inflight is not None:
             # stop() landed between dispatch and delivery: the tick's
             # tokens are already computed — deliver them so a mid-stream
