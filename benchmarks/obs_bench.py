@@ -1,0 +1,317 @@
+"""Observability overhead A/B + trace round-trip (ISSUE 7 tentpole gate).
+
+The vtpu/obs subsystem promises first-class telemetry — request-lifecycle
+tracing, tick-phase histograms, the vtpu_serving_* exporter — at a price
+of approximately nothing: recording is host-only (a counter bump, a
+monotonic stamp, a tuple into a preallocated ring), so turning tracing on
+must add ZERO host syncs and cost at most 2% tokens/sec. This bench is
+that contract's exit-code gate, in two parts:
+
+  1. Overhead A/B: identical decode-heavy request waves through two
+     LONG-LIVED engines differing ONLY in ``ServingConfig.trace_events``
+     (0 = ring off vs the ring on), warmed once so compiles never enter a
+     timed window. Measurement is built for a noisy shared box (measured:
+     raw run-to-run throughput swings 2x on seconds-scale CPU
+     contention): waves alternate off/on/off/on within each pair, each
+     arm's pair estimate is its best-of-2 wave (contention only ever
+     SLOWS a wave, so best-of estimates the uncontended rate and both
+     arms get a clean-window chance), and the overhead claim is the
+     MEDIAN pair's on/off ratio — drift between pairs cancels instead of
+     landing on one arm. Deterministic gates (always): the tracing-on
+     arm's ``device_gets_per_tick == 1.0`` (no fetch was added anywhere),
+     ``admission_syncs`` identical across arms (zero added blocking
+     syncs), and the on arm actually recorded events while the off arm
+     recorded none. Perf gate (full runs only; --quick CI boxes are too
+     noisy for a 2% bar): the median pair ratio within
+     ``--overhead-bar-pct`` of 1.
+
+  2. Trace round-trip: a park -> evict -> swap-out -> swap-in -> resume
+     lifecycle (plus a parallel drop -> recompute-on-fault session) driven
+     through a small overcommit engine with tracing on. Gates
+     (deterministic): each session's JSONL events reconstruct the exact
+     expected span sequence, the derived spans carry the parked/resume
+     attribution, and the Chrome dump is valid ``trace_event`` JSON
+     (loads in Perfetto).
+
+Usage:  python benchmarks/obs_bench.py [--quick] [--slots N] [--repeats R]
+            [--max-new N] [--overhead-bar-pct 2.0] [--out F]
+Emits:  full artifact JSON on stdout line 1, then the compact one-line
+        headline summary (metric/value/verdict — the PR-3 driver-artifact
+        convention, shared helper vtpu/obs/summary.py) as the FINAL stdout
+        line; human notes on stderr.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser("obs-bench")
+    ap.add_argument("--quick", action="store_true",
+                    help="CI mode: one A/B pair, short streams; the perf "
+                         "bar is reported but not gated")
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--prompt-len", type=int, default=12)
+    ap.add_argument("--max-new", type=int, default=64,
+                    help="decode tokens per request/wave (quick: capped "
+                         "at 16)")
+    ap.add_argument("--requests", type=int, default=None,
+                    help="requests per wave (default 4x slots)")
+    ap.add_argument("--repeats", type=int, default=7,
+                    help="interleaved measurement pairs (quick: 1)")
+    ap.add_argument("--waves-per-arm", type=int, default=4,
+                    help="waves per arm per pair; each arm scores its "
+                         "best-of (quick: 1)")
+    ap.add_argument("--overhead-bar-pct", type=float, default=2.0,
+                    help="full runs gate tracing-on tokens/sec within this "
+                         "percent of tracing-off")
+    ap.add_argument("--out", default=None,
+                    help="artifact path (default OBS_r10.json on full "
+                         "runs; quick runs only write when set)")
+    a = ap.parse_args()
+    if a.quick:
+        a.max_new = min(a.max_new, 16)
+        a.repeats = 1
+        a.waves_per_arm = 1
+    n_requests = a.requests or 4 * a.slots
+
+    import jax
+    import jax.numpy as jnp
+
+    from vtpu.models import ModelConfig, init_params
+    from vtpu.serving import ServingConfig, ServingEngine
+    from vtpu.obs.summary import print_summary
+    from vtpu.obs.trace import (
+        DROP_RESTORE_SEQUENCE, SWAP_RESTORE_SEQUENCE, subsequence)
+
+    # tiny on purpose (see paged_kv_bench): a CPU tick is dominated by
+    # fixed dispatch overhead — the regime where a TPU's latency-bound
+    # decode tick also lives, and the regime where per-tick host-side
+    # tracing cost would show if it existed
+    cfg = ModelConfig(
+        vocab=128, d_model=32, n_heads=2, n_layers=1, d_ff=64,
+        max_seq=max(128, a.prompt_len + a.max_new + 1), head_dim=16,
+        dtype=jnp.float32, use_pallas=False,
+    )
+    params = init_params(jax.random.key(0), cfg)
+    bucket = max(16, a.prompt_len)
+
+    def prompt(seed: int, n: int = None):
+        return [int(t) for t in jax.random.randint(
+            jax.random.key(seed), (n or a.prompt_len,), 1, cfg.vocab,
+            jnp.int32)]
+
+    prompts = [prompt(100 + i) for i in range(n_requests)]
+
+    import gc
+
+    def make_engine(trace_events: int) -> ServingEngine:
+        eng = ServingEngine(params, cfg, ServingConfig(
+            slots=a.slots, prefill_buckets=(bucket,),
+            max_new_tokens=a.max_new, trace_events=trace_events))
+        eng.start()
+        # warm pass: compiles and first-dispatch costs happen HERE, never
+        # inside a timed wave
+        for r in [eng.submit(p, max_new_tokens=2)
+                  for p in prompts[:a.slots]]:
+            list(r.stream())
+        return eng
+
+    def wave(eng: ServingEngine) -> float:
+        """One measured wave: submit the request set, drain every stream,
+        return tokens/sec."""
+        gc.collect()  # a GC pause inside a ~0.5 s wave is real noise
+        t0 = time.perf_counter()
+        reqs = [eng.submit(p, max_new_tokens=a.max_new) for p in prompts]
+        total = sum(len(list(r.stream())) for r in reqs)
+        return total / (time.perf_counter() - t0)
+
+    eng_off = make_engine(0)
+    eng_on = make_engine(16384)
+    pair_rows = []
+    try:
+        for rep in range(a.repeats):
+            # finest-grain interleave: off/on waves alternate inside the
+            # pair, and the pair's arm order flips per repeat, so neither
+            # a contention spike nor a one-time process cost lands on one
+            # arm systematically
+            arms = ([(eng_off, "off"), (eng_on, "on")] if rep % 2 == 0
+                    else [(eng_on, "on"), (eng_off, "off")])
+            scores = {"off": [], "on": []}
+            for _ in range(a.waves_per_arm):
+                for eng, name in arms:
+                    scores[name].append(wave(eng))
+            row = {"off": round(max(scores["off"]), 2),
+                   "on": round(max(scores["on"]), 2)}
+            row["ratio"] = round(row["on"] / row["off"], 4)
+            pair_rows.append(row)
+            print(f"pair {rep + 1}/{a.repeats}: off {row['off']} tok/s, "
+                  f"on {row['on']} tok/s (ratio {row['ratio']})",
+                  file=sys.stderr)
+        off_stats = eng_off.stats()
+        on_stats = eng_on.stats()
+    finally:
+        eng_off.stop()
+        eng_on.stop()
+
+    def arm_row(stats, trace_events):
+        return {
+            "trace_events": trace_events,
+            "device_gets_per_tick": stats["device_gets_per_tick"],
+            "admission_syncs": stats["admission_syncs"],
+            "trace_events_recorded": stats["trace_events_recorded"],
+            "trace_events_dropped": stats["trace_events_dropped"],
+            "host_ms_per_tick": stats["host_ms_per_tick"],
+            "tick_phase_ms": stats["tick_phase_ms"],
+            "itl_p50_ms": stats["itl_p50_ms"],
+            "ttft_p50_ms": stats["ttft_p50_ms"],
+        }
+
+    med = lambda vals: sorted(vals)[len(vals) // 2]  # noqa: E731
+    off_tps = med([r["off"] for r in pair_rows])
+    on_tps = med([r["on"] for r in pair_rows])
+    pair_ratios = [r["ratio"] for r in pair_rows]
+    overhead_pct = (1.0 - med(pair_ratios)) * 100.0
+    off, on = arm_row(off_stats, 0), arm_row(on_stats, 16384)
+    # zero ADDED host syncs: both engines served identical traffic, so
+    # their blocking-sync counters must be identical (and 0 on the
+    # default device-sampled path) and the tick transfer contract must
+    # hold on both — tracing touched neither
+    syncs_equal = off["admission_syncs"] == on["admission_syncs"]
+    tick_contract = (off["device_gets_per_tick"] == 1.0
+                     and on["device_gets_per_tick"] == 1.0)
+    recorded = (on["trace_events_recorded"] > 0
+                and off["trace_events_recorded"] == 0)
+
+    # ---- part 2: the lifecycle round-trip through the trace ------------
+    # streams long enough (24 tokens, parked after 2) that the park
+    # settles many ticks before the budget would retire the slot
+    page = 8
+    lc_prompt, lc_new = 8, 24
+    pages_per = -(-(lc_prompt + lc_new) // page)  # blocks per session
+    eng = ServingEngine(params, cfg, ServingConfig(
+        slots=2, prefill_buckets=(16,), max_new_tokens=lc_new,
+        prefill_chunk=16, kv_page=page, kv_pool_blocks=2 * pages_per,
+        kv_swap=pages_per))  # host tier holds ONE session: the other drops
+    eng.start()
+    try:
+        wave1 = [eng.submit(prompt(900 + i, lc_prompt),
+                            max_new_tokens=lc_new) for i in range(2)]
+        for r in wave1:
+            for _ in range(2):
+                assert r.out.get(timeout=60) is not None
+        # park ONE AT A TIME so park order (the eviction LRU axis) is
+        # deterministic: wave1[0] parks first, so it is evicted first and
+        # takes the host-tier slot; wave1[1] finds the tier full and drops
+        for i, r in enumerate(wave1):
+            eng.park(r)
+            t0 = time.perf_counter()
+            while eng.stats()["parked_sessions"] < i + 1:
+                assert time.perf_counter() - t0 < 60, "park stalled"
+                time.sleep(0.002)
+        # pool pressure: the second wave's admissions evict both parked
+        # sessions — the first-parked spills to the host tier, the second
+        # finds it full and drops (recompute-on-fault at resume)
+        wave2 = [eng.submit(prompt(910 + i, lc_prompt),
+                            max_new_tokens=lc_new) for i in range(2)]
+        for r in wave2:
+            list(r.stream())
+        for r in wave1:
+            eng.resume(r)
+            list(r.stream())
+        stats = eng.stats()
+        spans = eng.trace.spans()
+        by_rid = {r.rid: [] for r in wave1}
+        for e in eng.trace.events():
+            if e["rid"] in by_rid:
+                by_rid[e["rid"]].append(e["event"])
+        swap_ok = subsequence(SWAP_RESTORE_SEQUENCE, by_rid[wave1[0].rid])
+        drop_ok = subsequence(DROP_RESTORE_SEQUENCE, by_rid[wave1[1].rid])
+        span_ok = all(
+            spans[r.rid]["parks"] == 1
+            and spans[r.rid]["parked_ms"] > 0
+            and len(spans[r.rid]["resume_latency_ms"]) == 1
+            and spans[r.rid]["tokens"] == lc_new
+            for r in wave1)
+        chrome = eng.trace.chrome_trace()
+        chrome_ok = (
+            isinstance(chrome.get("traceEvents"), list)
+            and len(chrome["traceEvents"]) > 0
+            and all(isinstance(e, dict) and "ph" in e and "name" in e
+                    for e in chrome["traceEvents"])
+            and json.loads(json.dumps(chrome)) == chrome)
+        lifecycle = {
+            "swap_path_events_ok": swap_ok,
+            "drop_path_events_ok": drop_ok,
+            "spans_ok": span_ok,
+            "chrome_trace_valid": chrome_ok,
+            "chrome_trace_events": len(chrome["traceEvents"]),
+            "swap_out_bytes": stats["swap_out_bytes"],
+            "swap_in_bytes": stats["swap_in_bytes"],
+            "fault_recomputes": stats["fault_recomputes"],
+            "events": {str(r.rid): by_rid[r.rid] for r in wave1},
+        }
+        if not (swap_ok and drop_ok):
+            print(f"lifecycle events: {lifecycle['events']}", file=sys.stderr)
+    finally:
+        eng.stop()
+
+    ok = (tick_contract and syncs_equal and recorded
+          and swap_ok and drop_ok and span_ok and chrome_ok
+          and stats["swap_out_bytes"] > 0 and stats["fault_recomputes"] > 0)
+    perf_ok = overhead_pct <= a.overhead_bar_pct
+    artifact = {
+        "metric": "tracing_on_tokens_per_sec_overhead_pct",
+        "value": round(overhead_pct, 3),
+        "unit": f"percent_vs_tracing_off_bar_{a.overhead_bar_pct}",
+        "pass": bool(ok and (a.quick or perf_ok)),
+        "overhead_bar_pct": a.overhead_bar_pct,
+        "overhead_estimator":
+            "median_of_pair_ratios_best_of_waves_per_arm",
+        "pairs": pair_rows,
+        "tokens_per_sec_off_median": round(off_tps, 2),
+        "tokens_per_sec_on_median": round(on_tps, 2),
+        "device_gets_per_tick_contract": tick_contract,
+        "admission_syncs_equal": syncs_equal,
+        "trace_recording_asymmetry_ok": recorded,
+        "slots": a.slots,
+        "requests": n_requests,
+        "max_new": a.max_new,
+        "repeats": a.repeats,
+        "waves_per_arm": a.waves_per_arm,
+        "quick": a.quick,
+        "model": {"vocab": cfg.vocab, "d_model": cfg.d_model,
+                  "n_heads": cfg.n_heads, "n_layers": cfg.n_layers,
+                  "max_seq": cfg.max_seq},
+        "arms": [off, on],
+        "lifecycle": lifecycle,
+    }
+    out_path = a.out or (None if a.quick else "OBS_r10.json")
+    if out_path:
+        Path(out_path).write_text(json.dumps(artifact, indent=2) + "\n")
+    print(json.dumps(artifact))
+    print_summary(
+        artifact["metric"], artifact["value"],
+        "pass" if artifact["pass"] else "fail", unit=artifact["unit"],
+        tokens_per_sec_off=round(off_tps, 2),
+        tokens_per_sec_on=round(on_tps, 2),
+        device_gets_per_tick=on["device_gets_per_tick"],
+        added_host_syncs=0 if syncs_equal else "NONZERO",
+        lifecycle_round_trip=bool(swap_ok and drop_ok and chrome_ok),
+    )
+    # the structural gates (tick contract, zero added syncs, lifecycle
+    # round-trip) are deterministic and gate ALWAYS; the 2% tokens/sec
+    # envelope gates full runs only (quick CI boxes are too noisy)
+    if not ok or (not a.quick and not perf_ok):
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -243,21 +243,20 @@ def main() -> None:
     if out_path:
         Path(out_path).write_text(json.dumps(artifact, indent=2) + "\n")
     print(json.dumps(artifact))
-    # compact headline as the FINAL stdout line (PR-3 convention)
-    print(json.dumps({
-        "summary": True,
-        "metric": artifact["metric"],
-        "value": artifact["value"],
-        "unit": artifact["unit"],
-        "verdict": "pass" if artifact["pass"] else "fail",
-        "top_ratio": top["ratio"],
-        "sessions_vs_pool_blocks":
-            f"{top['sessions']}x{top['pool_blocks']}",
-        "token_equal": top["token_equal_vs_unconstrained"],
-        "swap_out_bytes": top["swap_out_bytes"],
-        "fault_recomputes": top["fault_recomputes"],
-        "device_gets_per_tick": top["device_gets_per_tick"],
-    }))
+    # compact headline as the FINAL stdout line (PR-3 convention, shared
+    # implementation in vtpu/obs/summary.py)
+    from vtpu.obs.summary import print_summary
+
+    print_summary(
+        artifact["metric"], artifact["value"],
+        "pass" if artifact["pass"] else "fail", unit=artifact["unit"],
+        top_ratio=top["ratio"],
+        sessions_vs_pool_blocks=f"{top['sessions']}x{top['pool_blocks']}",
+        token_equal=top["token_equal_vs_unconstrained"],
+        swap_out_bytes=top["swap_out_bytes"],
+        fault_recomputes=top["fault_recomputes"],
+        device_gets_per_tick=top["device_gets_per_tick"],
+    )
     # token equality + both-restore-paths + tick contract gate ALWAYS
     # (deterministic); the resume-p99 bound gates full runs only (quick CI
     # boxes are too noisy for a latency bar)

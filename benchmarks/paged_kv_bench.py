@@ -283,20 +283,18 @@ def main() -> None:
     if out_path:
         Path(out_path).write_text(json.dumps(artifact, indent=2) + "\n")
     print(json.dumps(artifact))
-    # Compact headline as the FINAL stdout line (the PR-3 convention:
-    # drivers that keep only a prefix or parse the last line still get a
-    # self-contained metric/value/verdict record).
-    print(json.dumps({
-        "summary": True,
-        "metric": artifact["metric"],
-        "value": artifact["value"],
-        "unit": artifact["unit"],
-        "verdict": "pass" if ok else "fail",
-        "paged_slots_vs_dense": f"{paged_slots}x{dense_slots}",
-        "prefix_zero_copy": zero_copy,
-        "prefix_install_copies_paged": paged_px["prefix_install_copies"],
-        "prefix_blocks_shared": paged_px["prefix_blocks_shared"],
-    }))
+    # Compact headline as the FINAL stdout line (the PR-3 convention,
+    # shared implementation in vtpu/obs/summary.py).
+    from vtpu.obs.summary import print_summary
+
+    print_summary(
+        artifact["metric"], artifact["value"],
+        "pass" if ok else "fail", unit=artifact["unit"],
+        paged_slots_vs_dense=f"{paged_slots}x{dense_slots}",
+        prefix_zero_copy=zero_copy,
+        prefix_install_copies_paged=paged_px["prefix_install_copies"],
+        prefix_blocks_shared=paged_px["prefix_blocks_shared"],
+    )
     # Exit code backs the CI step's name: the DETERMINISTIC zero-copy
     # contract always gates; the perf ratio gates full runs only (quick
     # CI boxes are too noisy to fail a 1.5x bar on).

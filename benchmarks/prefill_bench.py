@@ -25,8 +25,11 @@ contract: K prompts drain in <= ceil(K/Nmax) prefill dispatches.
 
 Usage:  python benchmarks/prefill_bench.py [--quick] [--slots 8] [--bg 4]
             [--burst 16] [--bg-steps 192] [--prompt-len 40]
-Emits:  one JSON object on stdout (human summary on stderr). --quick trims
-        the load for CI while keeping the A/B shape.
+Emits:  full artifact JSON on stdout line 1, then the compact one-line
+        headline summary (metric/value/verdict — the PR-3 driver-artifact
+        convention, shared helper vtpu/obs/summary.py) as the FINAL stdout
+        line; human notes on stderr. --quick trims the load for CI while
+        keeping the A/B shape.
 """
 
 from __future__ import annotations
@@ -227,7 +230,7 @@ def main() -> None:
           f"{ratio and round(ratio, 2)}x  (coalescing bound "
           f"{async_['drain_dispatches']} <= {async_['drain_dispatch_bound']}: "
           f"{coalesced})", file=sys.stderr)
-    json.dump({
+    artifact = {
         "metric": "batched_async_admission_itl_p99_speedup",
         "value": ratio and round(ratio, 3),
         "unit": "x_bg_itl_p99_vs_sync_serial",
@@ -238,8 +241,20 @@ def main() -> None:
         "model": {"vocab": cfg.vocab, "d_model": cfg.d_model,
                   "n_layers": cfg.n_layers},
         "arms": [sync, async_],
-    }, sys.stdout, indent=2)
-    print()
+    }
+    # artifact on stdout line 1, then the compact headline as the FINAL
+    # line (the PR-3 convention, shared implementation in
+    # vtpu/obs/summary.py) — this bench predates the convention and used
+    # to emit a bare multi-line artifact
+    print(json.dumps(artifact))
+    from vtpu.obs.summary import print_summary
+
+    print_summary(
+        artifact["metric"], artifact["value"],
+        "pass" if artifact["pass"] else "fail", unit=artifact["unit"],
+        coalescing_bound_held=coalesced,
+        admission_syncs_async=async_["admission_syncs"],
+    )
 
 
 if __name__ == "__main__":

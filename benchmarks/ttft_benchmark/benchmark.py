@@ -85,6 +85,18 @@ def main() -> None:
                 time.sleep(max(0.0, args.interval - (time.monotonic() - t0)))
     print(file=sys.stderr)
 
+    # server-side span telemetry, re-derived from the engine's trace
+    # substrate (vtpu/obs): the same percentiles as the engine measured
+    # them (submit -> first delivery), printed next to the client's
+    # wall-clock view so the HTTP hop's share of TTFT is visible. Older
+    # servers without GET /stats degrade to null.
+    server_trace = None
+    try:
+        with urllib.request.urlopen(f"{args.url}/stats", timeout=10) as resp:
+            server_trace = json.loads(resp.read().decode())
+    except (OSError, ValueError):
+        pass
+
     ttfts = sorted(s["ttft_ms"] for s in samples)
     itl = sorted(g for s in samples for g in s["gaps_ms"])
     print(json.dumps({
@@ -95,6 +107,7 @@ def main() -> None:
         "p50_itl_ms": round(pct(itl, 0.50), 2),
         "p95_itl_ms": round(pct(itl, 0.95), 2),
         "p99_itl_ms": round(pct(itl, 0.99), 2),
+        "server_trace": server_trace,
         "out": args.out,
     }))
 

@@ -68,6 +68,20 @@ class Engine:
             for _ in self.generate(bucket, 2):
                 pass
 
+    def trace_stats(self) -> dict:
+        """Engine-side span telemetry, re-derived from the trace substrate
+        (vtpu/obs): TTFT/ITL/queue-wait percentiles as the ENGINE measured
+        them (submit -> first delivery), served at GET /stats so the
+        benchmark client can print them next to its own wall-clock
+        percentiles — the server-side numbers exclude only the HTTP hop."""
+        s = self.engine.stats()
+        return {k: s[k] for k in (
+            "ttft_p50_ms", "ttft_p95_ms", "ttft_p99_ms",
+            "itl_p50_ms", "itl_p99_ms",
+            "queue_wait_p50_ms", "queue_wait_p99_ms",
+            "generated_tokens", "decode_ticks", "device_gets_per_tick",
+            "tick_phase_ms", "trace_events_recorded")}
+
     def generate(self, prompt_len: int, max_tokens: int):
         """Yield (token_id, monotonic_ts) per generated token."""
         limit = self.engine.serving.prefill_buckets[-1]
@@ -97,6 +111,12 @@ def make_handler(engine: Engine):
                 self.send_response(200)
                 self.end_headers()
                 self.wfile.write(b"ok")
+            elif self.path == "/stats":
+                body = json.dumps(engine.trace_stats()).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.end_headers()
+                self.wfile.write(body)
             else:
                 self.send_response(404)
                 self.end_headers()

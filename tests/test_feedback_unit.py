@@ -1,0 +1,148 @@
+"""Unit edge cases for the monitor's priority-feedback pass (ISSUE 7
+satellite): census cutoff boundary, empty inputs, mixed-priority ties on
+one device, and gate_timeout_ms propagation — pure-Python over fake
+entries, no libvtpu build needed (tests/test_monitor.py covers the
+cross-stack path over real regions)."""
+
+import time
+
+from vtpu.monitor.feedback import (
+    ACTIVE_WINDOW_SECONDS,
+    KERNEL_CREDIT,
+    DeviceCensus,
+    apply_feedback,
+    census,
+)
+from vtpu.monitor.lister import ContainerUsage
+from vtpu.monitor.region import DeviceSnapshot, RegionSnapshot
+
+NOW = 1_000_000 * 1_000_000_000  # an arbitrary "now" in ns
+CUTOFF = NOW - int(ACTIVE_WINDOW_SECONDS * 1e9)
+
+
+class FakeReader:
+    """Records every region write apply_feedback performs."""
+
+    def __init__(self):
+        self.calls = []
+
+    def __getattr__(self, name):
+        if not name.startswith("set_"):
+            raise AttributeError(name)
+
+        def _rec(value):
+            self.calls.append((name, value))
+
+        return _rec
+
+    def last(self, name):
+        vals = [v for n, v in self.calls if n == name]
+        return vals[-1] if vals else None
+
+
+def entry(pod, priority, last_kernel_ns, uuids=("device-0",)):
+    return ContainerUsage(
+        pod_uid=pod, container="main", dir_path=f"/tmp/{pod}_main",
+        reader=FakeReader(),
+        snapshot=RegionSnapshot(
+            priority=priority,
+            devices=[DeviceSnapshot(uuid=u, last_kernel_ns=last_kernel_ns)
+                     for u in uuids]))
+
+
+def test_census_entry_exactly_at_active_window_cutoff():
+    """A kernel stamped EXACTLY at now - ACTIVE_WINDOW counts as active
+    (the census comparison is >=): the boundary entry must not flap
+    between active and idle depending on which side rounding lands."""
+    at_cutoff = entry("edge", 1, CUTOFF)
+    just_stale = entry("stale", 1, CUTOFF - 1)
+    c = census([at_cutoff, just_stale], NOW)
+    assert c["device-0"].high_active == 1
+    assert c["device-0"].low_active == 0
+    # and the boundary activity gates a low-priority peer
+    low = entry("low", 0, NOW)
+    apply_feedback([at_cutoff, low], now_ns=NOW)
+    assert low.reader.last("set_recent_kernel") == -1
+    # whereas one ns past the window it does not
+    low2 = entry("low2", 0, NOW)
+    apply_feedback([just_stale, low2], now_ns=NOW)
+    assert low2.reader.last("set_recent_kernel") == KERNEL_CREDIT
+
+
+def test_census_empty_region_list():
+    assert census([], NOW) == {}
+    apply_feedback([], now_ns=NOW)  # must not raise
+
+
+def test_entry_with_no_devices_is_sole_tenant_and_unblocked():
+    """A region with an empty device list (allocation not yet written):
+    no device can report high-priority activity against it, so it gets
+    credit and the relaxed limiter — never a spurious block."""
+    bare = entry("bare", 0, NOW, uuids=())
+    high = entry("high", 1, NOW)  # active high on a DIFFERENT device set
+    apply_feedback([bare, high], now_ns=NOW)
+    assert bare.reader.last("set_recent_kernel") == KERNEL_CREDIT
+    assert bare.reader.last("set_utilization_switch") == 0
+
+
+def test_mixed_priority_ties_on_one_device():
+    """Two high + two low actively sharing one chip: EVERY low blocks,
+    EVERY high gets credit, and nobody sees the sole-tenant limiter
+    relaxation — the tie must not let one low-priority tenant slip
+    through because another low was censused first."""
+    highs = [entry(f"h{i}", 1, NOW) for i in range(2)]
+    lows = [entry(f"l{i}", 0, NOW) for i in range(2)]
+    c = census(highs + lows, NOW)
+    assert c["device-0"].high_active == 2
+    assert c["device-0"].low_active == 2
+    assert c["device-0"].total_active == 4
+    apply_feedback(highs + lows, now_ns=NOW)
+    for e in lows:
+        assert e.reader.last("set_recent_kernel") == -1
+        assert e.reader.last("set_utilization_switch") == 1
+    for e in highs:
+        assert e.reader.last("set_recent_kernel") == KERNEL_CREDIT
+        assert e.reader.last("set_utilization_switch") == 1
+
+
+def test_gate_timeout_and_heartbeat_propagate_to_every_region():
+    """gate_timeout_ms is written into EVERY region (blocked or not, the
+    C side reads it before each execute) together with the monitor
+    heartbeat — the liveness pair that lets a gated execute self-release
+    on a dead monitor."""
+    entries = [entry("h", 1, NOW), entry("l", 0, NOW),
+               entry("idle", 0, CUTOFF - 1)]
+    apply_feedback(entries, now_ns=NOW, gate_timeout_ms=750)
+    for e in entries:
+        assert e.reader.last("set_gate_timeout_ms") == 750
+        assert e.reader.last("set_monitor_heartbeat") == NOW
+    # default timeout is 0 (blocked stays blocked until the gate lifts)
+    fresh = [entry("h2", 1, NOW), entry("l2", 0, NOW)]
+    apply_feedback(fresh, now_ns=NOW)
+    for e in fresh:
+        assert e.reader.last("set_gate_timeout_ms") == 0
+
+
+def test_reader_closed_mid_feedback_skips_entry():
+    """A reader GC'd between update() and the write (raises ValueError)
+    is skipped without failing the pass or the other entries."""
+
+    class ClosedReader(FakeReader):
+        def __getattr__(self, name):
+            if name.startswith("set_"):
+                def _boom(value):
+                    raise ValueError("mmap closed")
+                return _boom
+            raise AttributeError(name)
+
+    dead = entry("dead", 0, NOW)
+    dead.reader = ClosedReader()
+    live = entry("live", 0, NOW)
+    apply_feedback([dead, live], now_ns=NOW)
+    assert live.reader.last("set_recent_kernel") == KERNEL_CREDIT
+
+
+def test_apply_feedback_defaults_now_to_wallclock():
+    e = entry("h", 1, time.time_ns())
+    apply_feedback([e])
+    assert e.reader.last("set_monitor_heartbeat") is not None

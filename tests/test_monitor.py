@@ -236,3 +236,78 @@ def test_scheduler_collector_exports():
     pod_mem = metrics["vtpu_container_vtpu_allocated_memory_bytes"].samples
     assert pod_mem[0].labels["podname"] == "p1"
     sched.stop()
+
+
+def test_monitor_scrape_merges_serving_families(hook):
+    """ISSUE 7 satellite: one HTTP scrape of the monitor endpoint returns
+    the merged libvtpu/region families AND the serving engine's
+    vtpu_serving_* families, as a well-formed exposition — every family a
+    HELP/TYPE pair, no duplicate family names, parseable by
+    prometheus_client's own text parser."""
+    import socket
+    import urllib.request
+
+    import jax
+    import jax.numpy as jnp
+    from prometheus_client import start_http_server
+    from prometheus_client.core import CollectorRegistry
+    from prometheus_client.parser import text_string_to_metric_families
+
+    from vtpu.models import ModelConfig, init_params
+    from vtpu.obs.export import ServingCollector
+    from vtpu.serving import ServingConfig, ServingEngine
+
+    hook_path, _ = hook
+    cfg = ModelConfig(vocab=64, d_model=32, n_heads=2, n_layers=1, d_ff=64,
+                      max_seq=32, head_dim=16, dtype=jnp.float32,
+                      use_pallas=False)
+    eng = ServingEngine(init_params(jax.random.key(0), cfg), cfg,
+                        ServingConfig(slots=2, prefill_buckets=(8,),
+                                      max_new_tokens=4))
+    eng.start()
+    try:
+        req = eng.submit(jnp.arange(1, 6, dtype=jnp.int32),
+                         max_new_tokens=4)
+        assert len(list(req.stream())) == 4
+        registry = CollectorRegistry()
+        registry.register(MonitorCollector(
+            ContainerLister(str(hook_path)), node_name="n1",
+            serving=ServingCollector({"engine0": eng})))
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        started = start_http_server(port, registry=registry)
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+                body = r.read().decode()
+        finally:
+            # newer prometheus_client returns (server, thread); older None
+            if started is not None:
+                server = started[0] if isinstance(started, tuple) else started
+                server.shutdown()
+    finally:
+        eng.stop()
+
+    families = list(text_string_to_metric_families(body))
+    names = [f.name for f in families]
+    assert len(names) == len(set(names)), "duplicate family names in scrape"
+    # libvtpu/region half (real regions written by the C++ shim)
+    assert "vtpu_memory_used_bytes" in names
+    assert "vtpu_calibration_verdict" in names
+    # serving half, counters gauges and histograms alike
+    assert "vtpu_serving_tokens_generated" in names
+    assert "vtpu_serving_kv_pool_free_blocks" in names
+    assert "vtpu_serving_ttft_seconds" in names
+    assert "vtpu_serving_tick_phase_seconds" in names
+    by_name = {f.name: f for f in families}
+    tok = by_name["vtpu_serving_tokens_generated"].samples
+    assert tok and tok[0].labels["engine"] == "engine0"
+    assert tok[0].value == 4.0
+    assert 'podUid="poda"' in body  # region labels survived the merge
+    # exposition hygiene: every HELP line pairs with a TYPE line
+    helps = {ln.split()[2] for ln in body.splitlines()
+             if ln.startswith("# HELP")}
+    types = {ln.split()[2] for ln in body.splitlines()
+             if ln.startswith("# TYPE")}
+    assert helps == types

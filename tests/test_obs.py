@@ -1,0 +1,331 @@
+"""Observability subsystem (vtpu/obs): trace ring, tick profiler, exporter.
+
+Fast tier. Three layers:
+
+- unit: the bounded event ring (wraparound, ordering, drop accounting),
+  the latency substrate with the ring disabled, and the phase histograms'
+  Prometheus bucket shapes;
+- engine: the acceptance-bar lifecycle round trip — a park -> evict ->
+  swap-out -> swap-in -> resume session (and a parallel drop ->
+  recompute-on-fault one) whose JSONL events reconstruct the exact span
+  sequence and whose Chrome dump is valid ``trace_event`` JSON;
+- exporter: the coverage static check (every stats() key maps to a
+  ``vtpu_serving_*`` family or is explicitly allowlisted — new engine
+  counters cannot silently drift out of the exporter) and the merged
+  MonitorCollector exposition staying duplicate-free.
+"""
+
+import io
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from vtpu.models import ModelConfig, init_params
+from vtpu.obs.export import (
+    ALLOWLIST,
+    COUNTERS,
+    GAUGES,
+    HIST_COUNTERS,
+    SPECIAL,
+    ServingCollector,
+)
+from vtpu.obs.tickprof import BoundedHistogram, TickProfiler
+from vtpu.obs.trace import (
+    DROP_RESTORE_SEQUENCE,
+    SWAP_RESTORE_SEQUENCE,
+    RequestTrace,
+    subsequence,
+)
+from vtpu.serving import ServingConfig, ServingEngine
+
+CFG = ModelConfig(
+    vocab=64, d_model=32, n_heads=2, n_layers=1, d_ff=64,
+    max_seq=64, head_dim=16, dtype=jnp.float32, use_pallas=False,
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(jax.random.key(0), CFG)
+
+
+def _prompt(seed, n):
+    return [int(t) for t in jax.random.randint(
+        jax.random.key(seed), (n,), 1, CFG.vocab, jnp.int32)]
+
+
+# ------------------------------------------------------------------- unit
+
+
+def test_trace_ring_bounded_wraparound():
+    tr = RequestTrace(capacity=8)
+    for i in range(20):
+        tr.record("token", rid=i)
+    evs = tr.snapshot()
+    assert len(evs) == 8
+    # oldest events fell off; the survivors are the newest, in order
+    assert [e[3] for e in evs] == list(range(12, 20))
+    assert [e[0] for e in evs] == sorted(e[0] for e in evs)
+    assert tr.events_recorded == 20
+    assert tr.events_dropped == 12
+    # timestamps are monotonic_ns stamps, non-decreasing in seq order
+    ts = [e[1] for e in evs]
+    assert ts == sorted(ts)
+
+
+def test_trace_disabled_ring_keeps_latency_substrate():
+    """capacity=0 turns the event ring off, but the ITL/TTFT/queue-wait
+    reservoirs stay live — stats() percentiles must never vanish when an
+    operator disables event recording."""
+    tr = RequestTrace(capacity=0)
+    tr.record("token", rid=1)
+    assert tr.snapshot() == [] and tr.events_recorded == 0
+    assert tr.events_dropped == 0
+    tr.note_itl(0.002)
+    tr.note_ttft(0.5)
+    tr.note_queue_wait(0.1)
+    assert tr.itl_gaps() == [0.002]
+    assert tr.ttft_samples() == [0.5]
+    assert tr.queue_wait_samples() == [0.1]
+    assert tr.itl_hist.count == 1 and tr.ttft_hist.count == 1
+
+
+def test_span_parked_window_closes_on_retire_without_resume():
+    """Cancel-while-parked retires with no resume event: the parked
+    window must still fold into parked_ms (regression: it read 0.0)."""
+    tr = RequestTrace(capacity=64)
+    for ev, slot in (("submit", -1), ("admit", 0), ("first_token", 0),
+                     ("park", 0)):
+        tr.record(ev, 1, slot)
+    time.sleep(0.01)
+    tr.record("retire", 1)
+    s = tr.spans()[1]
+    assert s["parks"] == 1
+    assert s["parked_ms"] >= 9.0
+    assert s["retire_ns"] is not None
+
+
+def test_chrome_trace_deferred_park_resume_slice_is_queued():
+    """A session parked BEFORE admission resumes back into the waiting
+    line: the resume..admit window must render as 'queued', not
+    'streaming' (regression: every resume opened a streaming slice)."""
+    tr = RequestTrace(capacity=64)
+    for ev in ("submit", "park", "resume", "admit", "first_token",
+               "retire"):
+        tr.record(ev, 7)
+        time.sleep(0.002)
+    slices = [e for e in tr.chrome_trace()["traceEvents"]
+              if e["ph"] == "X" and e["tid"] == 7]
+    names = [e["name"] for e in sorted(slices, key=lambda e: e["ts"])]
+    # queued (submit->park is still pre-admission), parked, queued again
+    # (resume->admit), then streaming only from admit on
+    assert names == ["queued", "parked", "queued", "streaming"]
+
+
+def test_bounded_histogram_prom_buckets():
+    h = BoundedHistogram(edges_ms=(1.0, 10.0, 100.0))
+    for ms in (0.5, 5.0, 50.0, 500.0, 0.2):
+        h.note_ms(ms)
+    assert h.count == 5
+    assert h.max_ms == 500.0
+    buckets, total_s = h.prom_buckets()
+    # cumulative counts at le=0.001s, 0.01s, 0.1s, +Inf
+    assert [b[1] for b in buckets] == [2.0, 3.0, 4.0, 5.0]
+    assert buckets[-1][0] == "+Inf"
+    assert total_s == pytest.approx(0.5557)
+
+
+def test_tick_profiler_phases():
+    prof = TickProfiler()
+    prof.note("dispatch", 0.001)
+    prof.note("dispatch", 0.003)
+    prof.note("fetch", 0.0001)
+    snap = prof.snapshot()
+    assert set(snap) == {"admission", "dispatch", "fetch", "deliver",
+                         "swap_drain"}
+    assert snap["dispatch"]["count"] == 2
+    assert snap["dispatch"]["mean_ms"] == pytest.approx(2.0)
+    assert snap["fetch"]["count"] == 1
+    assert snap["deliver"]["count"] == 0
+
+
+# ------------------------------------------------- engine lifecycle trace
+
+
+def test_lifecycle_round_trips_through_trace(params):
+    """The acceptance bar: a park -> evict -> swap-out -> swap-in ->
+    resume lifecycle round-trips through the trace — the JSONL events
+    reconstruct the exact span sequence for BOTH restore paths (host-tier
+    swap-in and drop + recompute-on-fault), the derived spans carry the
+    parked/resume attribution, and the Chrome dump is valid
+    ``trace_event`` JSON."""
+    page, lc_prompt, lc_new = 8, 8, 24
+    pages_per = -(-(lc_prompt + lc_new) // page)
+    eng = ServingEngine(params, CFG, ServingConfig(
+        slots=2, prefill_buckets=(16,), max_new_tokens=lc_new,
+        prefill_chunk=16, kv_page=page, kv_pool_blocks=2 * pages_per,
+        kv_swap=pages_per))  # host tier holds ONE session's pages
+    eng.start()
+    try:
+        wave1 = [eng.submit(_prompt(900 + i, lc_prompt),
+                            max_new_tokens=lc_new) for i in range(2)]
+        for r in wave1:
+            for _ in range(2):
+                assert r.out.get(timeout=60) is not None
+        # park one at a time: park order is the eviction LRU axis, so
+        # wave1[0] deterministically takes the host tier and wave1[1]
+        # deterministically drops
+        for i, r in enumerate(wave1):
+            eng.park(r)
+            t0 = time.perf_counter()
+            while eng.stats()["parked_sessions"] < i + 1:
+                assert time.perf_counter() - t0 < 60, "park stalled"
+                time.sleep(0.002)
+        wave2 = [eng.submit(_prompt(910 + i, lc_prompt),
+                            max_new_tokens=lc_new) for i in range(2)]
+        for r in wave2:
+            list(r.stream())
+        for r in wave1:
+            eng.resume(r)
+            list(r.stream())
+        stats = eng.stats()
+        events = eng.trace.events()
+        spans = eng.trace.spans()
+        chrome = eng.trace.chrome_trace()
+        jsonl = io.StringIO()
+        n_written = eng.trace.to_jsonl(jsonl)
+    finally:
+        eng.stop()
+
+    assert stats["swap_out_bytes"] > 0 and stats["swap_in_bytes"] > 0
+    assert stats["fault_recomputes"] == 1
+    by_rid = {}
+    for e in events:
+        by_rid.setdefault(e["rid"], []).append(e["event"])
+    assert subsequence(SWAP_RESTORE_SEQUENCE, by_rid[wave1[0].rid])
+    assert subsequence(DROP_RESTORE_SEQUENCE, by_rid[wave1[1].rid])
+    # the dropped session must NOT report a swap-in, nor the swapped one
+    # a recompute — the two restore paths stay distinguishable
+    assert "swap_in" not in by_rid[wave1[1].rid]
+    assert "fault_recompute" not in by_rid[wave1[0].rid]
+    for r in wave1:
+        s = spans[r.rid]
+        assert s["tokens"] == lc_new
+        assert s["parks"] == 1 and s["parked_ms"] > 0
+        assert len(s["resume_latency_ms"]) == 1
+        assert s["ttft_ms"] is not None and s["queue_wait_ms"] is not None
+        assert s["queue_wait_ms"] <= s["ttft_ms"]
+        # the park..resume silence is resume latency, never an ITL sample
+        assert len(s["itl_ms"]) == lc_new - 2
+    assert spans[wave1[0].rid]["swap_out_bytes"] > 0
+    assert spans[wave1[0].rid]["swap_in_bytes"] > 0
+    assert spans[wave1[1].rid]["fault_recomputes"] == 1
+
+    # JSONL: one parseable record per event, same content as events()
+    lines = [json.loads(ln) for ln in jsonl.getvalue().splitlines()]
+    assert len(lines) == n_written == len(events)
+    assert lines == events
+
+    # Chrome dump: valid trace_event JSON — a traceEvents list whose every
+    # entry carries a phase and a name (the format Perfetto loads)
+    assert json.loads(json.dumps(chrome)) == chrome
+    tev = chrome["traceEvents"]
+    assert isinstance(tev, list) and len(tev) > 0
+    assert all(isinstance(e, dict) and "ph" in e and "name" in e
+               for e in tev)
+    slices = [e for e in tev if e["ph"] == "X"]
+    assert {"queued", "streaming", "parked"} <= {e["name"] for e in slices}
+    assert all(e["dur"] >= 0 and e["ts"] >= 0 for e in slices)
+
+
+def test_trace_off_engine_still_reports_percentiles(params):
+    """trace_events=0: no lifecycle events, but ITL/TTFT/queue-wait
+    percentiles (the reservoir views) keep flowing into stats()."""
+    eng = ServingEngine(params, CFG, ServingConfig(
+        slots=2, prefill_buckets=(8,), max_new_tokens=4, trace_events=0))
+    eng.start()
+    try:
+        reqs = [eng.submit(_prompt(i, 5), max_new_tokens=4)
+                for i in range(2)]
+        for r in reqs:
+            assert len(list(r.stream())) == 4
+        stats = eng.stats()
+    finally:
+        eng.stop()
+    assert stats["trace_enabled"] is False
+    assert stats["trace_events_recorded"] == 0
+    assert eng.trace.snapshot() == []
+    assert stats["itl_p50_ms"] is not None
+    assert stats["ttft_p50_ms"] is not None
+    assert stats["queue_wait_p50_ms"] is not None
+    assert stats["device_gets_per_tick"] == 1.0
+
+
+# ---------------------------------------------------------------- exporter
+
+
+def test_exporter_covers_every_stats_key(params):
+    """The satellite static check: every counter/gauge stats() returns has
+    a vtpu_serving_* mapping (or an explicit allowlist entry), so a new
+    engine counter cannot silently drift out of the exporter."""
+    eng = ServingEngine(params, CFG, ServingConfig(
+        slots=2, prefill_buckets=(16,), max_new_tokens=4,
+        prefill_chunk=16, kv_page=8, kv_swap=2))
+    mapped = set(COUNTERS) | set(GAUGES) | set(HIST_COUNTERS) | SPECIAL \
+        | ALLOWLIST
+    missing = sorted(k for k in eng.stats() if k not in mapped)
+    assert not missing, (
+        f"stats() keys with no vtpu_serving_* family and no allowlist "
+        f"entry: {missing} — map them in vtpu/obs/export.py (COUNTERS/"
+        f"GAUGES/HIST_COUNTERS) or allowlist them explicitly")
+
+
+def test_serving_families_shape(params):
+    eng = ServingEngine(params, CFG, ServingConfig(
+        slots=2, prefill_buckets=(8,), max_new_tokens=4))
+    eng.start()
+    try:
+        r = eng.submit(_prompt(1, 5), max_new_tokens=4)
+        assert len(list(r.stream())) == 4
+        col = ServingCollector({"engine0": eng})
+        fams = list(col.collect())
+    finally:
+        eng.stop()
+    names = [f.name for f in fams]
+    assert len(names) == len(set(names)), "duplicate family names"
+    assert all(n.startswith("vtpu_serving_") for n in names)
+    by_name = {f.name: f for f in fams}
+    tokens = by_name["vtpu_serving_tokens_generated"]
+    assert tokens.samples and tokens.samples[0].labels["engine"] == "engine0"
+    assert tokens.samples[0].value == 4.0
+    # span histograms ride the same scrape, with bucket samples
+    ttft = by_name["vtpu_serving_ttft_seconds"]
+    assert any(s.name.endswith("_bucket") for s in ttft.samples)
+    assert sum(1 for s in ttft.samples if s.name.endswith("_count")) == 1
+    phases = by_name["vtpu_serving_tick_phase_seconds"]
+    assert {"admission", "dispatch", "fetch", "deliver", "swap_drain"} == {
+        s.labels["phase"] for s in phases.samples if "phase" in s.labels}
+
+
+def test_monitor_collector_merges_serving(params, tmp_path):
+    """MonitorCollector(serving=...) yields the libvtpu/region families
+    AND the vtpu_serving_* set from one collect() — the single-scrape
+    contract — with no duplicate family names."""
+    from vtpu.monitor.lister import ContainerLister
+    from vtpu.monitor.metrics import MonitorCollector
+
+    eng = ServingEngine(params, CFG, ServingConfig(
+        slots=2, prefill_buckets=(8,), max_new_tokens=4))
+    (tmp_path / "containers").mkdir()
+    lister = ContainerLister(str(tmp_path))
+    col = MonitorCollector(lister, node_name="n1",
+                           serving=ServingCollector({"e": eng}))
+    fams = list(col.collect())
+    names = [f.name for f in fams]
+    assert len(names) == len(set(names)), "merged exposition has dup names"
+    assert "vtpu_memory_used_bytes" in names
+    assert "vtpu_serving_tokens_generated" in names
+    assert "vtpu_serving_tick_phase_seconds" in names

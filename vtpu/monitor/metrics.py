@@ -28,10 +28,18 @@ LEGACY_ALIASES = {
 
 class MonitorCollector(Collector):
     def __init__(self, lister: ContainerLister, node_name: str = "",
-                 legacy_metrics: bool = False):
+                 legacy_metrics: bool = False, serving=None):
+        """``serving`` (a vtpu.obs.export.ServingCollector, or any
+        Collector) merges the engine-side ``vtpu_serving_*`` families into
+        this collector's output, so ONE scrape endpoint serves both halves
+        of the telemetry: libvtpu/region device truth AND serving-engine
+        data-plane counters/spans (the HAMi layer map's monitor role —
+        vGPUmonitor feeds the scheduler; our scheduler-feedback loop needs
+        engine telemetry in the same scrape)."""
         self.lister = lister
         self.node_name = node_name
         self.legacy_metrics = legacy_metrics
+        self.serving = serving
 
     def collect(self):
         entries = self.lister.update()
@@ -154,6 +162,11 @@ class MonitorCollector(Collector):
         yield from self._host_families(entries)
         if self.legacy_metrics:
             yield from self._legacy_aliases(families)
+        if self.serving is not None:
+            # engine telemetry rides the same scrape: vtpu_serving_*
+            # families from every registered ServingEngine (disjoint name
+            # prefix — the merged exposition stays duplicate-free)
+            yield from self.serving.collect()
 
     def _host_families(self, entries):
         """Host-level per-chip view (reference metrics.go:88-148

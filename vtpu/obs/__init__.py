@@ -1,0 +1,44 @@
+"""Serving-engine observability: request-lifecycle tracing, tick-phase
+profiling, and the unified ``vtpu_serving_*`` Prometheus exporter.
+
+Three pieces, all host-side (nothing here ever touches the device — the
+overhead contract benchmarks/obs_bench.py gates is that tracing adds zero
+host syncs and stays within 2% tokens/sec of tracing-off):
+
+- trace.py:    a lock-light bounded ring of structured lifecycle events
+               (submit .. retire) stamped ``time.monotonic_ns`` off the
+               tick hot path, with derived per-request spans, JSONL export
+               and a Chrome ``trace_event`` dump that loads in Perfetto.
+- tickprof.py: per-tick decode-loop phase attribution (admission head,
+               dispatch, fetch, deliver, swap drain) into bounded
+               histograms — where ``host_ms_per_tick`` actually goes.
+- export.py:   the ``vtpu_serving_*`` Prometheus family set over
+               ``ServingEngine.stats()`` + the span/phase histograms,
+               registered into the monitor's collector so ONE scrape
+               endpoint serves libvtpu and engine telemetry.
+- summary.py:  the shared one-line stdout summary helper every benchmark's
+               final line goes through (the PR-3 driver-artifact
+               convention).
+"""
+
+from vtpu.obs.summary import print_summary, summary_line
+from vtpu.obs.tickprof import BoundedHistogram, TickProfiler
+from vtpu.obs.trace import RequestTrace, pct
+
+try:  # the exporter needs prometheus_client; tracing/profiling do not —
+    # the serving engine must stay importable without the monitor's deps
+    from vtpu.obs.export import ServingCollector, serving_families
+except ImportError:  # pragma: no cover
+    ServingCollector = None  # type: ignore[assignment]
+    serving_families = None  # type: ignore[assignment]
+
+__all__ = [
+    "BoundedHistogram",
+    "RequestTrace",
+    "ServingCollector",
+    "TickProfiler",
+    "pct",
+    "print_summary",
+    "serving_families",
+    "summary_line",
+]

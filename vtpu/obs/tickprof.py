@@ -1,0 +1,113 @@
+"""Tick-phase profiler: where a serving tick's host time actually goes.
+
+The engine used to report one ``host_ms_per_tick`` EMA — a single number
+that says a tick costs 2 ms of host work without saying WHICH work. This
+module gives that number attribution: each loop pass notes the seconds it
+spent in each phase into a bounded histogram, so a TTFT p99 outlier can be
+blamed on admission head-of-line work vs the device fetch vs Python
+delivery bookkeeping vs swap-drain housekeeping.
+
+Phases (one histogram each):
+
+- admission:  ``_tick_head`` minus swap drain — queue drain, chunk
+              advancement, batched admission dispatch, lifecycle commands.
+- dispatch:   building and issuing the decode/spec dispatch (host-side
+              array builds + the async jit call).
+- fetch:      the tick's single batched ``jax.device_get`` — on the
+              pipelined loop this includes waiting for the device to
+              finish the in-flight tick, i.e. it is the device-bound
+              share of the tick.
+- deliver:    pure-Python bookkeeping after the fetch (stream puts,
+              budget/eos/retire, history).
+- swap_drain: landing completed D2H swap-out snapshots in the host pool.
+
+Everything is plain host arithmetic: a ``note()`` is one bisect over a
+static bucket table plus four scalar updates, cheap enough for five calls
+per tick. Writers are the serving-loop thread; ``snapshot()`` readers from
+other threads see monotonic counters (benign racing, same contract as
+``ServingEngine.stats()``).
+"""
+
+from __future__ import annotations
+
+from bisect import bisect_left
+
+# Default bucket upper edges in MILLISECONDS. Tick phases live in the
+# 10 us .. 100 ms range on real rigs; span latencies (TTFT/ITL/queue wait,
+# see trace.py) reuse the same class with the wider LATENCY edges.
+PHASE_BUCKETS_MS = (
+    0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0,
+    100.0, 250.0, 1000.0,
+)
+LATENCY_BUCKETS_MS = (
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+    1000.0, 2500.0, 5000.0, 10000.0,
+)
+
+PHASES = ("admission", "dispatch", "fetch", "deliver", "swap_drain")
+
+
+class BoundedHistogram:
+    """Fixed-bucket monotonic histogram (count / sum / max + per-bucket
+    counts). Monotonic on purpose: the Prometheus exporter publishes it as
+    a real histogram family, so counts must only ever grow — a reservoir
+    would make ``rate()`` lie."""
+
+    __slots__ = ("edges_ms", "counts", "count", "total_ms", "max_ms")
+
+    def __init__(self, edges_ms: tuple = PHASE_BUCKETS_MS):
+        self.edges_ms = tuple(edges_ms)
+        self.counts = [0] * (len(self.edges_ms) + 1)  # +1: the +Inf bucket
+        self.count = 0
+        self.total_ms = 0.0
+        self.max_ms = 0.0
+
+    def note_ms(self, ms: float) -> None:
+        self.counts[bisect_left(self.edges_ms, ms)] += 1
+        self.count += 1
+        self.total_ms += ms
+        if ms > self.max_ms:
+            self.max_ms = ms
+
+    def note(self, seconds: float) -> None:
+        self.note_ms(seconds * 1e3)
+
+    @property
+    def mean_ms(self) -> float:
+        return self.total_ms / self.count if self.count else 0.0
+
+    def snapshot(self) -> dict:
+        return {
+            "count": self.count,
+            "total_ms": round(self.total_ms, 4),
+            "mean_ms": round(self.mean_ms, 4),
+            "max_ms": round(self.max_ms, 4),
+        }
+
+    def prom_buckets(self) -> tuple[list[tuple[str, float]], float]:
+        """(cumulative (le, count) pairs with le in SECONDS, sum in
+        seconds) — the shape HistogramMetricFamily.add_metric wants."""
+        acc, out = 0, []
+        for edge_ms, c in zip(self.edges_ms, self.counts):
+            acc += c
+            out.append((repr(edge_ms / 1e3), float(acc)))
+        out.append(("+Inf", float(self.count)))
+        return out, self.total_ms / 1e3
+
+
+class TickProfiler:
+    """One BoundedHistogram per decode-loop phase."""
+
+    __slots__ = ("phases",)
+
+    def __init__(self, phases: tuple = PHASES,
+                 edges_ms: tuple = PHASE_BUCKETS_MS):
+        self.phases = {p: BoundedHistogram(edges_ms) for p in phases}
+
+    def note(self, phase: str, seconds: float) -> None:
+        self.phases[phase].note(seconds)
+
+    def snapshot(self) -> dict:
+        """{phase: {count, total_ms, mean_ms, max_ms}} — the stats() view
+        that replaces the single host-EMA number with attribution."""
+        return {p: h.snapshot() for p, h in self.phases.items()}

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import itertools
 import logging
 import queue
 import threading
@@ -30,6 +31,9 @@ from typing import Any, Callable, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from vtpu.obs.tickprof import TickProfiler
+from vtpu.obs.trace import RequestTrace, pct
 
 from vtpu.models.transformer import (
     ModelConfig,
@@ -192,6 +196,15 @@ class ServingConfig:
     # is cheaper than a swap-in round trip. 0 = recompute only on a fault
     # (pages dropped because the host tier was full).
     kv_swap_recompute_tokens: int = 0
+    # --- observability (vtpu/obs) ----------------------------------------
+    # Request-lifecycle event ring capacity (submit/admit/first-token/park/
+    # evict/swap/resume/retire + per-token events), read via engine.trace:
+    # spans, JSONL, Chrome trace_event dumps. 0 disables the ring (the
+    # latency reservoirs behind itl/ttft percentiles stay on — they ARE
+    # the stats() telemetry). Recording is host-only and lock-light; the
+    # overhead contract (obs_bench.py) is zero added host syncs and
+    # tokens/sec within 2% of tracing-off.
+    trace_events: int = 16384
 
 
 def choose_kv_int8(slots: int, max_window: int) -> bool:
@@ -377,6 +390,12 @@ class Request:
     # priority-0 batch conversation spills to host RAM before a priority-9
     # interactive one does
     priority: int = 0
+    # trace identity: assigned by submit() (engine-unique, monotonic) and
+    # stamped on every lifecycle event this request emits; -1 until then
+    rid: int = -1
+    # submit() timestamp (time.monotonic_ns) — the origin every derived
+    # span (queue wait, TTFT) measures from
+    t_submit_ns: int = 0
     out: "queue.Queue[Optional[int]]" = dataclasses.field(default_factory=queue.Queue)
     cancelled: bool = False
     # per-token log p under the engine's sampling distribution, appended at
@@ -1384,15 +1403,18 @@ class ServingEngine:
         # the tick loop — the stall the batched-async path shrinks)
         self._admission_ms_ema: Optional[float] = None
         # per-slot inter-token latency: timestamp of the last delivery per
-        # slot + a bounded reservoir of gaps feeding the p50/p99 telemetry
-        # (a slot's FIRST token records no gap — that interval is TTFT)
+        # slot (a slot's FIRST token records no gap — that interval is
+        # TTFT). The gap/TTFT/queue-wait reservoirs themselves live in the
+        # trace substrate below: stats() percentiles are a VIEW over it.
         self._itl_last: list[Optional[float]] = [None] * b
-        self._itl_gaps: "collections.deque[float]" = collections.deque(
-            maxlen=2048)
-        # appends come from the loop thread, stats() snapshots from client
-        # threads — iterating a deque mid-append raises RuntimeError, so
-        # both sides take this (uncontended, per-delivery-round) lock
-        self._itl_lock = threading.Lock()
+        # observability substrate (vtpu/obs): the request-lifecycle event
+        # ring + latency reservoirs/histograms, and the tick-phase
+        # profiler that attributes host_ms_per_tick (admission head,
+        # dispatch, fetch, deliver, swap drain). Host-only by
+        # construction: nothing here can add a device sync.
+        self.trace = RequestTrace(capacity=serving.trace_events)
+        self._prof = TickProfiler()
+        self._req_ctr = itertools.count()
         # registered prompt prefixes: id -> {tokens, buffers, len, pad,
         # last_logits}; install is a device copy, suffixes chunk from the
         # prefix offset
@@ -1686,6 +1708,9 @@ class ServingEngine:
         req = Request(tokens=tokens, prefix=prefix,
                       max_new_tokens=max_new_tokens or self.serving.max_new_tokens,
                       priority=priority)
+        req.rid = next(self._req_ctr)
+        req.t_submit_ns = time.monotonic_ns()
+        self.trace.record("submit", req.rid, -1, int(tokens.shape[0]))
         self._pending.put(req)
         self._wake.set()
         if self._stop.is_set():
@@ -1959,8 +1984,10 @@ class ServingEngine:
             e["pend"] = snaps
             self._swap_pending.append(e)
             self._stats["swap_out_bytes"] += m * self._block_bytes
+            spilled = True
         elif e["recompute_ok"]:
             e["dropped"] = True
+            spilled = False
         else:
             # neither spillable nor rebuildable: the pages MUST stay
             # resident (dropping them would wedge the resume) — correct
@@ -1968,6 +1995,10 @@ class ServingEngine:
             # caller's evictability snapshot went stale
             return
         self._stats["evicted_blocks"] += m
+        self.trace.record("evict", e["req"].rid, -1, m)
+        if spilled:
+            self.trace.record("swap_out", e["req"].rid, -1,
+                              m * self._block_bytes)
         self._alloc.release(priv)
         e["priv"] = []
 
@@ -2087,6 +2118,7 @@ class ServingEngine:
         self._itl_last[slot] = None
         self._admit_mask[slot] = False
         self._stats["parks"] += 1
+        self.trace.record("park", req.rid, slot, len(blocks))
 
     def _process_lifecycle(self) -> None:
         """Drain park/resume commands from client threads and apply the
@@ -2113,6 +2145,9 @@ class ServingEngine:
                 # resume instead would strand a parked client forever)
                 self._want_park.discard(req)
             elif req in self._parked and req not in self._want_resume:
+                # the resume-latency span starts HERE (command accepted),
+                # one lifecycle drain after the client's resume() call
+                self.trace.record("resume", req.rid)
                 self._want_resume.append(req)
         for req in list(self._want_park):
             if req.cancelled or req in self._parked:
@@ -2135,6 +2170,7 @@ class ServingEngine:
                 self._park_seq += 1
                 self._want_park.discard(req)
                 self._stats["parks"] += 1
+                self.trace.record("park", req.rid)
                 continue
             try:
                 slot = self._slot_req.index(req)
@@ -2161,6 +2197,7 @@ class ServingEngine:
                 self._do_park(slot)
         for req in [r for r, e in self._parked.items() if r.cancelled]:
             self._release_parked(self._parked.pop(req))
+            self.trace.record("retire", req.rid)
             req.out.put(None)
 
     def _advance_resumes(self, budget: float = float("inf")) -> float:
@@ -2259,6 +2296,8 @@ class ServingEngine:
         e["priv"] = priv
         self._stats["swap_in_bytes"] += need * self._block_bytes
         self._stats["swap_faults"] += 1
+        self.trace.record("swap_in", e["req"].rid, slot,
+                          need * self._block_bytes)
         self._finish_resume_slot(slot, e)
         return True
 
@@ -2333,6 +2372,7 @@ class ServingEngine:
             self.state, jnp.int32(slot), trow, jnp.int32(0))
         self._stats["swap_faults"] += 1
         self._stats["fault_recomputes"] += 1
+        self.trace.record("fault_recompute", req.rid, slot, n)
         toks = e["tokens"]
         bucket = next((b for b in self._prefill_buckets if b >= n), None)
         if bucket is not None:
@@ -2487,6 +2527,7 @@ class ServingEngine:
             # recompute-on-fault rebuilds
             self._seed_history(slot, req, n)
         self._stats["admissions"] += 1
+        self._note_admit(req, slot, n)
 
     def _begin_slot_async(self, slot: int, req: Request, logits_row,
                           n: int) -> None:
@@ -2520,6 +2561,7 @@ class ServingEngine:
             head = self._waiting.head()
             if head.cancelled:
                 self._waiting.popleft()
+                self.trace.record("retire", head.rid)
                 head.out.put(None)
                 continue
             n_head = int(head.tokens.shape[0])
@@ -2529,6 +2571,7 @@ class ServingEngine:
                 if self._paged and not self._reserve_paged(free[0], head):
                     break  # pool exhausted: head parks (backpressure)
                 self._waiting.popleft()
+                self.trace.record("queue_depart", head.rid, free[0])
                 self._admit(free.pop(0), head)
                 admitted = True
                 continue
@@ -2539,6 +2582,7 @@ class ServingEngine:
                 if self._paged and not self._reserve_paged(free[0], head):
                     break  # pool exhausted: head parks (backpressure)
                 self._waiting.popleft()
+                self.trace.record("queue_depart", head.rid, free[0])
                 self._admit(free.pop(0), head)
                 budget -= bucket
                 admitted = True
@@ -2581,6 +2625,7 @@ class ServingEngine:
                 batch = batch[:m]
             for req in batch:
                 self._waiting.remove(req)
+                self.trace.record("queue_depart", req.rid)
             slots = [free.pop(0) for _ in batch]
             self._admit_batch(slots, batch, bucket)
             budget -= len(batch) * bucket
@@ -2604,6 +2649,7 @@ class ServingEngine:
             if req.cancelled:
                 del self._admitting[slot]
                 self._free_slot_blocks(slot)
+                self.trace.record("retire", req.rid, slot)
                 req.out.put(None)
                 continue
             c = self._chunk
@@ -2635,6 +2681,7 @@ class ServingEngine:
             adm["off"] = off + c
             budget -= c
             self._stats["prefill_chunks"] += 1
+            self.trace.record("prefill_chunk", req.rid, slot, c)
             if adm["off"] >= adm["padded"].shape[1]:  # final chunk
                 del self._admitting[slot]
                 if adm.get("resume") is not None:
@@ -2685,7 +2732,14 @@ class ServingEngine:
         self._stats["bytes_fetched"] += sum(
             a.size * a.dtype.itemsize
             for a in jax.tree_util.tree_leaves(arrays))
-        return jax.device_get(arrays)
+        t0 = time.perf_counter()
+        out = jax.device_get(arrays)
+        # fetch phase = device wait + transfer: on the pipelined loop this
+        # is the time the host blocks for the in-flight tick to finish —
+        # the device-bound share of the tick, attributed separately from
+        # the Python bookkeeping phases
+        self._prof.note("fetch", time.perf_counter() - t0)
+        return out
 
     def _note_host_ms(self, seconds: float) -> None:
         ms = seconds * 1e3
@@ -2720,13 +2774,29 @@ class ServingEngine:
             rh[live] = rh.get(live, 0) + 1
 
     def _note_itl(self, slot: int, now: float) -> None:
-        """Record one inter-token gap for *slot* (first token after
-        admission only stamps the clock — that interval is TTFT)."""
+        """Record one inter-token gap for *slot* into the trace substrate
+        (first token after admission only stamps the clock — that interval
+        is TTFT). The stats() percentiles and the exporter's ITL histogram
+        are views over what lands here."""
         last = self._itl_last[slot]
         if last is not None:
-            with self._itl_lock:
-                self._itl_gaps.append(now - last)
+            self.trace.note_itl(now - last)
         self._itl_last[slot] = now
+
+    def _note_admit(self, req: Request, slot: int, n: int) -> None:
+        """Trace an admission: the 'admit' lifecycle event plus the
+        queue-wait reservoir sample (submit -> slot bookkeeping)."""
+        now_ns = time.monotonic_ns()
+        self.trace.record("admit", req.rid, slot, n)
+        if req.t_submit_ns:
+            self.trace.note_queue_wait((now_ns - req.t_submit_ns) / 1e9)
+
+    def _note_first_token(self, req: Request, slot: int) -> None:
+        """Trace a request's first delivered token + its TTFT sample."""
+        now_ns = time.monotonic_ns()
+        self.trace.record("first_token", req.rid, slot)
+        if req.t_submit_ns:
+            self.trace.note_ttft((now_ns - req.t_submit_ns) / 1e9)
 
     def _deliver_firsts(self, firsts: list[dict],
                         fetched: Optional[list] = None) -> None:
@@ -2759,6 +2829,7 @@ class ServingEngine:
         if self._track_history:
             self._history[slot].append(tok)
         self._itl_last[slot] = time.perf_counter()
+        self._note_first_token(req, slot)
         req.out.put(tok)
         self._stats["generated_tokens"] += 1
         if self._slot_budget[slot] <= 0 or tok == self.serving.eos_token:
@@ -2802,6 +2873,7 @@ class ServingEngine:
             self._emit(slot, int(toks[slot]),
                        float(lps[slot]) if lps is not None else None,
                        now=now)
+        self._prof.note("deliver", time.perf_counter() - t0)
         self._note_host_ms(extra_host_s + time.perf_counter() - t0)
 
     def _emit(self, slot: int, tok: int, lp: Optional[float] = None,
@@ -2816,6 +2888,7 @@ class ServingEngine:
         self._tokens[slot] = tok
         self._slot_len[slot] += 1
         self._note_itl(slot, now if now is not None else time.perf_counter())
+        self.trace.record("token", req.rid, slot)
         # logprob BEFORE the queue put: the put unblocks the client thread,
         # which may immediately read logprobs[-1] expecting this token's
         # entry to exist
@@ -2848,6 +2921,8 @@ class ServingEngine:
         self._stats["admissions"] += 1
         self._stats["generated_tokens"] += 1
         self._itl_last[slot] = time.perf_counter()
+        self._note_admit(req, slot, n)
+        self._note_first_token(req, slot)
         req.out.put(first)
         if self._slot_budget[slot] <= 0 or first == self.serving.eos_token:
             self._retire(slot)
@@ -2911,13 +2986,29 @@ class ServingEngine:
         s["admission_stall_ms"] = (
             round(self._admission_ms_ema, 4)
             if self._admission_ms_ema is not None else None)
-        with self._itl_lock:
-            gaps = sorted(self._itl_gaps)
-        s["itl_p50_ms"] = (
-            round(gaps[len(gaps) // 2] * 1e3, 3) if gaps else None)
-        s["itl_p99_ms"] = (
-            round(gaps[min(len(gaps) - 1, int(len(gaps) * 0.99))] * 1e3, 3)
-            if gaps else None)
+        # span telemetry is a VIEW over the trace substrate (vtpu/obs):
+        # the ITL/TTFT/queue-wait reservoirs the engine feeds as it
+        # delivers tokens — the same numbers the vtpu_serving_* exporter
+        # publishes as histograms and bench.py audits per tenant
+        gaps = sorted(self.trace.itl_gaps())
+        for q, key in ((0.5, "itl_p50_ms"), (0.99, "itl_p99_ms")):
+            v = pct(gaps, q)
+            s[key] = round(v * 1e3, 3) if v is not None else None
+        ttfts = sorted(self.trace.ttft_samples())
+        for q, key in ((0.5, "ttft_p50_ms"), (0.95, "ttft_p95_ms"),
+                       (0.99, "ttft_p99_ms")):
+            v = pct(ttfts, q)
+            s[key] = round(v * 1e3, 3) if v is not None else None
+        waits = sorted(self.trace.queue_wait_samples())
+        for q, key in ((0.5, "queue_wait_p50_ms"), (0.99, "queue_wait_p99_ms")):
+            v = pct(waits, q)
+            s[key] = round(v * 1e3, 3) if v is not None else None
+        s["trace_enabled"] = self.trace.enabled
+        s["trace_events_recorded"] = self.trace.events_recorded
+        s["trace_events_dropped"] = self.trace.events_dropped
+        # tick-phase attribution: where host_ms_per_tick actually goes
+        # (admission head / dispatch / fetch / deliver / swap drain)
+        s["tick_phase_ms"] = self._prof.snapshot()
         s["device_sampling"] = self._device_sampling
         s["pipelined"] = self._pipeline
         s["batched_admission"] = self._async_admission
@@ -2981,9 +3072,17 @@ class ServingEngine:
             len(self._host_free) if self._swap_enabled else None)
         return s
 
+    @property
+    def tick_profile(self) -> TickProfiler:
+        """The tick-phase profiler (vtpu/obs/tickprof): per-phase bounded
+        histograms behind stats()['tick_phase_ms'] and the exporter's
+        vtpu_serving_tick_phase_seconds family."""
+        return self._prof
+
     def _retire(self, slot: int) -> None:
         req = self._slot_req[slot]
         if req is not None:
+            self.trace.record("retire", req.rid, slot)
             req.out.put(None)
         self._slot_req[slot] = None
         self._slot_budget[slot] = 0
@@ -3144,6 +3243,7 @@ class ServingEngine:
         first: finishing an admission frees its head-of-line latency and
         its budget claim. Returns whether any admission happened."""
         t0 = time.perf_counter()
+        swap_s = 0.0
         if self._paged:
             self._drain_prefix_work()
         while True:
@@ -3156,7 +3256,10 @@ class ServingEngine:
             # parks, land READY swap-out transfers in the host pool (a
             # still-in-flight one waits — the tick never blocks on D2H)
             self._process_lifecycle()
+            t_sw = time.perf_counter()
             self._drain_swap_outs()
+            swap_s = time.perf_counter() - t_sw
+            self._prof.note("swap_drain", swap_s)
         decoding = any(r is not None for r in self._slot_req)
         budget = (
             float(self.serving.prefill_budget)
@@ -3176,6 +3279,10 @@ class ServingEngine:
             if req is not None and req.cancelled:
                 self._retire(slot)
         self._note_admission_ms(time.perf_counter() - t0)
+        # phase attribution: the admission head minus the swap drain
+        # (profiled on its own above) — where a TTFT outlier's host share
+        # of the tick actually went
+        self._prof.note("admission", time.perf_counter() - t0 - swap_s)
         return admitted
 
     def _idle_wait(self, admitted: bool) -> None:
@@ -3323,6 +3430,7 @@ class ServingEngine:
                              for i in range(b)],
                 }
                 disp_s = time.perf_counter() - t_disp
+                self._prof.note("dispatch", disp_s)
             if inflight is not None:
                 self._deliver(inflight, extra_host_s=disp_s, firsts=firsts)
             elif firsts:
@@ -3425,6 +3533,7 @@ class ServingEngine:
                     unroll=self._unroll,
                 )
                 disp_s = time.perf_counter() - t_disp
+                self._prof.note("dispatch", disp_s)
                 pred, count = self._fetch((pred, count))
                 t0 = time.perf_counter()
                 emitted_total = 0
@@ -3439,6 +3548,7 @@ class ServingEngine:
                         emitted = emitted[: emitted.index(eos) + 1]
                     req = self._slot_req[slot]
                     for tok in emitted:
+                        self.trace.record("token", req.rid, slot)
                         req.out.put(tok)
                     # acceptance accounting uses DELIVERED tokens (post-eos
                     # truncation): the device's raw count includes tokens
@@ -3476,6 +3586,7 @@ class ServingEngine:
                 if (self.serving.spec_min_mean
                         and self._spec_ema < self.serving.spec_min_mean):
                     self._spec_cooloff = self.serving.spec_cooloff_ticks
+                self._prof.note("deliver", time.perf_counter() - t0)
                 self._note_host_ms(disp_s + time.perf_counter() - t0)
                 continue
             if self._device_sampling:
@@ -3490,10 +3601,12 @@ class ServingEngine:
                 # this iteration, so the snapshot is simply the list (the
                 # pipelined loop's dispatch can be a strict subset; here it
                 # cannot)
+                disp_s = time.perf_counter() - t_disp
+                self._prof.note("dispatch", disp_s)
                 self._deliver({
                     "tokens": tok_d, "logprobs": lp_d,
                     "reqs": list(self._slot_req),
-                }, extra_host_s=time.perf_counter() - t_disp, firsts=firsts)
+                }, extra_host_s=disp_s, firsts=firsts)
                 continue
             # host-sampler fallback: fetch the FULL logits once (still a
             # single batched device_get — never B per-slot syncs) and run
@@ -3504,8 +3617,10 @@ class ServingEngine:
             )
             self._stats["decode_ticks"] += 1
             disp_s = time.perf_counter() - t_disp
+            self._prof.note("dispatch", disp_s)
             logits = self._fetch(logits)
             t0 = time.perf_counter()
             for slot in active_slots:
                 self._emit(slot, self.sample(logits[slot]))
+            self._prof.note("deliver", time.perf_counter() - t0)
             self._note_host_ms(disp_s + time.perf_counter() - t0)
