@@ -54,6 +54,152 @@ def pct(sorted_vals, q):
     return sorted_vals[min(len(sorted_vals) - 1, int(len(sorted_vals) * q))]
 
 
+def run_mixed_arm(params, cfg, serving, a, name: str,
+                  drain: bool = True) -> dict:
+    """One mixed-load arm: warmup wave, steady background streams (ITL
+    measured by client threads), a seeded Poisson burst (TTFT measured per
+    request), and — when ``drain`` — the deterministic same-bucket
+    coalescing phase. Shared by this bench's sync/async A/B and by
+    benchmarks/disagg_bench.py's co-scheduled/disagg A/B (which skips the
+    drain phase: the disagg worker admits through handoffs, not batched
+    prefill dispatches, so the dispatch-count bound doesn't apply)."""
+    import jax
+    import jax.numpy as jnp
+
+    from vtpu.serving import ServingEngine
+
+    bg_free = a.slots - a.bg
+
+    def prompt(seed: int):
+        return [int(t) for t in jax.random.randint(
+            jax.random.key(seed), (a.prompt_len,), 1, cfg.vocab, jnp.int32)]
+
+    eng = ServingEngine(params, cfg, serving)
+    eng.start()
+    try:
+        # warmup wave: every executable compiled, thread steady state
+        for r in [eng.submit(prompt(1 + i), max_new_tokens=4)
+                  for i in range(a.slots)]:
+            for _ in r.stream():
+                pass
+        # background streams: client threads record per-token stamps
+        bg_reqs = [eng.submit(prompt(100 + i), max_new_tokens=a.bg_steps)
+                   for i in range(a.bg)]
+        gap_log: list[tuple[float, float]] = []
+        lock = threading.Lock()
+
+        def consume_bg(req):
+            last = None
+            for _ in req.stream():
+                now = time.perf_counter()
+                if last is not None:
+                    with lock:
+                        gap_log.append((now, now - last))
+                last = now
+
+        bg_threads = [threading.Thread(target=consume_bg, args=(r,))
+                      for r in bg_reqs]
+        for t in bg_threads:
+            t.start()
+        time.sleep(0.05)  # let the pool reach steady decode
+        # Poisson burst: seeded arrivals, TTFT measured per request
+        rng = random.Random(a.seed)
+        ttfts: list[float] = []
+        burst_threads = []
+
+        def consume_burst(req, t0):
+            first = True
+            for _ in req.stream():
+                if first:
+                    with lock:
+                        ttfts.append(time.perf_counter() - t0)
+                    first = False
+
+        t_burst0 = time.perf_counter()
+        for i in range(a.burst):
+            t0 = time.perf_counter()
+            req = eng.submit(prompt(1000 + i),
+                             max_new_tokens=a.burst_steps)
+            th = threading.Thread(target=consume_burst, args=(req, t0))
+            th.start()
+            burst_threads.append(th)
+            time.sleep(rng.expovariate(1000.0 / a.mean_gap_ms) / 1000.0)
+        for th in burst_threads:
+            th.join()
+        t_burst1 = time.perf_counter()
+        drain_dispatches = None
+        if drain:
+            # deterministic coalescing phase: occupy every non-background
+            # slot with blockers, queue K same-bucket prompts behind them,
+            # then cancel the blockers — all K wait together and the freed
+            # slots return in ONE retire sweep, so the burst must drain in
+            # <= ceil(K/Nmax) prefill dispatches (Nmax = the largest
+            # warmed batch the per-tick budget admits while decoding)
+            blockers = [eng.submit(prompt(3000 + i), max_new_tokens=256)
+                        for i in range(bg_free)]
+            blocker_streams = [iter(r.stream()) for r in blockers]
+            for s in blocker_streams:
+                next(s)  # every blocker slot admitted and streaming
+            hist0 = eng.stats()["prefill_batch_hist"]
+            drain_reqs = [eng.submit(prompt(2000 + i), max_new_tokens=2)
+                          for i in range(bg_free)]
+            for r in blockers:
+                r.cancel()
+            for r in drain_reqs:
+                for _ in r.stream():
+                    pass
+            hist1 = eng.stats()["prefill_batch_hist"]
+            drain_dispatches = sum(b1 - b0 for b0, b1 in zip(hist0, hist1))
+        for r in bg_reqs:
+            r.cancel()
+        for t in bg_threads:
+            t.join()
+        stats = eng.stats()
+    finally:
+        eng.stop()
+    burst_gaps = sorted(g * 1e3 for ts, g in gap_log
+                        if t_burst0 <= ts <= t_burst1)
+    all_gaps = sorted(g * 1e3 for _, g in gap_log)
+    ttfts_ms = sorted(t * 1e3 for t in ttfts)
+    # largest batch a single dispatch may carry while decoding: warmed
+    # sizes capped by the free slots and by the per-tick prefill budget
+    budget = serving.prefill_budget
+    fit = [s for s in eng._admit_sizes
+           if s <= bg_free and (not budget or s * BUCKET <= budget)]
+    nmax = max(fit) if fit else 1
+    out = {
+        "arm": name,
+        "bg_itl_p50_ms": round(pct(burst_gaps, 0.50) or 0.0, 3),
+        "bg_itl_p99_ms": round(pct(burst_gaps, 0.99) or 0.0, 3),
+        "bg_itl_p99_ms_full_run": round(pct(all_gaps, 0.99) or 0.0, 3),
+        "ttft_p50_ms": round(pct(ttfts_ms, 0.50) or 0.0, 3),
+        "ttft_p99_ms": round(pct(ttfts_ms, 0.99) or 0.0, 3),
+        "ttft_runs": len(ttfts_ms),
+        "drain_prompts": bg_free if drain else None,
+        "drain_dispatches": drain_dispatches,
+        "drain_dispatch_bound": -(-bg_free // nmax) if drain else None,
+        "admission_syncs": stats["admission_syncs"],
+        "admission_stall_ms": stats["admission_stall_ms"],
+        "prefill_batch_hist": stats["prefill_batch_hist"],
+        "batched_admission": stats["batched_admission"],
+        # TTFT attribution (the trace-substrate split) + the disagg
+        # handoff contract counters — zero / None on co-scheduled arms
+        "queue_wait_p99_ms": stats["queue_wait_p99_ms"],
+        "prefill_exec_p99_ms": stats["prefill_exec_p99_ms"],
+        "disagg": stats["disagg"],
+        "handoffs": stats["handoffs"],
+        "handoff_copies": stats["handoff_copies"],
+        "repartitions": stats["repartitions"],
+        "device_gets_per_tick": stats["device_gets_per_tick"],
+    }
+    print(f"{name:>7}: bg ITL p99 {out['bg_itl_p99_ms']:8.2f} ms, "
+          f"TTFT p50 {out['ttft_p50_ms']:7.2f} ms, p99 "
+          f"{out['ttft_p99_ms']:7.2f} ms, "
+          f"{out['admission_syncs']} admission syncs, "
+          f"hist {out['prefill_batch_hist']}", file=sys.stderr)
+    return out
+
+
 def main() -> None:
     ap = argparse.ArgumentParser("prefill-bench")
     ap.add_argument("--quick", action="store_true",
@@ -83,7 +229,7 @@ def main() -> None:
     import jax.numpy as jnp
 
     from vtpu.models import ModelConfig, init_params
-    from vtpu.serving import ServingConfig, ServingEngine
+    from vtpu.serving import ServingConfig
 
     # Tiny on purpose (same scale as decode_bench): per-tick device compute
     # is small, so the A/B isolates what ADMISSION costs the tick loop —
@@ -98,131 +244,12 @@ def main() -> None:
     if bg_free < 1:
         sys.exit("--bg must leave at least one free slot for the burst")
 
-    def prompt(seed: int):
-        return [int(t) for t in jax.random.randint(
-            jax.random.key(seed), (a.prompt_len,), 1, cfg.vocab, jnp.int32)]
-
-    def run_arm(name: str, serving: ServingConfig) -> dict:
-        eng = ServingEngine(params, cfg, serving)
-        eng.start()
-        try:
-            # warmup wave: every executable compiled, thread steady state
-            for r in [eng.submit(prompt(1 + i), max_new_tokens=4)
-                      for i in range(a.slots)]:
-                for _ in r.stream():
-                    pass
-            # background streams: client threads record per-token stamps
-            bg_reqs = [eng.submit(prompt(100 + i), max_new_tokens=a.bg_steps)
-                       for i in range(a.bg)]
-            gap_log: list[tuple[float, float]] = []
-            lock = threading.Lock()
-
-            def consume_bg(req):
-                last = None
-                for _ in req.stream():
-                    now = time.perf_counter()
-                    if last is not None:
-                        with lock:
-                            gap_log.append((now, now - last))
-                    last = now
-
-            bg_threads = [threading.Thread(target=consume_bg, args=(r,))
-                          for r in bg_reqs]
-            for t in bg_threads:
-                t.start()
-            time.sleep(0.05)  # let the pool reach steady decode
-            # Poisson burst: seeded arrivals, TTFT measured per request
-            rng = random.Random(a.seed)
-            ttfts: list[float] = []
-            burst_threads = []
-
-            def consume_burst(req, t0):
-                first = True
-                for _ in req.stream():
-                    if first:
-                        with lock:
-                            ttfts.append(time.perf_counter() - t0)
-                        first = False
-
-            t_burst0 = time.perf_counter()
-            for i in range(a.burst):
-                t0 = time.perf_counter()
-                req = eng.submit(prompt(1000 + i),
-                                 max_new_tokens=a.burst_steps)
-                th = threading.Thread(target=consume_burst, args=(req, t0))
-                th.start()
-                burst_threads.append(th)
-                time.sleep(rng.expovariate(1000.0 / a.mean_gap_ms) / 1000.0)
-            for th in burst_threads:
-                th.join()
-            t_burst1 = time.perf_counter()
-            # deterministic coalescing phase: occupy every non-background
-            # slot with blockers, queue K same-bucket prompts behind them,
-            # then cancel the blockers — all K wait together and the freed
-            # slots return in ONE retire sweep, so the burst must drain in
-            # <= ceil(K/Nmax) prefill dispatches (Nmax = the largest warmed
-            # batch the per-tick budget admits while decoding)
-            blockers = [eng.submit(prompt(3000 + i), max_new_tokens=256)
-                        for i in range(bg_free)]
-            blocker_streams = [iter(r.stream()) for r in blockers]
-            for s in blocker_streams:
-                next(s)  # every blocker slot admitted and streaming
-            hist0 = eng.stats()["prefill_batch_hist"]
-            drain = [eng.submit(prompt(2000 + i), max_new_tokens=2)
-                     for i in range(bg_free)]
-            for r in blockers:
-                r.cancel()
-            for r in drain:
-                for _ in r.stream():
-                    pass
-            hist1 = eng.stats()["prefill_batch_hist"]
-            drain_dispatches = sum(b1 - b0 for b0, b1 in zip(hist0, hist1))
-            for r in bg_reqs:
-                r.cancel()
-            for t in bg_threads:
-                t.join()
-            stats = eng.stats()
-        finally:
-            eng.stop()
-        burst_gaps = sorted(g * 1e3 for ts, g in gap_log
-                            if t_burst0 <= ts <= t_burst1)
-        all_gaps = sorted(g * 1e3 for _, g in gap_log)
-        ttfts_ms = sorted(t * 1e3 for t in ttfts)
-        # largest batch a single dispatch may carry while decoding: warmed
-        # sizes capped by the free slots and by the per-tick prefill budget
-        budget = serving.prefill_budget
-        fit = [s for s in eng._admit_sizes
-               if s <= bg_free and (not budget or s * BUCKET <= budget)]
-        nmax = max(fit) if fit else 1
-        out = {
-            "arm": name,
-            "bg_itl_p50_ms": round(pct(burst_gaps, 0.50) or 0.0, 3),
-            "bg_itl_p99_ms": round(pct(burst_gaps, 0.99) or 0.0, 3),
-            "bg_itl_p99_ms_full_run": round(pct(all_gaps, 0.99) or 0.0, 3),
-            "ttft_p50_ms": round(pct(ttfts_ms, 0.50) or 0.0, 3),
-            "ttft_p99_ms": round(pct(ttfts_ms, 0.99) or 0.0, 3),
-            "ttft_runs": len(ttfts_ms),
-            "drain_prompts": bg_free,
-            "drain_dispatches": drain_dispatches,
-            "drain_dispatch_bound": -(-bg_free // nmax),
-            "admission_syncs": stats["admission_syncs"],
-            "admission_stall_ms": stats["admission_stall_ms"],
-            "prefill_batch_hist": stats["prefill_batch_hist"],
-            "batched_admission": stats["batched_admission"],
-        }
-        print(f"{name:>6}: bg ITL p99 {out['bg_itl_p99_ms']:8.2f} ms, "
-              f"TTFT p50 {out['ttft_p50_ms']:7.2f} ms, p99 "
-              f"{out['ttft_p99_ms']:7.2f} ms, "
-              f"{out['admission_syncs']} admission syncs, "
-              f"hist {out['prefill_batch_hist']}", file=sys.stderr)
-        return out
-
     common = dict(slots=a.slots, prefill_buckets=(BUCKET,),
                   max_new_tokens=a.bg_steps)
-    sync = run_arm("sync", ServingConfig(
-        **common, async_admission=False, prefill_batch_sizes=(1,)))
-    async_ = run_arm("async", ServingConfig(
-        **common, prefill_budget=2 * BUCKET))
+    sync = run_mixed_arm(params, cfg, ServingConfig(
+        **common, async_admission=False, prefill_batch_sizes=(1,)), a, "sync")
+    async_ = run_mixed_arm(params, cfg, ServingConfig(
+        **common, prefill_budget=2 * BUCKET), a, "async")
     ratio = (sync["bg_itl_p99_ms"] / async_["bg_itl_p99_ms"]
              if async_["bg_itl_p99_ms"] else None)
     coalesced = async_["drain_dispatches"] <= async_["drain_dispatch_bound"]

@@ -96,6 +96,14 @@ def main() -> None:
             server_trace = json.loads(resp.read().decode())
     except (OSError, ValueError):
         pass
+    if server_trace is not None:
+        # persist the engine-side view next to the samples so report.py
+        # can split TTFT into queue-wait vs prefill-execution per arm
+        # (records without ttft_ms are ignored by legacy aggregation)
+        with open(args.out, "a") as out:
+            out.write(json.dumps({"server_trace": server_trace,
+                                  "label": args.label,
+                                  "ts": time.time()}) + "\n")
 
     ttfts = sorted(s["ttft_ms"] for s in samples)
     itl = sorted(g for s in samples for g in s["gaps_ms"])

@@ -3,6 +3,13 @@
 Parity: reference benchmarks report generator — aggregates both arms'
 JSONL, prints a table of p50/p90/p99 TTFT and per-token latency, and the
 headline p50 degradation percent (north star: < 5% for 4-way sharing).
+
+Newer benchmark.py runs also append the server's engine-side trace view
+(a ``server_trace`` record) to the JSONL: when present, the report splits
+TTFT into its queue-wait vs prefill-execution components per arm — the
+attribution that says whether a TTFT delta came from waiting for a slot
+or from prefill itself (the disagg A/B's question). Legacy JSONL (samples
+only) falls back to the wall-clock table alone.
 """
 
 from __future__ import annotations
@@ -13,9 +20,21 @@ import statistics
 import sys
 
 
-def load(path: str) -> list[dict]:
+def load(path: str) -> tuple[list[dict], list[dict]]:
+    """Returns (samples, server_traces): records carrying ``ttft_ms`` are
+    client samples; ``server_trace`` records are the engine-side view.
+    Legacy files contain only samples — traces come back empty."""
+    samples, traces = [], []
     with open(path) as f:
-        return [json.loads(line) for line in f if line.strip()]
+        for line in f:
+            if not line.strip():
+                continue
+            rec = json.loads(line)
+            if "server_trace" in rec:
+                traces.append(rec["server_trace"])
+            elif "ttft_ms" in rec:
+                samples.append(rec)
+    return samples, traces
 
 
 def pct(sorted_vals: list[float], q: float) -> float:
@@ -45,6 +64,18 @@ def stats(samples: list[dict]) -> dict:
     }
 
 
+def ttft_split(traces: list[dict]) -> dict:
+    """The engine-side TTFT attribution from the newest server_trace
+    record: queue-wait vs prefill-execution percentiles (both reservoirs
+    fed off the request-lifecycle trace spans). Empty for legacy JSONL."""
+    if not traces:
+        return {}
+    t = traces[-1]
+    return {k: t.get(k) for k in (
+        "queue_wait_p50_ms", "queue_wait_p99_ms",
+        "prefill_exec_p50_ms", "prefill_exec_p99_ms")}
+
+
 def main() -> None:
     parser = argparse.ArgumentParser("ttft-report")
     parser.add_argument("--baseline", required=True, help="exclusive-arm JSONL")
@@ -52,8 +83,10 @@ def main() -> None:
     parser.add_argument("--target-pct", type=float, default=5.0)
     args = parser.parse_args()
 
-    base = stats(load(args.baseline))
-    cand = stats(load(args.candidate))
+    base_samples, base_traces = load(args.baseline)
+    cand_samples, cand_traces = load(args.candidate)
+    base = stats(base_samples)
+    cand = stats(cand_samples)
     if not base["runs"] or not cand["runs"]:
         sys.exit("empty sample file")
 
@@ -63,18 +96,31 @@ def main() -> None:
                 "p99_itl_ms"):
         rows.append((key, f"{base[key]:.2f}" if isinstance(base[key], float) else str(base[key]),
                      f"{cand[key]:.2f}" if isinstance(cand[key], float) else str(cand[key])))
+    # the TTFT split (server-side spans): only rows both arms can fill —
+    # legacy JSONL without server_trace records skips the section
+    bsplit, csplit = ttft_split(base_traces), ttft_split(cand_traces)
+    split_keys = [k for k in ("queue_wait_p50_ms", "queue_wait_p99_ms",
+                              "prefill_exec_p50_ms", "prefill_exec_p99_ms")
+                  if bsplit.get(k) is not None and csplit.get(k) is not None]
+    if split_keys:
+        rows.append(("-- ttft split (server spans) --", "", ""))
+        for key in split_keys:
+            rows.append((key, f"{bsplit[key]:.2f}", f"{csplit[key]:.2f}"))
     width = max(len(r[0]) for r in rows) + 2
     for r in rows:
         print(f"{r[0]:<{width}}{r[1]:>12}{r[2]:>12}", file=sys.stderr)
 
     degradation = (cand["p50_ttft_ms"] - base["p50_ttft_ms"]) / base["p50_ttft_ms"] * 100.0
-    print(json.dumps({
+    out = {
         "metric": "p50_ttft_degradation",
         "value": round(degradation, 2),
         "unit": "percent",
         "vs_baseline": round(degradation / args.target_pct, 3),
         "pass": degradation < args.target_pct,
-    }))
+    }
+    if split_keys:
+        out["ttft_split"] = {"baseline": bsplit, "candidate": csplit}
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -73,13 +73,19 @@ class Engine:
         (vtpu/obs): TTFT/ITL/queue-wait percentiles as the ENGINE measured
         them (submit -> first delivery), served at GET /stats so the
         benchmark client can print them next to its own wall-clock
-        percentiles — the server-side numbers exclude only the HTTP hop."""
+        percentiles — the server-side numbers exclude only the HTTP hop.
+        queue_wait_* + prefill_exec_* split TTFT into its waiting vs
+        prefilling components (both reservoirs fed off the trace spans),
+        so a disagg-vs-cosched TTFT delta is attributable; the disagg
+        handoff counters ride along when the role split is on."""
         s = self.engine.stats()
         return {k: s[k] for k in (
             "ttft_p50_ms", "ttft_p95_ms", "ttft_p99_ms",
             "itl_p50_ms", "itl_p99_ms",
             "queue_wait_p50_ms", "queue_wait_p99_ms",
+            "prefill_exec_p50_ms", "prefill_exec_p99_ms",
             "generated_tokens", "decode_ticks", "device_gets_per_tick",
+            "disagg", "handoffs", "handoff_copies", "prefill_backlog",
             "tick_phase_ms", "trace_events_recorded")}
 
     def generate(self, prompt_len: int, max_tokens: int):

@@ -177,6 +177,43 @@ def test_prefill_bench_quick_two_slot_iteration():
     assert arms["async"]["ttft_runs"] == 3
 
 
+def test_disagg_bench_help_parses():
+    r = _run([str(ROOT / "benchmarks" / "disagg_bench.py"), "--help"])
+    assert r.returncode == 0, r.stderr
+    assert "--quick" in r.stdout and "--itl-slack" in r.stdout
+
+
+def test_disagg_bench_quick_small_iteration():
+    """disagg_bench --quick at smoke scale: the co-scheduled/disagg A/B
+    runs end to end with the deterministic gates holding — the disagg arm
+    hands off with ZERO handoff copies, the co-scheduled arm stays
+    dormant, and both arms keep the decode-side one-fetch-per-tick
+    contract. The TTFT/ITL perf gates are full-run only (noisy-CI
+    discipline, same as every other bench here)."""
+    r = _run([str(ROOT / "benchmarks" / "disagg_bench.py"), "--quick",
+              "--slots", "4", "--bg", "2", "--burst", "6",
+              "--bg-steps", "48", "--prompt-len", "20",
+              "--burst-steps", "8"])
+    assert r.returncode == 0, r.stderr
+    lines = [ln for ln in r.stdout.splitlines() if ln.strip()]
+    artifact = json.loads(lines[0])
+    summary = json.loads(lines[-1])
+    assert artifact["metric"] == "disagg_burst_ttft_p99_speedup_vs_cosched"
+    det = artifact["deterministic_gates"]
+    assert det["disagg_handed_off"] and det["handoff_copies_zero"]
+    assert det["cosched_dormant"] and det["device_gets_per_tick_contract"]
+    arms = {a["arm"]: a for a in artifact["arms"]}
+    assert arms["disagg"]["disagg"] and not arms["cosched"]["disagg"]
+    assert arms["disagg"]["handoffs"] > 0
+    assert arms["disagg"]["handoff_copies"] == 0
+    assert arms["cosched"]["handoffs"] == 0
+    # the TTFT split rides both arms (queue-wait vs prefill-exec)
+    assert arms["disagg"]["prefill_exec_p99_ms"] is not None
+    assert arms["cosched"]["prefill_exec_p99_ms"] is not None
+    assert summary["summary"] and summary["verdict"] == "pass"
+    assert summary["handoff_copies"] == 0
+
+
 def test_obs_bench_help_parses():
     r = _run([str(ROOT / "benchmarks" / "obs_bench.py"), "--help"])
     assert r.returncode == 0, r.stderr

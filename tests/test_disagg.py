@@ -1,0 +1,422 @@
+"""Disaggregated prefill/decode engines (vtpu/serving/disagg) — ISSUE 9.
+
+Fast tier. The handoff protocol contract, layered like the change:
+
+- token-equal streams disagg vs co-scheduled for the exact-KV, int8-KV and
+  MoE families, and under tp=2 (the worker's chunked prefill writes the
+  same pool content the loop's chunked admission would, so decode picks
+  the session up bit-identically);
+- the zero-copy bar: ``handoff_copies == 0`` always, the decode side's
+  ``device_gets_per_tick == 1.0`` untouched, every pool block released by
+  stream end;
+- a handoff racing the overcommit eviction policy is safe by ownership
+  (worker blocks are refcount-1 and in no parked entry; prefix shares are
+  refcount > 1) — parked sessions evict, handoffs land, every stream
+  completes token-equal and no page table corrupts;
+- cancel-mid-prefill releases every reserved block;
+- a park landing while the worker owns the request defers and then
+  settles (the lifecycle ownership extension);
+- ``disagg=None`` stays bit-identical dormant: no workers, counters zero.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from vtpu.models import ModelConfig, init_params
+from vtpu.obs.trace import HANDOFF_SEQUENCE, subsequence
+from vtpu.parallel.mesh import make_axis_mesh
+from vtpu.serving import DisaggConfig, ServingConfig, ServingEngine
+
+CFG = ModelConfig(
+    vocab=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+    max_seq=32, head_dim=8, dtype=jnp.float32, use_pallas=False,
+)
+CFG_INT8 = ModelConfig(
+    vocab=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+    max_seq=32, head_dim=8, dtype=jnp.float32, use_pallas=False,
+    kv_int8=True,
+)
+PAGE = 8
+DISAGG = DisaggConfig(min_prefill_tokens=8, max_prefill_tokens=64,
+                      backlog_high=2)
+needs_devices = pytest.mark.skipif(
+    len(jax.devices()) < 2, reason="needs 2 virtual devices")
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(jax.random.key(0), CFG)
+
+
+@pytest.fixture(scope="module")
+def params_int8():
+    return init_params(jax.random.key(0), CFG_INT8)
+
+
+def _prompt(seed, n, vocab=CFG.vocab):
+    return [int(t) for t in jax.random.randint(
+        jax.random.key(seed), (n,), 1, vocab, jnp.int32)]
+
+
+def _serving(disagg=None, **kw):
+    # prompts of 12 exceed the single 8-bucket, so BOTH arms prefill
+    # through the chunked path — the executables are shared and the pool
+    # content written is bit-identical, which is what makes greedy stream
+    # equality an exact contract (not a lucky argmax margin)
+    base = dict(slots=2, prefill_buckets=(8,), max_new_tokens=6,
+                prefill_chunk=8, kv_page=PAGE, disagg=disagg)
+    base.update(kw)
+    return ServingConfig(**base)
+
+
+def _run(params, serving, prompts, steps=6, cfg=CFG, mesh=None, model=None):
+    eng = ServingEngine(params, cfg, serving, mesh=mesh, model=model)
+    eng.start()
+    try:
+        reqs = [eng.submit(p, max_new_tokens=steps) for p in prompts]
+        streams = [list(r.stream()) for r in reqs]
+        stats = eng.stats()
+        events = eng.trace.events()
+        rids = [r.rid for r in reqs]
+    finally:
+        eng.stop()
+    return streams, stats, events, rids
+
+
+def _assert_disagg_contract(stats, n_handoffs):
+    assert stats["disagg"] is True
+    assert stats["handoffs"] == n_handoffs
+    assert stats["handoff_copies"] == 0
+    assert stats["device_gets_per_tick"] == 1.0
+    # every reserved block came back: retires released the handoff blocks
+    assert stats["kv_pool_free"] == stats["kv_pool_blocks"]
+
+
+# ---------------------------------------------------- stream equality
+
+
+def test_disagg_streams_token_equal_exact(params):
+    prompts = [_prompt(40 + i, 12) for i in range(4)]
+    ref, ref_stats, _, _ = _run(params, _serving(), prompts)
+    got, stats, events, rids = _run(params, _serving(DISAGG), prompts)
+    assert got == ref
+    assert ref_stats["handoffs"] == 0 and ref_stats["disagg"] is False
+    _assert_disagg_contract(stats, n_handoffs=4)
+    # the handoff lifecycle round-trips through the trace in order
+    by_rid = {}
+    for e in events:
+        by_rid.setdefault(e["rid"], []).append(e["event"])
+    for rid in rids:
+        assert subsequence(HANDOFF_SEQUENCE, by_rid[rid]), by_rid[rid]
+
+
+def test_disagg_streams_token_equal_int8(params_int8):
+    prompts = [_prompt(50 + i, 12) for i in range(3)]
+    ref, _, _, _ = _run(params_int8, _serving(), prompts, cfg=CFG_INT8)
+    got, stats, _, _ = _run(
+        params_int8, _serving(DISAGG), prompts, cfg=CFG_INT8)
+    assert got == ref
+    _assert_disagg_contract(stats, n_handoffs=3)
+
+
+def test_disagg_streams_token_equal_moe():
+    from vtpu.models.moe import MoEConfig, init_moe_params
+    from vtpu.serving.adapters import MoeSlotModel
+
+    cfg = MoEConfig(vocab=96, d_model=64, n_heads=2, n_layers=2, d_ff=64,
+                    n_experts=4, top_k=2, max_seq=32, head_dim=32,
+                    dtype=jnp.float32)
+    mparams = init_moe_params(jax.random.key(5), cfg)
+    prompts = [_prompt(60 + i, 12, vocab=cfg.vocab) for i in range(3)]
+
+    def run(disagg):
+        model = MoeSlotModel(mparams, cfg, kv_page=PAGE)
+        serving = _serving(DISAGG if disagg else None)
+        return _run(None, serving, prompts, cfg=None, model=model)
+
+    ref, _, _, _ = run(False)
+    got, stats, _, _ = run(True)
+    assert got == ref
+    _assert_disagg_contract(stats, n_handoffs=3)
+
+
+@needs_devices
+def test_disagg_streams_token_equal_tp2(params):
+    mesh = make_axis_mesh("tp", 2)
+    prompts = [_prompt(70 + i, 12) for i in range(3)]
+    ref, _, _, _ = _run(params, _serving(), prompts, mesh=mesh)
+    got, stats, _, _ = _run(params, _serving(DISAGG), prompts, mesh=mesh)
+    assert got == ref
+    assert stats["tp"] == 2
+    _assert_disagg_contract(stats, n_handoffs=3)
+
+
+# -------------------------------------------------- prefix composition
+
+
+def test_disagg_prefix_zero_copy(params):
+    """Prefix-backed requests through the worker: full blocks map
+    read-only (share), COW only the boundary block, streams equal to the
+    co-scheduled prefix path, and the install is still zero-copy."""
+    prefix = _prompt(80, 12)
+    suffixes = [_prompt(81 + i, 9) for i in range(3)]
+
+    def run(disagg):
+        serving = _serving(DISAGG if disagg else None)
+        eng = ServingEngine(params, CFG, serving)
+        eng.start()
+        try:
+            pid = eng.register_prefix(prefix)
+            reqs = [eng.submit(s, max_new_tokens=5, prefix=pid)
+                    for s in suffixes]
+            streams = [list(r.stream()) for r in reqs]
+            stats = eng.stats()
+        finally:
+            eng.stop()
+        return streams, stats
+
+    ref, ref_stats = run(False)
+    got, stats = run(True)
+    assert got == ref
+    assert stats["handoffs"] == 3 and stats["handoff_copies"] == 0
+    assert stats["prefix_install_copies"] == 0
+    assert stats["prefix_blocks_shared"] == ref_stats["prefix_blocks_shared"]
+    assert stats["prefix_cow_copies"] == ref_stats["prefix_cow_copies"] > 0
+
+
+def test_two_workers_prefix_counters_match_cosched(params):
+    """prefill_workers=2: the claim mutex serializes head-peek -> reserve
+    -> take, so racing workers never double-reserve one request — the
+    prefix share/COW counters stay EQUAL to the co-scheduled arm's and
+    streams stay token-equal (the race would overcount and churn)."""
+    prefix = _prompt(140, 12)
+    suffixes = [_prompt(141 + i, 9) for i in range(4)]
+    two = DisaggConfig(min_prefill_tokens=8, max_prefill_tokens=64,
+                       backlog_high=2, prefill_workers=2)
+
+    def run(disagg):
+        eng = ServingEngine(params, CFG, _serving(disagg))
+        eng.start()
+        try:
+            pid = eng.register_prefix(prefix)
+            reqs = [eng.submit(s, max_new_tokens=5, prefix=pid)
+                    for s in suffixes]
+            streams = [list(r.stream()) for r in reqs]
+            return streams, eng.stats()
+        finally:
+            eng.stop()
+
+    ref, ref_stats = run(None)
+    got, stats = run(two)
+    assert got == ref
+    assert stats["handoffs"] == 4 and stats["handoff_copies"] == 0
+    assert stats["prefix_blocks_shared"] == ref_stats["prefix_blocks_shared"]
+    assert stats["prefix_cow_copies"] == ref_stats["prefix_cow_copies"]
+
+
+# ------------------------------------------- eviction / lifecycle races
+
+
+def test_handoff_racing_eviction_never_corrupts(params):
+    """Park-heavy overcommit pressure while a wave of new requests hands
+    off: the worker's allocator misses post reclaim requests, parked
+    sessions' private pages evict (swap or drop), handoffs land in the
+    freed blocks — and every stream, parked and new alike, completes
+    token-equal to an unconstrained reference. A corrupted page table or
+    a worker block wrongly evicted would surface as stream divergence."""
+    new_a = 16  # long enough that the park lands mid-stream
+    pages_a = -(-(12 + new_a) // PAGE)  # 4 blocks per parked session
+    prompts_a = [_prompt(90 + i, 12) for i in range(2)]
+    prompts_b = [_prompt(95 + i, 12) for i in range(2)]
+
+    # unconstrained reference (big pool, no disagg)
+    ref_a, _, _, _ = _run(params, _serving(), prompts_a, steps=new_a)
+    ref_b, _, _, _ = _run(params, _serving(), prompts_b)
+
+    serving = _serving(
+        DISAGG, kv_pool_blocks=2 * pages_a + 1, kv_swap=2 * pages_a)
+    eng = ServingEngine(params, CFG, serving)
+    eng.start()
+    try:
+        wave_a = [eng.submit(p, max_new_tokens=new_a) for p in prompts_a]
+        streams_a = [[] for _ in wave_a]
+        for i, r in enumerate(wave_a):
+            for _ in range(2):
+                tok = r.out.get(timeout=60)
+                assert tok is not None
+                streams_a[i].append(tok)
+        for i, r in enumerate(wave_a):
+            eng.park(r)
+            t0 = time.perf_counter()
+            while eng.stats()["parked_sessions"] < i + 1:
+                assert time.perf_counter() - t0 < 60, "park stalled"
+                time.sleep(0.002)
+        # the pool now holds the parked sessions' pages (+1 spare): the
+        # new wave's reservations MUST evict through the reclaim assist
+        wave_b = [eng.submit(p, max_new_tokens=6) for p in prompts_b]
+        streams_b = [list(r.stream()) for r in wave_b]
+        for r in wave_a:
+            eng.resume(r)
+        for i, r in enumerate(wave_a):
+            streams_a[i].extend(r.stream())
+        stats = eng.stats()
+    finally:
+        eng.stop()
+    assert streams_b == ref_b
+    assert streams_a == ref_a
+    assert stats["evicted_blocks"] > 0
+    assert stats["handoffs"] >= 2 and stats["handoff_copies"] == 0
+    assert stats["kv_pool_free"] == stats["kv_pool_blocks"]
+
+
+def test_cancel_mid_prefill_releases_every_block(params):
+    """Cancel racing the worker at every stage — still queued, claimed,
+    mid-chunk, handed off, installed: whatever stage the cancel lands in,
+    every reserved block returns to the pool and the stream ends with its
+    sentinel. Cancels fire at staggered offsets so repeated runs hit
+    different stages; the invariant is stage-independent."""
+    slow = DisaggConfig(min_prefill_tokens=8, max_prefill_tokens=8,
+                        backlog_high=99)
+    serving = _serving(slow, max_new_tokens=48)
+    eng = ServingEngine(params, CFG, serving)
+    eng.start()
+    try:
+        # background stream holds decode live (slow chunk pacing: the
+        # 8-token share means a 24-token prompt spans several ticks)
+        bg = eng.submit(_prompt(100, 12), max_new_tokens=40)
+        it = iter(bg.stream())
+        next(it)
+        victims = [eng.submit(_prompt(101 + i, 24), max_new_tokens=8)
+                   for i in range(4)]
+        victims[0].cancel()  # still queued (or just claimed)
+        for i, v in enumerate(victims[1:], 1):
+            time.sleep(0.004 * i)  # mid-chunk .. handed off .. installed
+            v.cancel()
+        for v in victims:
+            # stream must END with the sentinel whatever stage cancel hit
+            toks = list(v.stream())
+            assert len(toks) <= 8
+        bg.cancel()
+        list(it)
+        t0 = time.perf_counter()
+        while eng.stats()["kv_pool_free"] != eng.stats()["kv_pool_blocks"]:
+            assert time.perf_counter() - t0 < 30, "blocks leaked"
+            time.sleep(0.002)
+        stats = eng.stats()
+    finally:
+        eng.stop()
+    assert stats["kv_pool_free"] == stats["kv_pool_blocks"]
+    assert stats["handoff_copies"] == 0
+
+
+def test_park_while_worker_owns_request_defers_then_settles(params):
+    """park() landing while the request is mid-prefill (or an installed
+    handoff): the lifecycle drain defers — the command neither drops nor
+    double-services — and the session parks once slotted, resumes, and
+    finishes its exact stream."""
+    prompts = [_prompt(110 + i, 12) for i in range(2)]
+    ref, _, _, _ = _run(params, _serving(), prompts)
+    pages_per = -(-(12 + 6) // PAGE)
+    serving = _serving(DISAGG, kv_swap=4 * pages_per)
+    eng = ServingEngine(params, CFG, serving)
+    eng.start()
+    try:
+        reqs = [eng.submit(p, max_new_tokens=6) for p in prompts]
+        # park immediately: the requests are still queued / mid-prefill
+        for r in reqs:
+            eng.park(r)
+        t0 = time.perf_counter()
+        while eng.stats()["parked_sessions"] < 2:
+            assert time.perf_counter() - t0 < 60, "deferred park never settled"
+            time.sleep(0.002)
+        for r in reqs:
+            eng.resume(r)
+        streams = [list(r.stream()) for r in reqs]
+        stats = eng.stats()
+    finally:
+        eng.stop()
+    assert streams == ref
+    assert stats["parks"] == 2 and stats["resumes"] == 2
+    assert stats["kv_pool_free"] == stats["kv_pool_blocks"]
+
+
+# ------------------------------------------------------------ dormant
+
+
+def test_disagg_none_is_dormant(params):
+    """disagg=None: no runtime, no workers, counters present but zero —
+    the co-scheduled loop is bit-identical to the pre-disagg engine."""
+    eng = ServingEngine(params, CFG, _serving())
+    assert eng._disagg is None
+    eng.start()
+    try:
+        r = eng.submit(_prompt(120, 12), max_new_tokens=4)
+        assert len(list(r.stream())) == 4
+        stats = eng.stats()
+    finally:
+        eng.stop()
+    assert stats["disagg"] is False
+    assert stats["handoffs"] == 0 and stats["handoff_copies"] == 0
+    assert stats["repartitions"] == 0 and stats["prefill_backlog"] == 0
+    assert stats["prefill_share_tokens"] is None
+
+
+def test_disagg_requires_paged_chunked_device_sampling(params):
+    with pytest.raises(ValueError, match="paged"):
+        ServingEngine(params, CFG, ServingConfig(
+            slots=2, prefill_buckets=(8,), prefill_chunk=8, disagg=DISAGG))
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        ServingEngine(params, CFG, ServingConfig(
+            slots=2, prefill_buckets=(8,), kv_page=PAGE, disagg=DISAGG))
+    with pytest.raises(ValueError, match="device sampling"):
+        ServingEngine(params, CFG, ServingConfig(
+            slots=2, prefill_buckets=(8,), prefill_chunk=8, kv_page=PAGE,
+            disagg=DISAGG), sample=lambda logits: 0)
+    # empty prompt without a prefix: no logits row exists to sample a
+    # first token from — rejected at submit() in BOTH modes (the worker
+    # would crash; co-scheduled would sample off an all-padding bucket)
+    for disagg in (DISAGG, None):
+        eng = ServingEngine(params, CFG, _serving(disagg))
+        try:
+            with pytest.raises(ValueError, match="empty prompt"):
+                eng.submit([], max_new_tokens=4)
+        finally:
+            eng.stop()
+
+
+def test_disagg_chrome_trace_has_prefill_worker_lane(params):
+    """The Chrome dump grows a prefill-worker lane: a named thread track
+    carrying one slice per handed-off request, and the derived spans carry
+    the TTFT split (queue wait + prefill exec ≈ ttft)."""
+    from vtpu.obs.trace import PREFILL_LANE_TID
+
+    prompts = [_prompt(130 + i, 12) for i in range(2)]
+    eng = ServingEngine(params, CFG, _serving(DISAGG))
+    eng.start()
+    try:
+        reqs = [eng.submit(p, max_new_tokens=4) for p in prompts]
+        for r in reqs:
+            assert len(list(r.stream())) == 4
+        chrome = eng.trace.chrome_trace()
+        spans = eng.trace.spans()
+        rids = [r.rid for r in reqs]
+    finally:
+        eng.stop()
+    lane = [e for e in chrome["traceEvents"]
+            if e.get("tid") == PREFILL_LANE_TID]
+    names = {e["name"] for e in lane if e["ph"] == "M"}
+    assert any(e["ph"] == "M"
+               and e["args"]["name"].startswith("prefill worker")
+               for e in lane), names
+    slices = [e for e in lane if e["ph"] == "X"]
+    assert {e["args"]["rid"] for e in slices} == set(rids)
+    for rid in rids:
+        s = spans[rid]
+        assert s["handoffs"] == 1
+        assert s["prefill_start_ns"] is not None
+        assert s["pool_install_ns"] is not None
+        assert s["prefill_exec_ms"] is not None and s["ttft_ms"] is not None
+        assert s["queue_wait_ms"] + s["prefill_exec_ms"] <= s["ttft_ms"] + 1.0

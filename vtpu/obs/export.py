@@ -39,7 +39,7 @@ COUNTERS = {
     "spec_emitted": ("spec_emitted_tokens",
                      "Tokens delivered by speculative ticks"),
     "prefill_chunks": ("prefill_chunks", "Chunked-prefill dispatches"),
-    "admissions": ("admissions", "Requests admitted into slots"),
+    "admissions": ("admissions", "Requests that began service"),
     "device_gets": ("device_gets", "Batched device->host fetches"),
     "bytes_fetched": ("fetched_bytes", "Device->host payload bytes"),
     "tick_fetches": ("tick_fetches", "Tick-delivery fetches"),
@@ -77,6 +77,12 @@ COUNTERS = {
                               "Lifecycle events recorded into the trace ring"),
     "trace_events_dropped": ("trace_events_dropped",
                              "Lifecycle events the bounded ring overwrote"),
+    "handoffs": ("handoffs",
+                 "Prefill-worker sessions handed to the decode loop"),
+    "handoff_copies": ("handoff_copies",
+                       "Device copies performed by handoffs (contract: 0)"),
+    "repartitions": ("repartitions",
+                     "Disagg controller prefill-share level changes"),
 }
 
 # stats() key -> (family suffix, help, scale). Point-in-time gauges; a
@@ -110,6 +116,12 @@ GAUGES = {
                           "Submit->admit wait p50 (trace reservoir)", 1e-3),
     "queue_wait_p99_ms": ("queue_wait_p99_seconds",
                           "Submit->admit wait p99 (trace reservoir)", 1e-3),
+    "prefill_exec_p50_ms": ("prefill_exec_p50_seconds",
+                            "Queue-depart->first-token p50 (TTFT split)",
+                            1e-3),
+    "prefill_exec_p99_ms": ("prefill_exec_p99_seconds",
+                            "Queue-depart->first-token p99 (TTFT split)",
+                            1e-3),
     "mean_emitted_per_spec_tick": ("spec_mean_emitted_per_slot_tick",
                                    "Delivered tokens per spec slot-tick", 1),
     "spec_ema": ("spec_ema", "Adaptive-speculation acceptance EMA", 1),
@@ -120,6 +132,14 @@ GAUGES = {
     "batched_admission": ("batched_admission",
                           "1 when admission is batched/async", 1),
     "paged": ("paged", "1 when the KV cache is a paged pool", 1),
+    "disagg": ("disagg",
+               "1 when prefill/decode are disaggregated roles", 1),
+    "prefill_backlog": ("prefill_backlog",
+                        "Requests queued or mid-prefill on the worker side",
+                        1),
+    "prefill_share_tokens": ("prefill_share_tokens",
+                             "Current prefill partition (tokens per tick)",
+                             1),
     "trace_enabled": ("trace_enabled",
                       "1 while the lifecycle event ring records", 1),
     "kv_page": ("kv_page_tokens", "Tokens per KV block (None = dense)", 1),
@@ -223,6 +243,8 @@ def serving_families(sources: dict[str, object]) -> Iterable:
         ("ttft_seconds", "Time to first token", "ttft_hist"),
         ("itl_seconds", "Inter-token latency", "itl_hist"),
         ("queue_wait_seconds", "Submit->admit queue wait", "queue_wait_hist"),
+        ("prefill_exec_seconds", "Queue-depart to first token",
+         "prefill_exec_hist"),
     )
     for suffix, help_, attr in span_hists:
         fam = HistogramMetricFamily(PREFIX + suffix, help_, labels=("engine",))

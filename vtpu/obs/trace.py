@@ -43,9 +43,16 @@ from vtpu.obs.tickprof import LATENCY_BUCKETS_MS, BoundedHistogram
 # (prompt/installed tokens, chunk tokens, blocks, bytes, sequence length).
 EVENT_KINDS = (
     "submit",          # request entered the engine (val: prompt tokens)
-    "queue_depart",    # left the waiting line for a slot
+    "queue_depart",    # left the waiting line for a slot or worker
     "admit",           # slot bookkeeping complete (val: installed length)
+    "prefill_start",   # a disagg prefill worker claimed it (val: prompt)
     "prefill_chunk",   # one [1, C] chunk advanced (val: C)
+    "handoff",         # worker finished: blocks + first token ready for
+                       # the decode loop (val: blocks) — zero-copy by
+                       # contract (stats()["handoff_copies"] == 0)
+    "pool_install",    # decode loop mapped the handoff's blocks into a
+                       # slot's table row (val: pages) — the one fused
+                       # install write, still zero KV copies
     "first_token",     # first token delivered to the client
     "token",           # one decode/spec token delivered
     "park",            # taken out of the decode batch (val: owned pages)
@@ -56,6 +63,17 @@ EVENT_KINDS = (
     "resume",          # resume command accepted for a parked session
     "retire",          # stream ended (eos / budget / cancel)
 )
+
+# The disaggregated handoff lifecycle (prefill worker -> decode loop) as an
+# in-order subsequence — single-sourced like the restore sequences below so
+# benchmarks/disagg_bench.py and tests/test_disagg.py assert the same thing.
+HANDOFF_SEQUENCE = (
+    "submit", "queue_depart", "prefill_start", "prefill_chunk",
+    "first_token", "handoff", "pool_install", "admit", "token", "retire")
+
+# Chrome-trace track id for the prefill-worker lane (far above any real
+# request id, which double as per-request track ids)
+PREFILL_LANE_TID = 1 << 30
 
 FIELDS = ("seq", "ts_ns", "event", "rid", "slot", "val")
 
@@ -106,9 +124,12 @@ class RequestTrace:
             maxlen=itl_window)
         self._queue_wait: "collections.deque[float]" = collections.deque(
             maxlen=itl_window)
+        self._prefill_exec: "collections.deque[float]" = collections.deque(
+            maxlen=itl_window)
         self.itl_hist = BoundedHistogram(LATENCY_BUCKETS_MS)
         self.ttft_hist = BoundedHistogram(LATENCY_BUCKETS_MS)
         self.queue_wait_hist = BoundedHistogram(LATENCY_BUCKETS_MS)
+        self.prefill_exec_hist = BoundedHistogram(LATENCY_BUCKETS_MS)
 
     # ------------------------------------------------------------ recording
 
@@ -137,6 +158,13 @@ class RequestTrace:
             self._queue_wait.append(seconds)
         self.queue_wait_hist.note(seconds)
 
+    def note_prefill_exec(self, seconds: float) -> None:
+        """Queue departure -> first token: the prefill-execution half of
+        the TTFT split (queue wait is the other half)."""
+        with self._lat_lock:
+            self._prefill_exec.append(seconds)
+        self.prefill_exec_hist.note(seconds)
+
     # ------------------------------------------------------------ snapshots
 
     @property
@@ -161,6 +189,10 @@ class RequestTrace:
     def queue_wait_samples(self) -> list:
         with self._lat_lock:
             return list(self._queue_wait)
+
+    def prefill_exec_samples(self) -> list:
+        with self._lat_lock:
+            return list(self._prefill_exec)
 
     def snapshot(self) -> list[tuple]:
         """The ring's live events in recording order (oldest first)."""
@@ -194,6 +226,8 @@ class RequestTrace:
                     "resume_latency_ms": [], "evicted_blocks": 0,
                     "swap_out_bytes": 0, "swap_in_bytes": 0,
                     "fault_recomputes": 0,
+                    "prefill_start_ns": None, "handoff_ns": None,
+                    "pool_install_ns": None, "handoffs": 0,
                     "_last_tok_ns": None, "_park_ns": None,
                     "_resume_ns": None,
                 }
@@ -203,6 +237,13 @@ class RequestTrace:
                 s["queue_depart_ns"] = ts
             elif event == "admit":
                 s["admit_ns"] = ts
+            elif event == "prefill_start":
+                s["prefill_start_ns"] = ts
+            elif event == "handoff":
+                s["handoff_ns"] = ts
+                s["handoffs"] += 1
+            elif event == "pool_install":
+                s["pool_install_ns"] = ts
             elif event == "prefill_chunk":
                 s["prefill_chunks"] += 1
             elif event in ("first_token", "token"):
@@ -248,6 +289,15 @@ class RequestTrace:
                 else None)
             s["ttft_ms"] = (
                 (ft - sub) / 1e6 if sub is not None and ft is not None
+                else None)
+            # the TTFT split's other half: queue departure (or, on the
+            # disagg path, the worker's claim) -> first token. queue_wait
+            # + prefill_exec ≈ ttft, the attribution the disagg A/B reads.
+            start = (s["prefill_start_ns"] or s["queue_depart_ns"]
+                     or s["admit_ns"])
+            s["prefill_exec_ms"] = (
+                (ft - start) / 1e6
+                if start is not None and ft is not None and ft >= start
                 else None)
             for k in ("_last_tok_ns", "_park_ns", "_resume_ns"):
                 del s[k]
@@ -340,6 +390,43 @@ class RequestTrace:
                 out.append({"ph": "C", "pid": 1, "ts": us(res[0][1]),
                             "name": "ttft_ms",
                             "args": {"ms": round(span["ttft_ms"], 3)}})
+        # the prefill-worker lanes (disaggregated serving): one track PER
+        # WORKER (tid = PREFILL_LANE_TID + wid, the wid rides the event's
+        # slot field) carrying a slice per request from the worker's claim
+        # (prefill_start) to the handoff — the role split made visible
+        # next to the per-request queued/streaming/parked tracks. With
+        # prefill_workers > 1 concurrent prefills overlap in time; on one
+        # shared tid Perfetto would render them as nested frames of a
+        # single thread, hiding exactly the concurrency the lane shows.
+        lane: list[dict] = []
+        lane_tids: set = set()
+        for rid, res in per_rid.items():
+            start_ns = None
+            wid = 0
+            for _, ts, event, _, slot, _ in res:
+                if event == "prefill_start":
+                    start_ns = ts
+                    wid = slot if slot is not None and slot >= 0 else 0
+                elif start_ns is not None and event in ("handoff", "retire"):
+                    # retire closes the slice for budget-1 / cancelled
+                    # requests that never produce a handoff
+                    tid = PREFILL_LANE_TID + wid
+                    lane_tids.add(tid)
+                    lane.append({"ph": "X", "pid": 1,
+                                 "tid": tid,
+                                 "ts": us(start_ns),
+                                 "dur": max((ts - start_ns) / 1e3, 0.001),
+                                 "name": f"prefill r{rid}",
+                                 "args": {"rid": rid, "worker": wid}})
+                    start_ns = None
+        if lane:
+            for tid in sorted(lane_tids):
+                out.append({"ph": "M", "pid": 1, "tid": tid,
+                            "name": "thread_name",
+                            "args": {"name":
+                                     f"prefill worker "
+                                     f"{tid - PREFILL_LANE_TID}"}})
+            out.extend(lane)
         return {"traceEvents": out, "displayTimeUnit": "ms"}
 
     def to_chrome_trace(self, dest: Union[str, IO]) -> dict:

@@ -1,5 +1,6 @@
 """TPU-native serving engine: continuous batching over a slot-based KV cache."""
 
+from vtpu.serving.disagg import DisaggConfig
 from vtpu.serving.engine import (
     BlockAllocator,
     Request,
@@ -13,6 +14,7 @@ from vtpu.serving.engine import (
 
 __all__ = [
     "BlockAllocator",
+    "DisaggConfig",
     "Request",
     "ServingConfig",
     "ServingEngine",

@@ -23,6 +23,13 @@ serving path: the engine composes decode_step with the on-device sampler
 int32 tokens — the array the pipelined loop feeds straight into the next
 dispatch. Logits only cross to the host when a custom ``sample=`` callable
 is configured (the fallback path, which also disables pipelining).
+
+``prefill_chunk_into_slot`` with an explicit ``block_ids`` row (and the
+out-of-range slot sentinel that drops the length write) doubles as the
+SLOT-LESS prefill contract: ``register_prefix`` builds shared prefixes
+through it, and the disaggregated prefill workers (vtpu/serving/disagg)
+reuse exactly the same path to fill pool blocks with no slot and no page
+table — which is why a handoff can install with zero copies.
 """
 
 from __future__ import annotations

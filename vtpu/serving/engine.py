@@ -205,6 +205,18 @@ class ServingConfig:
     # overhead contract (obs_bench.py) is zero added host syncs and
     # tokens/sec within 2% of tracing-off.
     trace_events: int = 16384
+    # --- disaggregated prefill/decode (vtpu/serving/disagg) --------------
+    # A DisaggConfig splits the engine into role-specialized workers over
+    # the shared block pool: dedicated PrefillWorker thread(s) drain the
+    # admission WaitQueue, chunk-prefill directly into slot-less pool
+    # blocks (the register_prefix zero-copy discipline), deliver the first
+    # token WITHOUT waiting for a decode slot, and hand the decode loop a
+    # filled page-table row (one fused install, handoff_copies == 0); a
+    # DisaggController dynamically re-partitions prefill vs decode
+    # capacity by backlog. Requires kv_page + prefill_chunk + device
+    # sampling + batched admission, no speculation. None = the
+    # co-scheduled loop, bit-identical streams, zero new threads.
+    disagg: Optional[Any] = None
 
 
 def choose_kv_int8(slots: int, max_window: int) -> bool:
@@ -321,21 +333,38 @@ class WaitQueue:
     identity membership — the same semantics the list version's ``is``-based
     lifecycle relied on. Iteration yields live entries in FIFO order off a
     snapshot, so callers may tombstone entries mid-iteration (the batch
-    coalescing path does exactly that). Single-thread (serving loop) use."""
+    coalescing path does exactly that). Thread-safe: under disaggregation
+    (vtpu/serving/disagg) prefill workers claim the head while the serving
+    loop appends and the lifecycle drain tombstones — every operation takes
+    the internal lock, and ``take`` makes remove-if-live atomic (the
+    check-then-remove a park racing a worker claim must not split)."""
 
-    __slots__ = ("_q", "_live")
+    __slots__ = ("_q", "_live", "_lock")
 
     def __init__(self):
         self._q: "collections.deque" = collections.deque()
         self._live: set = set()
+        self._lock = threading.Lock()
 
     def append(self, req) -> None:
-        self._q.append(req)
-        self._live.add(req)
+        with self._lock:
+            self._q.append(req)
+            self._live.add(req)
 
     def remove(self, req) -> None:
         """Tombstone *req* wherever it sits in the line (O(1))."""
-        self._live.discard(req)
+        with self._lock:
+            self._live.discard(req)
+
+    def take(self, req) -> bool:
+        """Atomically tombstone *req* IF it is still live; returns whether
+        this caller won it. Two racing claimants (a prefill worker and the
+        park-of-waiting lifecycle path) can never both own one request."""
+        with self._lock:
+            if req in self._live:
+                self._live.discard(req)
+                return True
+            return False
 
     def _compact(self) -> None:
         q = self._q
@@ -344,33 +373,41 @@ class WaitQueue:
 
     def head(self):
         """The oldest live entry, or None (does not pop)."""
-        self._compact()
-        return self._q[0] if self._q else None
+        with self._lock:
+            self._compact()
+            return self._q[0] if self._q else None
 
     def popleft(self):
-        self._compact()
-        req = self._q.popleft()
-        self._live.discard(req)
-        return req
+        with self._lock:
+            self._compact()
+            req = self._q.popleft()
+            self._live.discard(req)
+            return req
 
     def clear(self) -> None:
-        self._q.clear()
-        self._live.clear()
+        with self._lock:
+            self._q.clear()
+            self._live.clear()
 
     def __contains__(self, req) -> bool:
-        return req in self._live
+        with self._lock:
+            return req in self._live
 
     def __len__(self) -> int:
-        return len(self._live)
+        with self._lock:
+            return len(self._live)
 
     def __iter__(self):
         # dedupe: remove-then-append (the park-waiting/resume cycle)
         # leaves a stale copy in the deque alongside the re-added live
         # one; yielding it twice would let batch coalescing admit one
         # request into two slots
+        with self._lock:
+            snap = list(self._q)
+            live = set(self._live)
         seen = set()
-        for r in list(self._q):
-            if r in self._live and r not in seen:
+        for r in snap:
+            if r in live and r not in seen:
                 seen.add(r)
                 yield r
 
@@ -396,6 +433,10 @@ class Request:
     # submit() timestamp (time.monotonic_ns) — the origin every derived
     # span (queue wait, TTFT) measures from
     t_submit_ns: int = 0
+    # queue-departure timestamp (claimed by admission or a prefill
+    # worker); with t_submit_ns it splits TTFT into queue-wait vs
+    # prefill-execution (the trace's prefill_exec reservoir); 0 until then
+    t_depart_ns: int = 0
     out: "queue.Queue[Optional[int]]" = dataclasses.field(default_factory=queue.Queue)
     cancelled: bool = False
     # per-token log p under the engine's sampling distribution, appended at
@@ -1428,6 +1469,38 @@ class ServingEngine:
         self._install_jits: dict[int, Any] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # --- disaggregated prefill/decode (vtpu/serving/disagg) ----------
+        # The state mutex serializes the ONLY two writers the donated
+        # device state can ever have: the serving loop's tick-head +
+        # dispatch section and a prefill worker's chunk dispatches. With
+        # disagg off it is never taken — the loop's hot path is untouched.
+        self._state_mu = threading.Lock()
+        if serving.disagg is not None:
+            from vtpu.serving.disagg import DisaggConfig, DisaggRuntime
+
+            if not isinstance(serving.disagg, DisaggConfig):
+                raise ValueError(
+                    "ServingConfig.disagg must be a DisaggConfig, got "
+                    f"{type(serving.disagg).__name__}")
+            if not self._paged:
+                raise ValueError(
+                    "disagg requires the paged pool (set kv_page): prefill "
+                    "workers build KV into slot-less pool blocks")
+            if not self._chunk:
+                raise ValueError(
+                    "disagg requires prefill_chunk: the worker prefills "
+                    "through the explicit-block_ids chunked path")
+            if not self._device_sampling or self._spec_tokens:
+                raise ValueError(
+                    "disagg requires device sampling (no custom sample= "
+                    "callable) and no active speculation")
+            if not self._async_admission:
+                raise ValueError(
+                    "disagg requires batched/async admission (the warmed "
+                    "on-device first-token samplers)")
+            self._disagg = DisaggRuntime(self, serving.disagg)
+        else:
+            self._disagg = None
 
     # ------------------------------------------------------------------ API
 
@@ -1664,6 +1737,12 @@ class ServingEngine:
         # validate HERE, on the caller's thread: an oversized prompt must
         # raise to its submitter, not kill the serving loop (which would
         # hang every other client forever)
+        if int(tokens.shape[0]) == 0 and prefix is None:
+            # with no prefix there are no logits to sample a first token
+            # from: the co-scheduled path would greedy-sample off an
+            # all-padding bucket (garbage) and a disagg worker has no row
+            # at all — reject identically in both modes
+            raise ValueError("empty prompt requires a prefix")
         if self._paged:
             # a request whose WORST-CASE private pages exceed the whole
             # pool can never admit — backpressure would park it (and, at
@@ -1713,6 +1792,10 @@ class ServingEngine:
         self.trace.record("submit", req.rid, -1, int(tokens.shape[0]))
         self._pending.put(req)
         self._wake.set()
+        if self._disagg is not None:
+            # wake a blocked prefill worker directly — it will find the
+            # request once the next tick head drains pending into waiting
+            self._disagg.notify_work()
         if self._stop.is_set():
             # raced with stop(): its drain may have missed this request; an
             # extra end-of-stream sentinel is harmless, a missing one hangs
@@ -1753,6 +1836,11 @@ class ServingEngine:
     def start(self) -> None:
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
+        if self._disagg is not None:
+            # workers block on the runtime's started event until the loop
+            # finishes _warm_executables — no worker dispatch may race a
+            # first-use compile or a cold pool state
+            self._disagg.start()
 
     def stop(self) -> None:
         self._stop.set()
@@ -1772,6 +1860,8 @@ class ServingEngine:
         """End-of-stream for everyone still holding a Request: occupied slots
         and queued waiters alike — a client blocked in Request.stream() must
         observe the None sentinel, not hang on a dead engine."""
+        if self._disagg is not None:
+            self._disagg.drain()
         for slot in range(len(self._slot_req)):
             self._retire(slot)
         for slot, adm in self._admitting.items():
@@ -1858,8 +1948,13 @@ class ServingEngine:
                 return self._reserve_paged_locked(slot, req, entry)
         return self._reserve_paged_locked(slot, req, None)
 
-    def _reserve_paged_locked(self, slot: int, req: Request,
-                              entry: Optional[dict]) -> bool:
+    def _reserve_plan(self, req: Request,
+                      entry: Optional[dict]) -> tuple[int, int, int, int]:
+        """The page-reservation arithmetic every admission path shares —
+        slot admission (_reserve_paged_locked) and the disagg prefill
+        workers alike, so the budget clamp and page math can never
+        diverge between the co-scheduled and disaggregated modes.
+        Returns (base, budget, full_prefix_pages, need_priv)."""
         page = self._page
         n = int(req.tokens.shape[0])
         base = entry["len"] if entry is not None else 0
@@ -1870,8 +1965,17 @@ class ServingEngine:
             budget = min(budget, ctx - total)
         reserve = -(-max(total + max(budget, 0), 1) // page)
         full = base // page  # whole prefix pages, shareable as-is
+        return base, budget, full, reserve - full
+
+    def _reserve_paged_locked(self, slot: int, req: Request,
+                              entry: Optional[dict]) -> bool:
+        # the share/COW sequence is mirrored by the disagg worker's
+        # _reserve_locked (loop thread here: eviction-assisted alloc,
+        # immediate counters, no state mutex). A semantic change to
+        # boundary-block handling must land in BOTH places.
+        page = self._page
+        base, _, full, need_priv = self._reserve_plan(req, entry)
         shared = entry["blocks"][:full] if entry is not None else []
-        need_priv = reserve - full
         # overcommit: a dry free list first evicts parked sessions' private
         # pages (QoS-then-LRU) before this admission is allowed to park —
         # pool exhaustion is backpressure-with-eviction, not a hard park
@@ -2154,11 +2258,11 @@ class ServingEngine:
                 self._want_park.discard(req)
                 self._park_unseen.discard(req)
                 continue
-            if req in self._waiting:
-                # not yet admitted: park it unstarted — resume re-queues
-                # through normal admission, no pages to save
+            if self._waiting.take(req):
+                # not yet admitted (and atomically won from any racing
+                # prefill-worker claim): park it unstarted — resume
+                # re-queues through normal admission, no pages to save
                 self._park_unseen.discard(req)
-                self._waiting.remove(req)
                 self._parked[req] = {
                     "req": req, "unstarted": True, "tokens": [],
                     "pending": None, "budget": 0, "seq_len": 0,
@@ -2183,8 +2287,15 @@ class ServingEngine:
                 # the second consecutive one — by then the next pending
                 # drain has certainly run and a vanished request is
                 # genuinely finished
-                if not any(adm["req"] is req
-                           for adm in self._admitting.values()):
+                owned = (self._disagg is not None
+                         and self._disagg.owns(req))
+                if owned:
+                    # mid-prefill on a worker, or a completed handoff
+                    # awaiting a slot: like a mid-chunked admission, the
+                    # park settles once the session reaches a slot
+                    self._park_unseen.discard(req)
+                elif not any(adm["req"] is req
+                             for adm in self._admitting.values()):
                     if req in self._park_unseen:
                         self._want_park.discard(req)
                         self._park_unseen.discard(req)
@@ -2571,6 +2682,7 @@ class ServingEngine:
                 if self._paged and not self._reserve_paged(free[0], head):
                     break  # pool exhausted: head parks (backpressure)
                 self._waiting.popleft()
+                head.t_depart_ns = time.monotonic_ns()
                 self.trace.record("queue_depart", head.rid, free[0])
                 self._admit(free.pop(0), head)
                 admitted = True
@@ -2582,6 +2694,7 @@ class ServingEngine:
                 if self._paged and not self._reserve_paged(free[0], head):
                     break  # pool exhausted: head parks (backpressure)
                 self._waiting.popleft()
+                head.t_depart_ns = time.monotonic_ns()
                 self.trace.record("queue_depart", head.rid, free[0])
                 self._admit(free.pop(0), head)
                 budget -= bucket
@@ -2625,12 +2738,62 @@ class ServingEngine:
                 batch = batch[:m]
             for req in batch:
                 self._waiting.remove(req)
+                req.t_depart_ns = time.monotonic_ns()
                 self.trace.record("queue_depart", req.rid)
             slots = [free.pop(0) for _ in batch]
             self._admit_batch(slots, batch, bucket)
             budget -= len(batch) * bucket
             admitted = True
         return admitted, budget
+
+    def _install_handoffs(self) -> bool:
+        """Disaggregated decode-side pickup: map each completed handoff's
+        already-filled blocks into a freed slot — ONE fused table-row +
+        length write (the same op a resume remap uses) and pure host
+        bookkeeping. The prefill worker already computed and delivered the
+        first token, so the slot resumes with its pending token exactly
+        like a parked session: the next decode tick feeds it and the
+        existing one-fetch tick contract carries the stream. ZERO KV bytes
+        move here — handoff_copies stays 0 by construction."""
+        rt = self._disagg
+        installed = False
+        for slot in range(self.serving.slots):
+            if self._slot_req[slot] is not None or slot in self._admitting:
+                continue
+            while True:
+                e = rt.pop_ready()
+                if e is None:
+                    return installed
+                req = e["req"]
+                if not req.cancelled:
+                    break
+                # discard the dead entry and retry the SAME free slot: a
+                # live handoff behind it must not wait out a tick. The
+                # worker delivered its first token, so the request BEGAN
+                # service — count the admission (the installed and
+                # worker-retired paths both do; dropping it here would
+                # undercount vs co-scheduled under cancellation load)
+                blocks = e["shared"] + e["priv"]
+                if blocks:
+                    self._alloc.release(blocks)
+                self._stats["admissions"] += 1
+                self.trace.record("retire", req.rid)
+                req.out.put(None)
+            n_pages, seq_len = e["n_pages"], e["seq_len"]
+            # the handoff entry is park-shaped by construction, so the
+            # resume remap IS the install: one fused table-row + length
+            # write plus the shared slot-restore bookkeeping (a field
+            # added to the restore path cannot miss handed-off sessions)
+            self._finish_resume_slot(slot, e)
+            # the next decode token's gap counts from the worker's first-
+            # token delivery, the same clock origin the co-scheduled
+            # path's _emit_first stamps (the restore cleared it)
+            self._itl_last[slot] = e["t_first"]
+            self._stats["admissions"] += 1
+            self.trace.record("pool_install", req.rid, slot, n_pages)
+            self.trace.record("admit", req.rid, slot, seq_len)
+            installed = True
+        return installed
 
     def _advance_admissions(self, budget: float = float("inf")) -> float:
         """One prefill chunk per mid-admission slot (then back to the decode
@@ -2792,11 +2955,17 @@ class ServingEngine:
             self.trace.note_queue_wait((now_ns - req.t_submit_ns) / 1e9)
 
     def _note_first_token(self, req: Request, slot: int) -> None:
-        """Trace a request's first delivered token + its TTFT sample."""
+        """Trace a request's first delivered token + its TTFT sample, and
+        the prefill-execution component (queue departure -> first token):
+        with the queue-wait reservoir it splits TTFT into where the time
+        actually went — the attribution the disagg A/B is judged on."""
         now_ns = time.monotonic_ns()
         self.trace.record("first_token", req.rid, slot)
         if req.t_submit_ns:
             self.trace.note_ttft((now_ns - req.t_submit_ns) / 1e9)
+        dep = req.t_depart_ns or req.t_submit_ns
+        if dep:
+            self.trace.note_prefill_exec((now_ns - dep) / 1e9)
 
     def _deliver_firsts(self, firsts: list[dict],
                         fetched: Optional[list] = None) -> None:
@@ -3003,6 +3172,15 @@ class ServingEngine:
         for q, key in ((0.5, "queue_wait_p50_ms"), (0.99, "queue_wait_p99_ms")):
             v = pct(waits, q)
             s[key] = round(v * 1e3, 3) if v is not None else None
+        # prefill-execution component of TTFT (queue departure -> first
+        # token): with the queue-wait reservoir above it attributes a TTFT
+        # regression to waiting vs prefilling — the split the disagg A/B
+        # and the ttft_benchmark /stats endpoint report
+        pexec = sorted(self.trace.prefill_exec_samples())
+        for q, key in ((0.5, "prefill_exec_p50_ms"),
+                       (0.99, "prefill_exec_p99_ms")):
+            v = pct(pexec, q)
+            s[key] = round(v * 1e3, 3) if v is not None else None
         s["trace_enabled"] = self.trace.enabled
         s["trace_events_recorded"] = self.trace.events_recorded
         s["trace_events_dropped"] = self.trace.events_dropped
@@ -3070,6 +3248,42 @@ class ServingEngine:
             self._swap_host_blocks if self._swap_enabled else None)
         s["swap_host_free"] = (
             len(self._host_free) if self._swap_enabled else None)
+        # disaggregated prefill/decode: handoff counters (handoff_copies
+        # is the zero-copy contract — device copies performed by the
+        # handoff path, 0 by construction), the live prefill backlog the
+        # controller partitions on, and the worker-side flow counters
+        # merged into the engine totals so the two modes stay comparable.
+        # Worker fetches land in admission_fetches/device_gets (their own
+        # thread's reads, like idle-engine admission fetches) and NEVER in
+        # tick_fetches — device_gets_per_tick stays a decode-side contract.
+        if self._disagg is not None:
+            rtc = self._disagg.counters_snapshot()
+            s["disagg"] = True
+            s["handoffs"] = rtc["handoffs"]
+            s["handoff_copies"] = rtc["handoff_copies"]
+            s["repartitions"] = self._disagg.controller.repartitions
+            s["prefill_backlog"] = self._disagg.backlog()
+            s["prefill_share_tokens"] = self._disagg.controller.prefill_share
+            s["generated_tokens"] += rtc["first_tokens"]
+            s["admissions"] += rtc["worker_retired"]
+            # a claimed or ready request has left _waiting but is not
+            # streaming yet — without this the queued gauge under-reads
+            # the moment disagg turns on (cross-mode dashboards compare it)
+            s["queued"] += self._disagg.owned()
+            s["prefill_chunks"] += rtc["prefill_chunks"]
+            s["device_gets"] += rtc["fetches"]
+            s["admission_fetches"] += rtc["fetches"]
+            s["bytes_fetched"] += rtc["bytes_fetched"]
+            s["prefix_blocks_shared"] += rtc["prefix_blocks_shared"]
+            s["prefix_cow_copies"] += rtc["prefix_cow_copies"]
+            s["pool_blocked_admissions"] += rtc["pool_blocked_prefills"]
+        else:
+            s["disagg"] = False
+            s["handoffs"] = 0
+            s["handoff_copies"] = 0
+            s["repartitions"] = 0
+            s["prefill_backlog"] = 0
+            s["prefill_share_tokens"] = None
         return s
 
     @property
@@ -3221,11 +3435,22 @@ class ServingEngine:
     def _loop(self) -> None:
         try:
             self._warm_executables()
+            if self._disagg is not None:
+                self._disagg.started.set()
             if self._pipeline:
                 self._loop_pipelined()
             else:
                 self._loop_sync()
         finally:
+            if self._disagg is not None:
+                # workers first: the drain below owns everything they
+                # might still be releasing (their stop paths return blocks
+                # and end streams; join bounds the wait). _stop may not be
+                # set yet when the loop died on an exception — set it so
+                # the workers observe the shutdown.
+                self._stop.set()
+                self._disagg.started.set()
+                self._disagg.join()
             # the loop owns slot/queue state, so it also owns the shutdown
             # sweep: every live Request gets its end-of-stream sentinel the
             # moment the loop exits (stop() only waits, never mutates)
@@ -3260,6 +3485,13 @@ class ServingEngine:
             self._drain_swap_outs()
             swap_s = time.perf_counter() - t_sw
             self._prof.note("swap_drain", swap_s)
+        if self._disagg is not None and self._swap_enabled:
+            # reclaim assist: a prefill worker's allocator miss posts the
+            # needed block count — eviction of parked pages runs HERE, on
+            # the parked-state owner's thread, never on a worker
+            need = self._disagg.take_needed_blocks()
+            if need:
+                self._reclaim(need)
         decoding = any(r is not None for r in self._slot_req)
         budget = (
             float(self.serving.prefill_budget)
@@ -3273,7 +3505,21 @@ class ServingEngine:
             # them (chunked rebuilds ride the budgeted
             # _advance_admissions path above on subsequent ticks)
             budget = self._advance_resumes(budget)
-        admitted, _ = self._admit_waiting(budget)
+        if self._disagg is not None:
+            # role split: the loop never admits from the waiting line —
+            # prefill workers own it; the loop only INSTALLS completed
+            # handoffs (one fused table-row write per session, zero
+            # copies) into freed slots, resumes first (older traffic)
+            admitted = self._install_handoffs()
+            if len(self._waiting):
+                # wake workers only when there is something to claim: the
+                # drain above just surfaced new heads, or a retire/reclaim
+                # this tick freed pool blocks a dry-pool claim was waiting
+                # on. Steady decode with an empty line skips the broadcast
+                # (submit() notifies directly, so no wakeup is lost).
+                self._disagg.notify_work()
+        else:
+            admitted, _ = self._admit_waiting(budget)
         for slot in range(self.serving.slots):
             req = self._slot_req[slot]
             if req is not None and req.cancelled:
@@ -3340,29 +3586,109 @@ class ServingEngine:
         # rebuild + upload (the tokens input already skips its own)
         active = None
         active_key: Optional[tuple] = None
+        # under disaggregation the tick-head + dispatch section (every
+        # loop-side mutation of the donated device state) runs inside the
+        # state mutex; it is released before the blocking delivery fetch
+        # and the idle wait so prefill workers dispatch in those windows
+        locking = self._disagg is not None
         while not self._stop.is_set():
-            admitted = self._tick_head()
-            # this pass's async-admission manifest: their device token
-            # arrays ride the delivery fetch below (or a standalone batched
-            # admission fetch when no tick is in flight to piggyback on)
-            firsts = self._pending_firsts
-            self._pending_firsts = []
-            t_disp = time.perf_counter()
-            # fed[i]: slot i's next token is the in-flight tick's device
-            # sample (same request then and now; identity survives neither
-            # retire nor recycle)
-            fed = [
-                inflight is not None
-                and inflight["reqs"][i] is not None
-                and inflight["reqs"][i] is self._slot_req[i]
-                for i in range(b)
-            ]
-            dispatch = [
-                i for i in range(b)
-                if self._slot_req[i] is not None
-                and self._slot_req[i] not in self._want_park
-                and self._slot_budget[i] - (1 if fed[i] else 0) > 0
-            ]
+            if locking:
+                self._state_mu.acquire()
+            locked = locking
+            try:
+                admitted = self._tick_head()
+                # this pass's async-admission manifest: their device token
+                # arrays ride the delivery fetch below (or a standalone
+                # batched admission fetch when no tick is in flight to
+                # piggyback on)
+                firsts = self._pending_firsts
+                self._pending_firsts = []
+                t_disp = time.perf_counter()
+                # fed[i]: slot i's next token is the in-flight tick's
+                # device sample (same request then and now; identity
+                # survives neither retire nor recycle)
+                fed = [
+                    inflight is not None
+                    and inflight["reqs"][i] is not None
+                    and inflight["reqs"][i] is self._slot_req[i]
+                    for i in range(b)
+                ]
+                dispatch = [
+                    i for i in range(b)
+                    if self._slot_req[i] is not None
+                    and self._slot_req[i] not in self._want_park
+                    and self._slot_budget[i] - (1 if fed[i] else 0) > 0
+                ]
+                new_inflight = None
+                disp_s = 0.0
+                if dispatch:
+                    live = set(dispatch)
+                    if inflight is not None and all(fed[i] for i in dispatch):
+                        # steady state (no admit/retire since last tick):
+                        # feed the in-flight device tokens straight back —
+                        # no host upload, no where; non-dispatched rows
+                        # carry stale device values the active mask ignores
+                        tokens = inflight["tokens"]
+                    elif inflight is None:
+                        tokens = jnp.asarray(self._tokens, jnp.int32)
+                    else:
+                        tokens = self._merge_tokens(
+                            jnp.asarray(fed, bool), inflight["tokens"],
+                            jnp.asarray(self._tokens, jnp.int32))
+                    over = [i for i in dispatch if self._admit_mask[i]]
+                    if over:
+                        # freshly admitted slots: their first tokens are
+                        # still device-resident in _admit_buf (scattered
+                        # there inside the prefill dispatch) — one
+                        # static-shape jitted merge, no host visit and no
+                        # per-pattern compile
+                        tokens = self._merge_tokens(
+                            jnp.asarray([i in over for i in range(b)], bool),
+                            self._admit_buf, tokens)
+                        for i in over:
+                            self._admit_mask[i] = False
+                    if active_key != tuple(dispatch):
+                        active = jnp.asarray(
+                            [i in live for i in range(b)], bool)
+                        active_key = tuple(dispatch)
+                    if self._use_kv_buckets:
+                        # the host length mirror lags one tick for
+                        # in-flight slots; the read window must cover the
+                        # DEVICE length
+                        need = 1 + max(
+                            self._slot_len[i] + (1 if fed[i] else 0)
+                            for i in dispatch)
+                        kv_bucket = next(
+                            (bkt for bkt in self._kv_buckets if bkt >= need),
+                            self.model.max_context,
+                        )
+                    else:
+                        kv_bucket = 0
+                    self._note_kv_window(
+                        kv_bucket,
+                        [self._slot_len[i] + (1 if fed[i] else 0)
+                         for i in dispatch])
+                    tok_d, lp_d, self.state, self._rng = self._decode_sampled(
+                        self.params, self.state, tokens, active, self._rng,
+                        kv_bucket, unroll=self._unroll,
+                    )
+                    self._stats["decode_ticks"] += 1
+                    if self._disagg is not None:
+                        # one decode tick elapsed: refill the controller's
+                        # prefill allowance at the current partition
+                        self._disagg.on_tick()
+                    if inflight is not None:
+                        self._stats["pipelined_ticks"] += 1
+                    new_inflight = {
+                        "tokens": tok_d, "logprobs": lp_d,
+                        "reqs": [self._slot_req[i] if i in live else None
+                                 for i in range(b)],
+                    }
+                    disp_s = time.perf_counter() - t_disp
+                    self._prof.note("dispatch", disp_s)
+            finally:
+                if locked:
+                    self._state_mu.release()
             if not dispatch and inflight is None:
                 if firsts:
                     # admissions whose every request spends its whole budget
@@ -3371,66 +3697,6 @@ class ServingEngine:
                 else:
                     self._idle_wait(admitted)
                 continue
-            new_inflight = None
-            disp_s = 0.0
-            if dispatch:
-                live = set(dispatch)
-                if inflight is not None and all(fed[i] for i in dispatch):
-                    # steady state (no admit/retire since last tick): feed
-                    # the in-flight device tokens straight back — no host
-                    # upload, no where; non-dispatched rows carry stale
-                    # device values the active mask ignores
-                    tokens = inflight["tokens"]
-                elif inflight is None:
-                    tokens = jnp.asarray(self._tokens, jnp.int32)
-                else:
-                    tokens = self._merge_tokens(
-                        jnp.asarray(fed, bool), inflight["tokens"],
-                        jnp.asarray(self._tokens, jnp.int32))
-                over = [i for i in dispatch if self._admit_mask[i]]
-                if over:
-                    # freshly admitted slots: their first tokens are still
-                    # device-resident in _admit_buf (scattered there inside
-                    # the prefill dispatch) — one static-shape jitted merge,
-                    # no host visit and no per-pattern compile
-                    tokens = self._merge_tokens(
-                        jnp.asarray([i in over for i in range(b)], bool),
-                        self._admit_buf, tokens)
-                    for i in over:
-                        self._admit_mask[i] = False
-                if active_key != tuple(dispatch):
-                    active = jnp.asarray([i in live for i in range(b)], bool)
-                    active_key = tuple(dispatch)
-                if self._use_kv_buckets:
-                    # the host length mirror lags one tick for in-flight
-                    # slots; the read window must cover the DEVICE length
-                    need = 1 + max(
-                        self._slot_len[i] + (1 if fed[i] else 0)
-                        for i in dispatch)
-                    kv_bucket = next(
-                        (bkt for bkt in self._kv_buckets if bkt >= need),
-                        self.model.max_context,
-                    )
-                else:
-                    kv_bucket = 0
-                self._note_kv_window(
-                    kv_bucket,
-                    [self._slot_len[i] + (1 if fed[i] else 0)
-                     for i in dispatch])
-                tok_d, lp_d, self.state, self._rng = self._decode_sampled(
-                    self.params, self.state, tokens, active, self._rng,
-                    kv_bucket, unroll=self._unroll,
-                )
-                self._stats["decode_ticks"] += 1
-                if inflight is not None:
-                    self._stats["pipelined_ticks"] += 1
-                new_inflight = {
-                    "tokens": tok_d, "logprobs": lp_d,
-                    "reqs": [self._slot_req[i] if i in live else None
-                             for i in range(b)],
-                }
-                disp_s = time.perf_counter() - t_disp
-                self._prof.note("dispatch", disp_s)
             if inflight is not None:
                 self._deliver(inflight, extra_host_s=disp_s, firsts=firsts)
             elif firsts:
@@ -3458,8 +3724,20 @@ class ServingEngine:
         newest token must be observed before the next dispatch). Still one
         batched device_get per tick — only the overlap is missing."""
         b = self.serving.slots
+        # disaggregation serializes the loop's state mutations against the
+        # prefill workers' (see _loop_pipelined): the tick head and the
+        # decode dispatch each run under the state mutex, and the only
+        # disagg-reachable branch here is the device-sampled one (disagg
+        # forbids custom samplers and speculation). Everything between the
+        # two locked sections reads host-side slot structures the workers
+        # never touch.
+        locking = self._disagg is not None
         while not self._stop.is_set():
-            admitted = self._tick_head()
+            if locking:
+                with self._state_mu:
+                    admitted = self._tick_head()
+            else:
+                admitted = self._tick_head()
             # async-admission first tokens (device sampling with pipelining
             # off): delivered through this tick's batched fetch, same
             # contract as the pipelined loop
@@ -3592,10 +3870,18 @@ class ServingEngine:
             if self._device_sampling:
                 # fused device sampling: the tick returns [B] tokens, not
                 # logits, and _deliver does the one batched fetch
-                tok_d, lp_d, self.state, self._rng = self._decode_sampled(
-                    self.params, self.state, tokens, active, self._rng,
-                    kv_bucket, unroll=self._unroll,
-                )
+                if locking:
+                    with self._state_mu:
+                        tok_d, lp_d, self.state, self._rng = \
+                            self._decode_sampled(
+                                self.params, self.state, tokens, active,
+                                self._rng, kv_bucket, unroll=self._unroll)
+                    self._disagg.on_tick()
+                else:
+                    tok_d, lp_d, self.state, self._rng = self._decode_sampled(
+                        self.params, self.state, tokens, active, self._rng,
+                        kv_bucket, unroll=self._unroll,
+                    )
                 self._stats["decode_ticks"] += 1
                 # active_slots IS the set of non-None _slot_req entries
                 # this iteration, so the snapshot is simply the list (the
