@@ -307,6 +307,10 @@ def tenant_main(a: argparse.Namespace) -> None:
                 "kv_bucket_hist", "kv_hbm_bytes", "kv_hbm_bytes_per_chip",
                 "tp", "paged",
                 "kv_pool_occupancy", "pool_blocked_admissions",
+                # paged decode-attention routing: which read route each
+                # dispatched tick compiled to (fused table-walking kernel
+                # vs gather-then-dense) — the measured-routing audit trail
+                "paged_attn_kernel_ticks", "paged_attn_gather_ticks",
                 "prefix_blocks_shared", "prefix_install_copies",
                 # KV overcommit: pool high-water vs capacity, parked
                 # population, host-tier swap traffic, and the faults the

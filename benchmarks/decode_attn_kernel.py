@@ -1,19 +1,27 @@
-"""Pallas decode/verify attention — a standalone kernel study, NOT a product
-path.
+"""Pallas decode-attention study surface — now a thin shim over the PRODUCT
+kernel module ``vtpu/ops/decode_attn.py``.
 
-History (VERDICT r5 weak #4 resolution): standalone, this fused kernel beats
-XLA at every serving cell (DECODE_ATTN_r05.json, two-chain-difference
-timing — 1.1-1.9x, ~760 GB/s). In the TRUNK it loses everywhere (MFU_r05
-decode): a pallas operand must be materialized while the serving cache is
-being scatter-updated, so XLA copies the layer view it would otherwise fuse
-windowed reads from — the copy costs more than the kernel saves, and no
-operand shape avoids both the copy and the window. ``decode_attn="auto"``
-therefore always routed XLA, which left the in-trunk "pallas" route a dead
-product path; r6 removed the route (vtpu/ops/attention.py keeps only the
-shipped paths) and parked the kernel here, where hack/decode_attn_bench.py
-keeps its standalone numbers re-checkable. Re-promotion needs what the r5
-notes name: a shard_map wrapper (tp meshes) plus input/output aliasing so
-the cache view feeds the kernel without materialization.
+History (VERDICT r5 weak #4 → ISSUE 10 resolution): standalone, the fused
+dense-cache kernel beats XLA at the T=1 long-window cells
+(DECODE_ATTN_r05.json, two-chain-difference timing — bf16 1.1-1.6x from
+window 1024, int8 1.9x at 2048, ~760 GB/s; int8@1024 and T=4 chunks lost —
+the shipped auto router keys on exactly those cells). In the TRUNK it lost
+everywhere (MFU_r05 decode): a pallas operand must be materialized while the
+serving cache is being scatter-updated, so XLA copied the layer view — the
+copy cost more than the kernel saved, r6 removed the route and parked the
+kernel here. The park verdict named what re-promotion needed: a shard_map
+wrapper for ('tp',) meshes, and input/output aliasing so the cache feeds
+the kernel without materialization.
+
+BOTH shipped with the paged pool route (ISSUE 10): ``paged_decode_attention``
+in vtpu/ops/decode_attn.py takes the whole donated block pool as its operand
+(nothing to materialize — the scatter-updated buffer aliases straight in),
+walks the page table via scalar prefetch, wraps in shard_map under a ('tp',)
+mesh, and speaks int8 natively. The serving trunk routes to it per measured
+shape (paged_attn_route); the dense study kernel lives on in the product
+module unchanged so its standalone numbers stay re-checkable —
+hack/decode_attn_bench.py drives ``decode_attention`` through this import
+exactly as before.
 
 Equals causal_attention / causal_attention_int8kv on the same operands
 (tests/test_ops.py asserts both, driving this module directly).
@@ -21,185 +29,6 @@ Equals causal_attention / causal_attention_int8kv on the same operands
 
 from __future__ import annotations
 
-import functools
-import math
+from vtpu.ops.decode_attn import decode_attention
 
-import jax
-import jax.numpy as jnp
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
-
-_NEG_INF = -1e30
-
-
-def _decode_kernel(q_ref, k_ref, v_ref, lens_ref, o_ref,
-                   acc_ref, m_ref, d_ref, *,
-                   scale: float, nheads: int, dh: int, s_blk: int,
-                   n_blocks: int, ks_ref=None, vs_ref=None):
-    """One batch row x one KV S-block, all heads unrolled in-kernel.
-
-    Decode attention on the XLA path is dispatch-bound, not byte-bound
-    (MFU_r04: 33% HBM BW at batch 8 — M=1 batched matmuls, a materialized
-    [B,H,T,S] mask/score tensor, separate softmax ops). Here the whole
-    attention for a batch row is one kernel: K/V stream through VMEM as
-    contiguous (S_blk, H*Dh) tiles read straight from the cache's native
-    [B, S, H*Dh] view (a [B,H,S,Dh] relayout would copy the entire window
-    every tick, costing the bytes the kernel exists to save), heads are a
-    static unroll, and the softmax runs ONLINE across S-blocks (flash
-    style) so VMEM holds one tile + (T, Dh) f32 accumulators per head.
-
-    int8 variant (ks_ref/vs_ref non-None): the quantized planes convert to
-    bf16 IN VMEM — HBM streams the int8 bytes, which is the halving the
-    cache quantization promises — and the per-token-per-head scales apply
-    post-matmul exactly as in causal_attention_int8kv: k_scale on the score
-    tile before max/exp; v_scale on the probabilities only in the OUTPUT
-    accumulation, never in the softmax denominator."""
-    j = pl.program_id(1)
-
-    @pl.when(j == 0)
-    def _init():
-        m_ref[...] = jnp.full(m_ref.shape, _NEG_INF, m_ref.dtype)
-        d_ref[...] = jnp.zeros(d_ref.shape, d_ref.dtype)
-        acc_ref[...] = jnp.zeros(acc_ref.shape, acc_ref.dtype)
-
-    lens = lens_ref[0, 0, :]  # (T,) int32: query i may read k_pos < lens[i]
-    t = lens.shape[0]
-    base = j * s_blk
-    k_pos = base + jax.lax.broadcasted_iota(jnp.int32, (t, s_blk), 1)
-    valid = k_pos < lens[:, None]
-    for h in range(nheads):
-        q = q_ref[0, :, h * dh:(h + 1) * dh]  # (T, Dh)
-        k = k_ref[0, :, h * dh:(h + 1) * dh].astype(q.dtype)
-        v = v_ref[0, :, h * dh:(h + 1) * dh].astype(q.dtype)
-        scores = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
-        if ks_ref is not None:
-            scores = scores * ks_ref[0, h, :][None, :]
-        scores = jnp.where(valid, scores, _NEG_INF)
-        m_prev = m_ref[h, :, :1]  # (T, 1) f32 (lane-replicated store)
-        d_prev = d_ref[h, :, :1]
-        m_new = jnp.maximum(m_prev, jnp.max(scores, axis=-1, keepdims=True))
-        alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(scores - m_new)  # (T, S_blk) f32
-        d_ref[h] = jnp.broadcast_to(
-            d_prev * alpha + jnp.sum(p, axis=-1, keepdims=True),
-            d_ref[h].shape)
-        m_ref[h] = jnp.broadcast_to(m_new, m_ref[h].shape)
-        if vs_ref is not None:
-            p = p * vs_ref[0, h, :][None, :]
-        pv = jnp.dot(p.astype(v.dtype), v, preferred_element_type=jnp.float32)
-        acc_ref[h] = acc_ref[h] * alpha + pv
-
-    @pl.when(j == n_blocks - 1)
-    def _emit():
-        for h in range(nheads):
-            out = acc_ref[h] / d_ref[h, :, :1]
-            o_ref[0, :, h * dh:(h + 1) * dh] = out.astype(o_ref.dtype)
-
-
-def _decode_s_block(s: int) -> int:
-    for cand in (512, 256, 128):
-        if s % cand == 0:
-            return min(cand, s)
-    return s
-
-
-@functools.partial(jax.jit, static_argnames=("bucket", "interpret"))
-def decode_attention(
-    q: jax.Array,
-    k: jax.Array,
-    v: jax.Array,
-    kv_len: jax.Array,
-    k_scale: jax.Array | None = None,
-    v_scale: jax.Array | None = None,
-    bucket: int = 0,
-    interpret: bool | None = None,
-) -> jax.Array:
-    """Pallas decode/verify attention over the serving cache's native
-    layout. q: [B, T, H, Dh] (T = 1 decode tick or k+1 verify chunk);
-    k, v: [B, S, H, Dh] bf16, or int8 with k_scale/v_scale [B, S, H] f32;
-    kv_len: ragged [B, T] (query i of row b reads k_pos < kv_len[b, i]) or
-    [B] (T must be 1; the suffix-decode mask k_pos < len is identical).
-
-    ``bucket`` (static; 0 = S) bounds the attention READS via the GRID —
-    blocks past the bucket are simply never scheduled. Callers pass the
-    cache's FULL per-layer view (a contiguous leading-dim slice, zero
-    copy) instead of a ``[:, :bucket]`` slice: a pallas operand must be
-    materialized, so the sliced form forced XLA to copy the whole window
-    every tick — measured 27 ms vs XLA's 6.8 ms at batch 32 / 2048 before
-    this (MFU_r05 first pass), erasing the kernel's standalone win.
-
-    Single-chip kernel: under a GSPMD-partitioned tp mesh a pallas_call
-    cannot shard over the head axis; see the module docstring for the
-    re-promotion requirements.
-    """
-    b, t, h, dh = q.shape
-    s = k.shape[1]
-    bucket = bucket or s
-    if bucket > s:
-        raise ValueError(f"bucket {bucket} exceeds cache length {s}")
-    if kv_len.ndim == 1:
-        if t != 1:
-            raise ValueError("[B] kv_len requires T=1 (ragged [B,T] otherwise)")
-        kv_len = kv_len[:, None]
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
-    scale = 1.0 / math.sqrt(dh)
-    s_blk = _decode_s_block(bucket)
-    n_blocks = bucket // s_blk
-    # native [B, S, H, Dh] -> [B, S, H*Dh] is a free reshape (contiguous);
-    # per-head tiles are static minor-dim slices in-kernel
-    kf = k.reshape(b, s, h * dh)
-    vf = v.reshape(b, s, h * dh)
-    qf = q.reshape(b, t, h * dh)
-    lens3 = kv_len[:, None, :]  # [B, 1, T]: rank-3 so block dims satisfy tiling
-    grid = (b, n_blocks)
-    q_spec = pl.BlockSpec((1, t, h * dh), lambda i, j: (i, 0, 0))
-    kv_spec = pl.BlockSpec((1, s_blk, h * dh), lambda i, j: (i, j, 0))
-    len_spec = pl.BlockSpec((1, 1, t), lambda i, j: (i, 0, 0))
-    out_shape = jax.ShapeDtypeStruct((b, t, h * dh), q.dtype)
-    scratch = [
-        pltpu.VMEM((h, t, dh), jnp.float32),   # acc
-        pltpu.VMEM((h, t, 128), jnp.float32),  # m (lane-replicated)
-        pltpu.VMEM((h, t, 128), jnp.float32),  # d (lane-replicated)
-    ]
-    kern = functools.partial(
-        _decode_kernel, scale=scale, nheads=h, dh=dh, s_blk=s_blk,
-        n_blocks=n_blocks)
-    if k_scale is None:
-        out = pl.pallas_call(
-            kern,
-            grid=grid,
-            in_specs=[q_spec, kv_spec, kv_spec, len_spec],
-            out_specs=q_spec,
-            out_shape=out_shape,
-            scratch_shapes=scratch,
-            interpret=interpret,
-        )(qf, kf, vf, lens3)
-        return out.reshape(b, t, h, dh)
-
-    def kern8(q_ref, k_ref, ks_ref, v_ref, vs_ref, lens_ref, o_ref,
-              acc_ref, m_ref, d_ref):
-        _decode_kernel(q_ref, k_ref, v_ref, lens_ref, o_ref,
-                       acc_ref, m_ref, d_ref,
-                       scale=scale, nheads=h, dh=dh, s_blk=s_blk,
-                       n_blocks=n_blocks, ks_ref=ks_ref, vs_ref=vs_ref)
-
-    # scales sliced to the bucket THEN pre-transposed to [B, H, bucket]:
-    # contiguous (H, S_blk) tiles (the cache-native [B, S, H] would DMA
-    # 4-byte strided runs). Slicing first keeps the materialization
-    # proportional to the window actually read — a full-S transpose on a
-    # long cache with a small bucket would cost a significant fraction of
-    # the int8 bytes the grid-bounding saves.
-    ks_t = k_scale[:, :bucket].transpose(0, 2, 1)
-    vs_t = v_scale[:, :bucket].transpose(0, 2, 1)
-    scale_spec = pl.BlockSpec((1, h, s_blk), lambda i, j: (i, 0, j))
-    out = pl.pallas_call(
-        kern8,
-        grid=grid,
-        in_specs=[q_spec, kv_spec, scale_spec, kv_spec, scale_spec, len_spec],
-        out_specs=q_spec,
-        out_shape=out_shape,
-        scratch_shapes=scratch,
-        interpret=interpret,
-    )(qf, kf, ks_t, vf, vs_t, lens3)
-    return out.reshape(b, t, h, dh)
+__all__ = ["decode_attention"]

@@ -109,6 +109,44 @@ def test_paged_kv_bench_quick_tp2_iteration():
     assert summary["summary"] and summary["prefix_zero_copy"]
 
 
+def test_paged_kv_bench_attn_kernel_quick_iteration():
+    """paged_kv_bench --attn-kernel --quick end to end at smoke scale: the
+    kernel-vs-gather long-context A/B runs with every deterministic gate
+    holding — token-equal streams across the routes, route counters
+    attributing each tick, the kernel arm's compiled decode step free of
+    pool-window gathers (the gather arm keeps them), auto routing staying
+    on gather off-TPU, and the one-fetch-per-tick contract on both arms.
+    The tokens/sec ratio is TPU-full-run gated, never asserted here (the
+    kernel arm runs interpreted pallas on this rig)."""
+    r = _run([str(ROOT / "benchmarks" / "paged_kv_bench.py"),
+              "--attn-kernel", "--quick", "--max-seq", "64",
+              "--requests", "3", "--max-new", "8"])
+    assert r.returncode == 0, r.stderr
+    lines = [ln for ln in r.stdout.splitlines() if ln.strip()]
+    artifact = json.loads(lines[0])
+    summary = json.loads(lines[-1])
+    assert artifact["metric"] == \
+        "paged_attn_kernel_long_context_tokens_per_sec_speedup"
+    det = artifact["deterministic_gates"]
+    assert det["streams_token_equal"]
+    assert det["route_counters_attributed"]
+    assert det["kernel_hlo_gather_free"]
+    assert det["gather_hlo_has_pool_gathers"]
+    assert det["auto_route_off_tpu_is_gather"]
+    assert det["device_gets_per_tick_contract"]
+    assert artifact["pool_window_gathers"]["kernel_arm"] == 0
+    assert artifact["pool_window_gathers"]["gather_arm"] > 0
+    arms = {a["arm"]: a for a in artifact["arms"]}
+    assert arms["kernel"]["paged_attn_kernel_ticks"] > 0
+    assert arms["kernel"]["paged_attn_gather_ticks"] == 0
+    assert arms["gather"]["paged_attn_gather_ticks"] > 0
+    assert arms["gather"]["paged_attn_kernel_ticks"] == 0
+    assert arms["kernel"]["tokens"] == arms["gather"]["tokens"]
+    assert not artifact["perf_gated"]  # cpu rig: perf is TPU-full-run only
+    assert summary["summary"] and summary["verdict"] == "pass"
+    assert summary["kernel_hlo_gather_free"]
+
+
 def test_overcommit_bench_help_parses():
     r = _run([str(ROOT / "benchmarks" / "overcommit_bench.py"), "--help"])
     assert r.returncode == 0, r.stderr

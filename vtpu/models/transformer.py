@@ -23,6 +23,9 @@ from vtpu.ops import (
     paged_causal_attention_int8kv,
 )
 from vtpu.ops.attention import FLASH_MIN_SEQ
+from vtpu.ops.decode_attn import (
+    paged_attn_route, paged_decode_attention, paged_decode_attention_int8kv,
+)
 
 Params = dict[str, Any]
 
@@ -378,6 +381,7 @@ def decode_layer_loop(
     ffn_fn=None,
     unroll: bool = False,
     mesh=None,
+    paged_attn=None,
 ) -> tuple[jax.Array, dict[str, jax.Array]]:
     """Shared decode-step body: a fori_loop carrying the STACKED cache (not a
     scan stacking fresh per-layer outputs), so the cache write — supplied by
@@ -393,10 +397,11 @@ def decode_layer_loop(
     spec_verify_loop, which owns the single implementation — one decode
     token is a T=1 verify chunk, so plain-decode and speculative-verify
     numerics can never drift apart). ``mesh`` marks a head-sharded paged
-    pool (see spec_verify_loop). Returns (logits [B, vocab], new kv)."""
+    pool; ``paged_attn`` forces or resolves the kernel-vs-gather paged read
+    route (see spec_verify_loop). Returns (logits [B, vocab], new kv)."""
     logits, new_kv = spec_verify_loop(
         params, cfg, cache, token[:, None], kv_bucket, write_kv,
-        ffn_fn=ffn_fn, unroll=unroll, mesh=mesh,
+        ffn_fn=ffn_fn, unroll=unroll, mesh=mesh, paged_attn=paged_attn,
     )
     return logits[:, 0], new_kv
 
@@ -411,6 +416,7 @@ def spec_verify_loop(
     ffn_fn=None,
     unroll: bool = False,
     mesh=None,
+    paged_attn=None,
 ) -> tuple[jax.Array, dict[str, jax.Array]]:
     """Verify pass for speculative decoding: one forward over a [B, T] draft
     chunk whose row-i query sits at cache position len[b] + i.
@@ -448,6 +454,16 @@ def spec_verify_loop(
     introduce no collectives beyond the per-block all-reduce the dense TP
     path already pays after wo. None (the default) is the single-chip
     path, bit-identical to before the mesh existed.
+
+    ``paged_attn`` (paged caches only) picks the read route: "kernel"
+    forces the fused Pallas table-walker (ops.decode_attn
+    paged_decode_attention{,_int8kv} — attends over pool blocks IN PLACE,
+    no gather, no dense window), "gather" forces the classic
+    gather-then-dense chain, and None resolves the measured per-shape
+    router (paged_attn_route — the FLASH_MIN_SEQ discipline: the kernel
+    engages only where it beat the gather path on this hardware). Both
+    routes share the kv_len masking and null-block contracts verbatim, so
+    streams stay token-equal across the routing decision.
     """
     b, t = draft.shape
     bucket = kv_bucket or cfg.max_seq
@@ -462,9 +478,15 @@ def spec_verify_loop(
     # verbatim — paged-vs-dense streams stay token-identical. The caller's
     # write_kv owns the paged scatter (block id = table[b, pos // page]).
     table = cache.get("table")
+    use_kernel = False
     if table is not None:
         page = cache["k"].shape[2]  # [L, n_blocks, page, H, Dh]
         table_w = table[:, : bucket // page]  # [B, Wp]
+        # route resolution is a static per-shape property (window, chunk
+        # width, quantization), so the engine's per-tick route counters can
+        # mirror it exactly
+        use_kernel = paged_attn_route(
+            paged_attn, bucket, t=t, quant=quant) == "kernel"
     # clip: a slot near the context wall still computes (static shapes) but
     # its out-of-range rows are never written (write_kv masks) nor emitted
     # (the engine caps acceptance); clipping only keeps the rope gather legal
@@ -483,14 +505,31 @@ def spec_verify_loop(
             lp = jax.tree_util.tree_map(lambda a: a[l], params["layers"])
         q, k, v = _qkv(cfg, lp, x, cos, sin, positions)
         kv = write_kv(l, kv, k, v)
+        # Paged KERNEL route: the fused table-walker takes the WHOLE
+        # scatter-updated pool plus the layer index (a scalar-prefetch
+        # operand — static under the unrolled serving loop, traced under
+        # fori_loop, one executable either way), so no per-layer view and
+        # no gathered window ever materialize. This is the re-promotion of
+        # the r5 study: the pool operand aliases straight into the
+        # pallas_call, killing the copy that routed every trunk cell to
+        # XLA back then (MFU_r05).
+        if use_kernel:
+            if quant:
+                attn = paged_decode_attention_int8kv(
+                    q, kv["k"], kv["k_scale"], kv["v"], kv["v_scale"],
+                    table_w, ragged_len, layer=l, mesh=mesh)
+            else:
+                attn = paged_decode_attention(
+                    q, kv["k"], kv["v"], table_w, ragged_len, layer=l,
+                    mesh=mesh)
+            x = x + attn.reshape(b, t, cfg.qkv_dim) @ lp["wo"]
+            x = x + ffn(lp, x)
+            return x, kv
         # Bounded window reads: with the UNROLLED loop (the serving
         # default) the static index is a contiguous leading-dim slice and
         # the [:, :bucket] view fuses into the attention reads; under
         # fori_loop the loop-carried layer index materializes the slice
         # (correct but slow — benchmarks/mfu_bench.py decode_fori_exhibit).
-        # The fused Pallas decode kernel that once had a forced route here
-        # is a standalone study in benchmarks/decode_attn_kernel.py: trunk
-        # measurement routed every serving cell to XLA (MFU_r05).
         if unroll:
             view = {key: kv[key][l] for key in kv_keys}
         else:

@@ -61,6 +61,12 @@ COUNTERS = {
                         "Live pages gathered by decode reads"),
     "read_pages_window": ("read_pages_window",
                           "Window pages spanned by decode reads"),
+    "paged_attn_kernel_ticks": ("paged_attn_kernel_ticks",
+                                "Ticks routed to the fused paged-attention "
+                                "kernel (table walked in place)"),
+    "paged_attn_gather_ticks": ("paged_attn_gather_ticks",
+                                "Ticks routed to the gather-then-dense "
+                                "paged-attention chain"),
     "parks": ("parks", "Sessions taken out of the decode batch"),
     "resumes": ("resumes", "Parked sessions brought back"),
     "evicted_blocks": ("evicted_blocks",

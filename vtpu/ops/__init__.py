@@ -18,6 +18,15 @@ from vtpu.ops.attention import (
     paged_causal_attention,
     paged_causal_attention_int8kv,
 )
+from vtpu.ops.decode_attn import (
+    PAGED_ATTN_MIN_WINDOW,
+    PAGED_ATTN_MIN_WINDOW_INT8,
+    count_pool_gathers,
+    decode_attention,
+    paged_attn_route,
+    paged_decode_attention,
+    paged_decode_attention_int8kv,
+)
 
 __all__ = [
     "scaled_normal",
@@ -30,4 +39,11 @@ __all__ = [
     "gather_kv_pages",
     "paged_causal_attention",
     "paged_causal_attention_int8kv",
+    "PAGED_ATTN_MIN_WINDOW",
+    "PAGED_ATTN_MIN_WINDOW_INT8",
+    "count_pool_gathers",
+    "decode_attention",
+    "paged_attn_route",
+    "paged_decode_attention",
+    "paged_decode_attention_int8kv",
 ]

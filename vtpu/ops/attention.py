@@ -8,11 +8,14 @@ K-pass per q-block is the fastest schedule (no online-softmax rescan needed).
 On non-TPU backends the kernel runs in interpret mode so tests stay green on
 the CPU CI mesh.
 
-The fused Pallas DECODE kernel that used to live here is a standalone study
-under benchmarks/decode_attn_kernel.py: full-trunk measurement routed every
-serving cell to the XLA op chain (MFU_r05 — the cache-view materialization a
-pallas operand forces costs more than the kernel saves), so no in-trunk
-route exists and this module keeps only shipped paths.
+The fused Pallas DECODE kernels live in vtpu/ops/decode_attn.py: the dense-
+cache study (parked after r5 full-trunk measurement routed every serving
+cell to the XLA op chain — the cache-view materialization a pallas operand
+forces cost more than the kernel saved) and the shipped PAGED product path,
+``paged_decode_attention{,_int8kv}``, which walks the page table over the
+block pool in place — the serving trunk routes between it and the
+``paged_causal_attention`` gather path below per measured shape
+(decode_attn.paged_attn_route).
 """
 
 from __future__ import annotations

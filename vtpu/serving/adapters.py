@@ -158,11 +158,12 @@ class TransformerSlotModel:
 
     def __init__(self, params: Any, cfg: Any, mesh: Optional[Any] = None,
                  kv_page: Optional[int] = None,
-                 kv_pool_blocks: Optional[int] = None):
+                 kv_pool_blocks: Optional[int] = None,
+                 paged_attn: Optional[str] = None):
         self.cfg = cfg
         self.mesh = mesh
         self.max_context = cfg.max_seq
-        _init_paged_attrs(self, kv_page, kv_pool_blocks)
+        _init_paged_attrs(self, kv_page, kv_pool_blocks, paged_attn)
         if mesh is None:
             self.params = params
         else:
@@ -218,7 +219,7 @@ class TransformerSlotModel:
         logits, new = batched_decode_step(
             cfg=self.cfg, params=params, cache=_constrain_paged(self, state),
             tokens=tokens, active=active, kv_bucket=kv_bucket, unroll=unroll,
-            mesh=self.mesh,
+            mesh=self.mesh, paged_attn=self.paged_attn,
         )
         return logits, _constrain_paged(self, new)
 
@@ -229,7 +230,7 @@ class TransformerSlotModel:
         pred, count, new = batched_spec_step(
             cfg=self.cfg, params=params, cache=_constrain_paged(self, state),
             draft=draft, active=active, cap=cap, kv_bucket=kv_bucket,
-            unroll=unroll, mesh=self.mesh,
+            unroll=unroll, mesh=self.mesh, paged_attn=self.paged_attn,
         )
         return pred, count, _constrain_paged(self, new)
 
@@ -286,14 +287,31 @@ def _constrain_paged(model: Any, state: Any) -> Any:
 
 
 def _init_paged_attrs(model: Any, kv_page: Optional[int],
-                      kv_pool_blocks: Optional[int]) -> None:
+                      kv_pool_blocks: Optional[int],
+                      paged_attn: Optional[str] = None) -> None:
     """Shared paged-pool attribute setup for KV-cache adapter families.
     kv_pool_blocks counts USABLE blocks; n_kv_blocks (resolved at
     init_state once the slot count is known) includes the reserved null
-    block 0."""
+    block 0. ``paged_attn`` (None/"kernel"/"gather") is the paged
+    decode-attention route override the decode/spec steps thread into the
+    trunk — None resolves the measured per-shape router; forcing a route
+    without a paged pool is a config contradiction and raises."""
+    from vtpu.ops.decode_attn import PAGED_ATTN_ROUTES
+
+    if paged_attn is not None:
+        if paged_attn not in PAGED_ATTN_ROUTES:
+            raise ValueError(
+                f"paged_attn must be one of {PAGED_ATTN_ROUTES} or None "
+                f"(auto), got {paged_attn!r}")
+        if kv_page is None:
+            raise ValueError(
+                "paged_attn forces a paged decode-attention route, but the "
+                "cache is dense (kv_page=None) — there is no paged read "
+                "path to route")
     model.kv_page = kv_page
     model.kv_pool_blocks = kv_pool_blocks
     model.n_kv_blocks = None
+    model.paged_attn = paged_attn
 
 
 def _init_paged_state(model: Any, slots: int):
@@ -342,11 +360,12 @@ class MoeSlotModel:
 
     def __init__(self, params: Any, cfg: Any, mesh: Optional[Any] = None,
                  kv_page: Optional[int] = None,
-                 kv_pool_blocks: Optional[int] = None):
+                 kv_pool_blocks: Optional[int] = None,
+                 paged_attn: Optional[str] = None):
         self.cfg = cfg
         self.mesh = mesh
         self.max_context = cfg.max_seq
-        _init_paged_attrs(self, kv_page, kv_pool_blocks)
+        _init_paged_attrs(self, kv_page, kv_pool_blocks, paged_attn)
         if mesh is None:
             self.params = params
         else:
@@ -411,6 +430,7 @@ class MoeSlotModel:
             cfg=self.cfg, params=params, cache=_constrain_paged(self, state),
             tokens=tokens, active=active, kv_bucket=kv_bucket,
             ffn_fn=moe_decode_ffn(self.cfg), unroll=unroll, mesh=self.mesh,
+            paged_attn=self.paged_attn,
         )
         return logits, _constrain_paged(self, new)
 
@@ -423,6 +443,7 @@ class MoeSlotModel:
             cfg=self.cfg, params=params, cache=_constrain_paged(self, state),
             draft=draft, active=active, cap=cap, kv_bucket=kv_bucket,
             ffn_fn=moe_decode_ffn(self.cfg), unroll=unroll, mesh=self.mesh,
+            paged_attn=self.paged_attn,
         )
         return pred, count, _constrain_paged(self, new)
 

@@ -34,6 +34,7 @@ import numpy as np
 
 from vtpu.obs.tickprof import TickProfiler
 from vtpu.obs.trace import RequestTrace, pct
+from vtpu.ops.decode_attn import paged_attn_route
 
 from vtpu.models.transformer import (
     ModelConfig,
@@ -169,6 +170,20 @@ class ServingConfig:
     # materially more concurrent slots, and the free-list backpressure
     # absorbs the tail instead of an allocator failure.
     kv_pool_blocks: Optional[int] = None
+    # Paged decode-attention route (paged pools only). None = the measured
+    # per-shape router (ops.decode_attn.paged_attn_route — the FLASH_MIN_SEQ
+    # discipline: the fused Pallas table-walking kernel engages only at the
+    # dispatch shapes (window, chunk width, quantization) where it beat the
+    # gather path on this hardware, and never on non-TPU backends where
+    # pallas is interpreted emulation).
+    # "kernel" forces the fused kernel everywhere (walks the page table
+    # over the pool in place — no gather_kv_pages, no dense window);
+    # "gather" forces the classic gather-then-dense chain. Both routes are
+    # token-equal by contract (shared kv_len masking and null-block rules);
+    # stats() counts which route each tick dispatched
+    # (paged_attn_kernel_ticks / paged_attn_gather_ticks). Setting a route
+    # without kv_page is a config contradiction and raises.
+    paged_attn: Optional[str] = None
     # --- KV overcommit (eviction + host-RAM swap + recompute-on-fault) ---
     # kv_swap (host swap tier capacity, in BLOCKS; None = overcommit off,
     # bit-identical to the plain paged pool) turns pool exhaustion into
@@ -469,6 +484,7 @@ def batched_decode_step(
     ffn_fn=None,
     unroll: bool = False,
     mesh=None,
+    paged_attn=None,
 ) -> tuple[jax.Array, dict[str, jax.Array]]:
     """One decode tick for the whole slot pool.
 
@@ -488,7 +504,9 @@ def batched_decode_step(
     the trunk so page gathers stay chip-local on the head shard; the paged
     scatter below is head-sharded by propagation (blk_w/off index the
     replicated block/page axes, the written values carry the q/k/v column
-    shard).
+    shard). ``paged_attn`` picks the paged READ route (fused table-walking
+    kernel vs gather — see spec_verify_loop); the scatter here is
+    route-oblivious.
     """
     b = tokens.shape[0]
     lens = cache["len"]
@@ -554,7 +572,7 @@ def batched_decode_step(
 
     logits, new_kv = decode_layer_loop(
         params, cfg, cache, tokens, kv_bucket, write_kv, ffn_fn=ffn_fn,
-        unroll=unroll, mesh=mesh,
+        unroll=unroll, mesh=mesh, paged_attn=paged_attn,
     )
     return logits, {**new_kv, "len": jnp.where(active, lens + 1, lens)}
 
@@ -570,6 +588,7 @@ def batched_spec_step(
     ffn_fn=None,
     unroll: bool = False,
     mesh=None,
+    paged_attn=None,
 ) -> tuple[jax.Array, jax.Array, dict[str, jax.Array]]:
     """One speculative tick for the slot pool: verify a [B, T] draft chunk
     (column 0 is each slot's pending next token, columns 1..T-1 the
@@ -588,6 +607,17 @@ def batched_spec_step(
     Greedy only: acceptance compares argmax — a custom sampler would make
     the emitted stream diverge from its own non-speculative distribution,
     so the engine disables speculation when one is configured.
+
+    ``paged_attn`` makes draft/verify TABLE-AWARE on the pool: under the
+    kernel route the verify chunk's ragged window reads walk the page table
+    in place (one fused kernel per layer, T = K+1 queries amortizing the
+    window bytes) instead of materializing a gathered dense window first.
+    A forced override applies to spec ticks exactly as to decode ticks;
+    AUTO routes verify chunks (T > 1) to gather — every measured T=4 cell
+    in the routing basis lost (DECODE_ATTN_r05.json: 0.28-0.59x; XLA
+    amortizes the window across the chunk's queries better) — so the
+    adaptive-speculation economics never regress under auto and the kernel
+    still proves token-equality on spec ticks whenever forced.
     """
     b, t = draft.shape
     lens = cache["len"]
@@ -635,7 +665,7 @@ def batched_spec_step(
 
     logits, new_kv = spec_verify_loop(
         params, cfg, cache, draft, kv_bucket, write_kv, ffn_fn=ffn_fn,
-        unroll=unroll, mesh=mesh,
+        unroll=unroll, mesh=mesh, paged_attn=paged_attn,
     )
     pred = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, T]
     match = (draft[:, 1:] == pred[:, :-1]).astype(jnp.int32)
@@ -699,6 +729,12 @@ def chunked_prefill_into_slot(
     ``mesh`` (paged pools under tensor parallelism): the gathered window
     view and the page scatter-back are pinned to the pool's head shard —
     the per-chunk pool traffic stays chip-local exactly like decode's.
+
+    The paged decode KERNEL route deliberately does not apply here: a chunk
+    needs the materialized dense window regardless (the whole window
+    scatters back to the pool after the trunk), so gathering it first costs
+    nothing extra — the kernel's payoff is exclusive to the decode/verify
+    ticks, where the gather was pure read-side overhead.
     """
     c = chunk.shape[1]
     bucket = kv_bucket or cfg.max_seq
@@ -974,7 +1010,8 @@ class ServingEngine:
                     cfg, kv_int8=choose_kv_int8(serving.slots, cfg.max_seq))
             model = TransformerSlotModel(
                 params, cfg, mesh=mesh, kv_page=serving.kv_page,
-                kv_pool_blocks=serving.kv_pool_blocks)
+                kv_pool_blocks=serving.kv_pool_blocks,
+                paged_attn=serving.paged_attn)
         self.model = model
         self.params = model.params
         self.cfg = getattr(model, "cfg", cfg)
@@ -1005,6 +1042,19 @@ class ServingEngine:
                 f"model adapter was built with kv_page={self._page}; pass "
                 "kv_page/kv_pool_blocks to the adapter (or just params+cfg)")
         self._paged = self._page is not None
+        # paged decode-attention route (kernel vs gather), resolved per
+        # dispatched window shape by ops.decode_attn.paged_attn_route; the
+        # adapter is the single source of truth exactly like kv_page (the
+        # trunk closes over its attribute at trace time, so the engine's
+        # per-tick route counters must read the same value)
+        self._paged_attn = getattr(model, "paged_attn", None)
+        if (serving.paged_attn is not None
+                and self._paged_attn != serving.paged_attn):
+            raise ValueError(
+                f"ServingConfig.paged_attn={serving.paged_attn!r} but the "
+                f"provided model adapter was built with "
+                f"paged_attn={self._paged_attn!r}; pass paged_attn to the "
+                "adapter (or just params+cfg)")
         self.state = model.init_state(b)
         # Device-side sampling is the default: the sampler is fused into the
         # jitted decode step (adapters.sampled_decode_step), so a tick's
@@ -1410,6 +1460,16 @@ class ServingEngine:
                        # the null block, so live/window is the fraction
                        # of the window streaming distinct HBM lines.
                        "kv_bucket_hist": {},
+                       # paged decode-attention routing: ticks dispatched
+                       # through the fused table-walking kernel vs the
+                       # gather-then-dense chain. The route is a static
+                       # per-window-shape property (paged_attn_route), so
+                       # these mirror exactly what the compiled executables
+                       # did — the bench's kernel-vs-gather arms gate on
+                       # them, and auto routing off-TPU must keep
+                       # kernel_ticks at 0 (interpreted pallas never wins).
+                       "paged_attn_kernel_ticks": 0,
+                       "paged_attn_gather_ticks": 0,
                        "pool_blocked_admissions": 0,
                        "prefix_install_copies": 0,
                        "prefix_blocks_shared": 0,
@@ -2916,7 +2976,8 @@ class ServingEngine:
             ms if self._admission_ms_ema is None
             else 0.9 * self._admission_ms_ema + 0.1 * ms)
 
-    def _note_kv_window(self, kv_bucket: int, lens: list[int]) -> None:
+    def _note_kv_window(self, kv_bucket: int, lens: list[int],
+                        t: int = 1) -> None:
         """Per-dispatch read-window telemetry. kv_bucket_hist surfaces the
         global read tax: every dispatched tick's window, set by the LONGEST
         live sequence — on the dense path that window is streamed verbatim
@@ -2935,6 +2996,14 @@ class ServingEngine:
             self._stats["read_pages_window"] += (key // page) * len(lens)
             rh = self._stats["read_pages_hist"]
             rh[live] = rh.get(live, 0) + 1
+            # kernel-vs-gather route accounting: the trunk resolves the
+            # route statically from the same (override, window, chunk
+            # width, quantization) inputs, so this host-side count IS what
+            # the dispatched executable did
+            route = paged_attn_route(
+                self._paged_attn, key, t=t, quant="k_scale" in self.state)
+            self._stats["paged_attn_kernel_ticks" if route == "kernel"
+                        else "paged_attn_gather_ticks"] += 1
 
     def _note_itl(self, slot: int, now: float) -> None:
         """Record one inter-token gap for *slot* into the trace substrate
@@ -3794,7 +3863,8 @@ class ServingEngine:
                 kv_bucket = 0
             self._note_kv_window(
                 kv_bucket,
-                [self._slot_len[i] + chunk - 1 for i in active_slots])
+                [self._slot_len[i] + chunk - 1 for i in active_slots],
+                t=chunk)
             if drafts is not None:
                 draft = jnp.asarray(
                     [
