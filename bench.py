@@ -283,6 +283,12 @@ def tenant_main(a: argparse.Namespace) -> None:
                 # inter-token-latency percentiles
                 "admission_stall_ms", "prefill_batch_hist",
                 "admission_syncs", "batched_admission",
+                # multi-tick device loop: the configured k, flush/early-
+                # exit counters, and the per-token amortization of the
+                # fetch + host-bookkeeping contracts (1/k and EMA/k with
+                # the loop on; identical to the per-tick figures when off)
+                "decode_loop_k", "loop_flushes", "loop_early_exits",
+                "device_gets_per_token", "host_ms_per_token",
                 # span telemetry is re-derived from the trace substrate
                 # (vtpu/obs): the ITL reservoir is a view over the trace,
                 # and TTFT/queue-wait percentiles come from the same

@@ -18,6 +18,22 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compilation cache, shared by every test in the tier AND
+# primed for the next run on the same checkout. The suite is dominated by
+# engine-executable compiles (a ServingEngine build measured 7.3s cold vs
+# 2.5s warm on the 2-core CI rig), and tier-1 runs under a hard wall-clock
+# budget on shared, throttle-prone runners — caching identical compiles is
+# the difference between fitting that budget and flaking on box weather.
+# Keyed by exact HLO + flags, so nothing about what is tested changes.
+# test_bench_smoke threads the same dir into its bench subprocesses.
+_cache_dir = os.path.join(os.path.dirname(os.path.dirname(__file__)),
+                          ".jax_cache")
+try:
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+except Exception:  # older jax without the persistent-cache knobs
+    pass
+
 import shutil  # noqa: E402
 import subprocess  # noqa: E402
 from pathlib import Path  # noqa: E402

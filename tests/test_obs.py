@@ -162,7 +162,12 @@ def test_lifecycle_round_trips_through_trace(params):
     swap-in and drop + recompute-on-fault), the derived spans carry the
     parked/resume attribution, and the Chrome dump is valid
     ``trace_event`` JSON."""
-    page, lc_prompt, lc_new = 8, 8, 24
+    # lc_new fills the context: the park below must land while the stream
+    # is still running (a finished request makes park a documented no-op),
+    # so the window between reading two tokens and the park settling has
+    # to cover many remaining ticks — warm-compile engines made the old
+    # 24-token budget a losable race on fast boxes
+    page, lc_prompt, lc_new = 8, 8, 48
     pages_per = -(-(lc_prompt + lc_new) // page)
     eng = ServingEngine(params, CFG, ServingConfig(
         slots=2, prefill_buckets=(16,), max_new_tokens=lc_new,
@@ -239,6 +244,66 @@ def test_lifecycle_round_trips_through_trace(params):
     slices = [e for e in tev if e["ph"] == "X"]
     assert {"queued", "streaming", "parked"} <= {e["name"] for e in slices}
     assert all(e["dur"] >= 0 and e["ts"] >= 0 for e in slices)
+
+
+def test_device_loop_flush_trace_semantics(params):
+    """Trace fidelity at decode_loop_k > 1 (ISSUE 11 satellite): per-token
+    events inside a device flush share ONE host observation, so the engine
+    records a ``loop_flush`` event carrying k per delivery and emits the k
+    token events with interpolated-but-flagged timestamps (val=1). The
+    pinned semantics: every flush-delivered token event is flagged, stamps
+    are non-decreasing per request (the interpolation floors at the
+    previous delivery), and derived ITL spans stay well-defined — no
+    negative gaps, one span sample per decoded token."""
+    k, steps = 4, 10
+    eng = ServingEngine(params, CFG, ServingConfig(
+        slots=2, prefill_buckets=(8,), max_new_tokens=steps,
+        decode_loop_k=k))
+    eng.start()
+    try:
+        r = eng.submit(_prompt(77, 5), max_new_tokens=steps)
+        assert len(list(r.stream())) == steps
+        events = eng.trace.events()
+        spans = eng.trace.spans()
+        stats = eng.stats()
+    finally:
+        eng.stop()
+    flushes = [e for e in events if e["event"] == "loop_flush"]
+    assert flushes and all(e["val"] == k for e in flushes)
+    assert stats["loop_flushes"] == len(flushes)
+    toks = [e for e in events if e["event"] == "token" and e["rid"] == r.rid]
+    assert len(toks) == steps - 1  # first_token is its own (observed) event
+    assert all(e["val"] == 1 for e in toks), "flush tokens must be flagged"
+    ts = [e["ts_ns"] for e in toks]
+    assert ts == sorted(ts), "interpolated stamps must stay monotonic"
+    s = spans[r.rid]
+    # 1 first_token + (steps-1) flush tokens -> steps-1 derived gaps
+    assert len(s["itl_ms"]) == steps - 1
+    assert all(gap >= 0 for gap in s["itl_ms"])
+    # the observed events around the flush window stay un-flagged
+    first = [e for e in events if e["event"] == "first_token"
+             and e["rid"] == r.rid]
+    assert first and first[0]["ts_ns"] <= ts[0]
+
+
+def test_tick_profiler_per_tick_attribution():
+    """The per-inner-tick attribution the device loop reports through
+    tick_phase_ms: a note covering k ticks amortizes its duration, so
+    mean_ms_per_tick == mean_ms / k while the histogram keeps the
+    observed per-pass durations (Prometheus buckets unchanged)."""
+    prof = TickProfiler()
+    prof.note("deliver", 0.004, ticks=4)
+    prof.note("deliver", 0.004, ticks=4)
+    snap = prof.snapshot()["deliver"]
+    assert snap["count"] == 2 and snap["ticks"] == 8
+    assert snap["mean_ms"] == pytest.approx(4.0)
+    assert snap["mean_ms_per_tick"] == pytest.approx(1.0)
+    # default ticks=1 keeps the two means equal (the classic loop)
+    prof2 = TickProfiler()
+    prof2.note("fetch", 0.002)
+    snap2 = prof2.snapshot()["fetch"]
+    assert snap2["ticks"] == snap2["count"] == 1
+    assert snap2["mean_ms_per_tick"] == snap2["mean_ms"]
 
 
 def test_trace_off_engine_still_reports_percentiles(params):

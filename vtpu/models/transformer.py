@@ -243,6 +243,84 @@ def sample_tokens(
     return tok, lp, split[:, 1]
 
 
+# Sentinel a multi-tick device loop pads frozen slots' token output with:
+# sampled ids are always >= 0 (argmax over the vocab), so -1 can never be a
+# real token — the host trusts the per-slot counts, the sentinel just keeps
+# the [B, k] matrix self-describing in dumps and tests.
+LOOP_PAD_TOKEN = -1
+
+
+def multi_tick_decode(
+    decode_fn,
+    sample_fn,
+    k: int,
+    eos_token: int,
+    logprobs: bool,
+    state,
+    tokens: jax.Array,
+    active: jax.Array,
+    keys: jax.Array,
+    cap: jax.Array,
+):
+    """Run ``k`` decode ticks inside ONE traced loop with on-device token
+    feedback: the sampled token of inner tick i feeds inner tick i+1
+    without ever visiting the host. This is the loop body the serving
+    engine's device-resident decode loop (``ServingConfig.decode_loop_k``)
+    compiles — the host tick tax (dispatch, fetch, deliver, bookkeeping)
+    is then paid once per k tokens instead of once per token.
+
+    ``decode_fn(state, tokens[B], active[B]) -> (logits[B, vocab], state)``
+    is one tick of the family trunk (the caller closes over params /
+    kv_bucket / unroll — dense, paged, int8 and MoE layouts all route
+    through the same shared trunk, so the loop body IS the existing step).
+    ``sample_fn(logits, keys) -> (tok, lp|None, keys)`` is the on-device
+    sampler (sample_tokens with the config bound statically).
+
+    Per-slot EARLY EXIT: a slot freezes in place the inner tick after it
+    emits its cap'th token (``cap`` [B] int32 — its remaining budget,
+    clamped to k by the caller) or an ``eos_token`` — its active lane goes
+    False, so subsequent inner ticks mask its KV writes exactly like any
+    inactive slot (dense: where-masked; paged: the out-of-range drop
+    sentinel routes the write off every mapped block) and its cache length
+    stops advancing. Frozen output columns hold LOOP_PAD_TOKEN.
+
+    Under a paged pool the per-tick write address is derived ON DEVICE
+    from the advancing length (``table[b, len // page]`` / ``len % page``
+    — the PR-9 table-walk discipline), so the page-table row needs no host
+    round trip between inner ticks; the host-replicated length mirror
+    catches up at flush delivery.
+
+    Returns ``(out [B, k] int32, counts [B] int32, carry [B] int32,
+    lps [B, k] f32 | None, state, keys)``: ``out[b, :counts[b]]`` are the
+    tokens slot b emits this flush (sentinel-padded above), ``carry`` is
+    each slot's final sampled token — the device-resident feed for the
+    NEXT flush's dispatch.
+    """
+    b = tokens.shape[0]
+    out0 = jnp.full((b, k), LOOP_PAD_TOKEN, jnp.int32)
+    lp0 = jnp.zeros((b, k if logprobs else 0), jnp.float32)
+    bud0 = jnp.where(active, jnp.maximum(cap, 0), 0)
+
+    def body(i, carry):
+        state, tok, act, keys, bud, out, lps = carry
+        logits, state = decode_fn(state, tok, act)
+        nxt, lp, keys = sample_fn(logits, keys)
+        out = out.at[:, i].set(jnp.where(act, nxt, LOOP_PAD_TOKEN))
+        if logprobs:
+            lps = lps.at[:, i].set(jnp.where(act, lp, 0.0))
+        bud = bud - act.astype(jnp.int32)
+        # the emitted token becomes the slot's pending feed; after a
+        # freeze the lane is masked, so the stale value is unobservable
+        tok = jnp.where(act, nxt, tok)
+        act = act & (bud > 0) & (nxt != eos_token)
+        return (state, tok, act, keys, bud, out, lps)
+
+    state, tok, _, keys, bud, out, lps = jax.lax.fori_loop(
+        0, k, body, (state, tokens, active, keys, bud0, out0, lp0))
+    counts = bud0 - bud
+    return out, counts, tok, (lps if logprobs else None), state, keys
+
+
 def _qkv(cfg, lp, x, cos, sin, positions):
     """Project to rotated q/k/v heads: [B, S, H, Dh] each."""
     b, s, _ = x.shape

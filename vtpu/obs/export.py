@@ -49,6 +49,11 @@ COUNTERS = {
                         "Blocking per-admission host syncs (legacy path)"),
     "pipelined_ticks": ("pipelined_ticks",
                         "Ticks dispatched with one tick in flight"),
+    "loop_flushes": ("loop_flushes",
+                     "k-tick device-loop flush dispatches"),
+    "loop_early_exits": ("loop_early_exits",
+                         "Slots frozen inside a device-loop flush "
+                         "(budget wall or eos before tick k)"),
     "pool_blocked_admissions": ("pool_blocked_admissions",
                                 "Admissions deferred by pool exhaustion"),
     "prefix_install_copies": ("prefix_install_copies",
@@ -106,6 +111,15 @@ GAUGES = {
                                "Fetched bytes / ticks", 1),
     "host_ms_per_tick": ("host_seconds_per_tick",
                          "EMA host bookkeeping per delivered tick", 1e-3),
+    "decode_loop_k": ("decode_loop_k",
+                      "Inner decode ticks per compiled flush (1 = classic "
+                      "loop)", 1),
+    "device_gets_per_token": ("device_gets_per_token",
+                              "Tick fetches / inner decode ticks "
+                              "(contract: 1/decode_loop_k)", 1),
+    "host_ms_per_token": ("host_seconds_per_token",
+                          "EMA host bookkeeping amortized per token-step "
+                          "(host_ms_per_tick / decode_loop_k)", 1e-3),
     "admission_stall_ms": ("admission_stall_seconds",
                            "EMA host seconds per _tick_head pass", 1e-3),
     "itl_p50_ms": ("itl_p50_seconds",

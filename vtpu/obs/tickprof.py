@@ -53,7 +53,8 @@ class BoundedHistogram:
     a real histogram family, so counts must only ever grow — a reservoir
     would make ``rate()`` lie."""
 
-    __slots__ = ("edges_ms", "counts", "count", "total_ms", "max_ms")
+    __slots__ = ("edges_ms", "counts", "count", "total_ms", "max_ms",
+                 "ticks")
 
     def __init__(self, edges_ms: tuple = PHASE_BUCKETS_MS):
         self.edges_ms = tuple(edges_ms)
@@ -61,20 +62,33 @@ class BoundedHistogram:
         self.count = 0
         self.total_ms = 0.0
         self.max_ms = 0.0
+        # inner decode ticks the samples covered: with the multi-tick
+        # device loop one loop pass serves k ticks, so per-TOKEN
+        # attribution divides by ticks, not count (ticks == count when
+        # every note covers one tick — the classic loop)
+        self.ticks = 0
 
-    def note_ms(self, ms: float) -> None:
+    def note_ms(self, ms: float, ticks: int = 1) -> None:
         self.counts[bisect_left(self.edges_ms, ms)] += 1
         self.count += 1
         self.total_ms += ms
+        self.ticks += ticks
         if ms > self.max_ms:
             self.max_ms = ms
 
-    def note(self, seconds: float) -> None:
-        self.note_ms(seconds * 1e3)
+    def note(self, seconds: float, ticks: int = 1) -> None:
+        self.note_ms(seconds * 1e3, ticks=ticks)
 
     @property
     def mean_ms(self) -> float:
         return self.total_ms / self.count if self.count else 0.0
+
+    @property
+    def mean_ms_per_tick(self) -> float:
+        """Phase milliseconds amortized over the inner ticks the samples
+        covered — the device-loop headline: a k-tick flush pays each host
+        phase once, so its per-tick share is mean_ms / k."""
+        return self.total_ms / self.ticks if self.ticks else 0.0
 
     def snapshot(self) -> dict:
         return {
@@ -82,6 +96,8 @@ class BoundedHistogram:
             "total_ms": round(self.total_ms, 4),
             "mean_ms": round(self.mean_ms, 4),
             "max_ms": round(self.max_ms, 4),
+            "ticks": self.ticks,
+            "mean_ms_per_tick": round(self.mean_ms_per_tick, 4),
         }
 
     def prom_buckets(self) -> tuple[list[tuple[str, float]], float]:
@@ -104,8 +120,13 @@ class TickProfiler:
                  edges_ms: tuple = PHASE_BUCKETS_MS):
         self.phases = {p: BoundedHistogram(edges_ms) for p in phases}
 
-    def note(self, phase: str, seconds: float) -> None:
-        self.phases[phase].note(seconds)
+    def note(self, phase: str, seconds: float, ticks: int = 1) -> None:
+        """Record one phase sample. ``ticks`` is how many inner decode
+        ticks the sample amortizes over (k for a device-loop flush): the
+        histogram keeps the observed per-pass duration — Prometheus bucket
+        semantics unchanged — while mean_ms_per_tick carries the
+        per-inner-tick attribution."""
+        self.phases[phase].note(seconds, ticks=ticks)
 
     def snapshot(self) -> dict:
         """{phase: {count, total_ms, mean_ms, max_ms}} — the stats() view

@@ -54,7 +54,16 @@ EVENT_KINDS = (
                        # slot's table row (val: pages) — the one fused
                        # install write, still zero KV copies
     "first_token",     # first token delivered to the client
-    "token",           # one decode/spec token delivered
+    "token",           # one decode/spec token delivered. Device-loop
+                       # flushes (decode_loop_k > 1) record their k
+                       # per-token events with INTERPOLATED timestamps
+                       # (they share one host observation) and flag them
+                       # with val=1 — derived ITL spans stay well-defined,
+                       # consumers that need observed-only stamps filter
+                       # on the flag
+    "loop_flush",      # one k-tick device-loop delivery (val: k) — the
+                       # host-boundary marker the interpolated token
+                       # events between two flushes hang off
     "park",            # taken out of the decode batch (val: owned pages)
     "evict",           # private pages reclaimed from the pool (val: blocks)
     "swap_out",        # pages spilled to the host tier (val: bytes)
@@ -142,6 +151,18 @@ class RequestTrace:
         seq = next(self._ctr)
         self._buf[seq % self.capacity] = (
             seq, time.monotonic_ns(), event, rid, slot, val)
+
+    def record_at(self, ts_ns: int, event: str, rid: int, slot: int = -1,
+                  val: int = 0) -> None:
+        """record() with an explicit monotonic_ns timestamp. The device-
+        loop flush delivery synthesizes per-token stamps by interpolating
+        across the flush window (k tokens share ONE host observation);
+        callers flag synthesized events via ``val`` so span consumers can
+        tell observed from interpolated."""
+        if not self.enabled:
+            return
+        seq = next(self._ctr)
+        self._buf[seq % self.capacity] = (seq, ts_ns, event, rid, slot, val)
 
     def note_itl(self, gap_s: float) -> None:
         with self._lat_lock:

@@ -66,6 +66,46 @@ def sampled_decode_step(model: Any, temperature: float, top_k: int,
     return step
 
 
+def multi_tick_decode_step(model: Any, temperature: float, top_k: int,
+                           top_p: float, logprobs: bool, k: int,
+                           eos_token: int):
+    """Compose a slot model's decode_step with the on-device sampler into a
+    k-tick device-resident loop (models.transformer.multi_tick_decode) —
+    ONE jit-able flush:
+
+        (params, state, tokens[B], active[B], keys[B], cap[B], kv_bucket,
+         unroll) -> (out[B, k] int32, counts[B] int32, carry[B] int32,
+                     logprobs[B, k] f32 | None, state, keys)
+
+    The loop body is the UNCHANGED per-family decode step (the same trunk
+    every layout — dense, paged, int8, MoE — already routes through), so a
+    k-tick flush is token-equal to k single ticks by construction; the
+    engine jits this with the state and keys donated, and the returned
+    ``carry`` feeds the next flush's dispatch device-resident. ``cap`` is
+    each slot's remaining token budget clamped to k (the per-slot
+    early-exit wall); ``eos_token`` freezes a slot the tick after it
+    samples it. One flush replaces k dispatch/fetch/deliver round trips —
+    the host tick tax amortizes over k tokens."""
+    from vtpu.models.transformer import multi_tick_decode, sample_tokens
+
+    def step(params, state, tokens, active, keys, cap, kv_bucket,
+             unroll=False):
+        def decode(st, tok, act):
+            return model.decode_step(params, st, tok, act, kv_bucket,
+                                     unroll=unroll)
+
+        def sample(logits, keys):
+            return sample_tokens(
+                logits, keys, temperature=temperature, top_k=top_k,
+                top_p=top_p, return_logprobs=logprobs)
+
+        return multi_tick_decode(
+            decode, sample, k, eos_token, logprobs, state, tokens, active,
+            keys, cap)
+
+    return step
+
+
 def batched_admission_step(model: Any, temperature: float, top_k: int,
                            top_p: float):
     """Compose a slot model's batched prefill (prefill_into_slots) with the

@@ -232,6 +232,24 @@ class ServingConfig:
     # sampling + batched admission, no speculation. None = the
     # co-scheduled loop, bit-identical streams, zero new threads.
     disagg: Optional[Any] = None
+    # --- multi-tick device-resident decode loop --------------------------
+    # Run k decode ticks inside ONE compiled executable: the sampled token
+    # of inner tick i feeds the dispatch of tick i+1 on device, per-slot
+    # early-exit masks freeze a slot that hits its budget or eos inside the
+    # loop (writes masked, output padded with a sentinel), paged scatters
+    # keep walking the table with device-side t//page / t%page arithmetic,
+    # and the host performs ONE batched [B, k] fetch + deliver per k ticks.
+    # Admission, park/evict/swap drains, disagg handoff installs and
+    # repartitioning all move to flush boundaries — the lifecycle machinery
+    # is untouched, it just runs 1/k as often. This targets the regime
+    # where the Python tick tax (tick_phase_ms), not FLOPs, caps tokens/sec
+    # at high slot counts. None (default) and 1 are bit-identical to the
+    # classic one-tick loop. Requires device sampling (a custom sample=
+    # callable needs host logits every tick) and no active speculation (the
+    # verify step builds drafts from host history every tick) — an
+    # unsatisfiable k > 1 raises at construction, like pipeline_decode.
+    # Composes with paged pools, int8 KV, tp meshes, and disagg.
+    decode_loop_k: Optional[int] = None
 
 
 def choose_kv_int8(slots: int, max_window: int) -> bool:
@@ -1120,6 +1138,53 @@ class ServingEngine:
             pipeline = True
         self._pipeline = bool(
             pipeline and self._device_sampling and not self._spec_tokens)
+        # --- multi-tick device-resident decode loop (decode_loop_k) ------
+        # Validated HERE, next to the paged_attn/pipeline contradiction
+        # checks: every rejection names the interaction precisely. k is
+        # compatible with paged pools, int8 KV, tp meshes and disagg (the
+        # loop body is the unchanged shared trunk); it is rejected for the
+        # two features that structurally need host logits every tick.
+        loop_k = serving.decode_loop_k
+        if loop_k is not None and loop_k < 1:
+            raise ValueError(
+                f"decode_loop_k must be >= 1 (or None), got {loop_k}")
+        if loop_k is not None and loop_k > 1:
+            if not self._device_sampling:
+                raise ValueError(
+                    f"decode_loop_k={loop_k} requires device sampling: a "
+                    "custom sample= callable consumes host logits every "
+                    "tick, which is exactly the per-token host round trip "
+                    "the device loop removes — drop sample= or set "
+                    "decode_loop_k=None")
+            if self._spec_tokens:
+                raise ValueError(
+                    f"decode_loop_k={loop_k} is incompatible with active "
+                    f"speculation (spec_tokens={serving.spec_tokens}): the "
+                    "verify step builds its draft from host-side token "
+                    "history every tick — disable spec_tokens or the "
+                    "device loop")
+        # k = 1 resolves to the classic loop (bit-identical to None by
+        # construction, pinned in tests); stats() still reports the
+        # resolved decode_loop_k so dashboards see what was asked for
+        self._loop_k = loop_k if loop_k is not None and loop_k > 1 else None
+        if self._loop_k:
+            from vtpu.serving.adapters import multi_tick_decode_step
+
+            self._decode_loop = jax.jit(
+                multi_tick_decode_step(
+                    model, serving.temperature, serving.top_k,
+                    serving.top_p, serving.logprobs, self._loop_k,
+                    serving.eos_token),
+                static_argnames=("kv_bucket", "unroll"),
+                donate_argnums=(1, 4),  # state + per-slot PRNG keys
+            )
+        else:
+            self._decode_loop = None
+        # monotonic_ns stamp of the last flush delivery: the floor of the
+        # next flush's interpolated per-token timestamps, so a pipelined
+        # flush (dispatched before the previous delivery) can never
+        # synthesize token events earlier than tokens already delivered
+        self._last_flush_ns = 0
         self._spec = jax.jit(
             model.spec_step, static_argnames=("kv_bucket", "unroll"),
             donate_argnums=(1,),
@@ -1443,6 +1508,12 @@ class ServingEngine:
                        "prefill_batch_hist": [0] * (max(
                            self._admit_sizes) + 1),
                        "pipelined_ticks": 0,
+                       # multi-tick device loop: loop_flushes counts k-tick
+                       # dispatches (decode_ticks counts INNER ticks, k per
+                       # flush, so FLOP/byte accounting stays per-tick
+                       # honest); loop_early_exits counts slots that froze
+                       # inside a flush (budget wall or eos) before tick k
+                       "loop_flushes": 0, "loop_early_exits": 0,
                        # KV-memory data plane. kv_bucket_hist: read-window
                        # bucket -> dispatched ticks — on the DENSE path
                        # this is the global longest-live-sequence read tax
@@ -2940,15 +3011,18 @@ class ServingEngine:
         self._admit_key, sub = jax.random.split(self._admit_key)
         return int(self._sample1(logits, sub))
 
-    def _fetch(self, arrays, kind: str = "tick"):
+    def _fetch(self, arrays, kind: str = "tick", ticks: int = 1):
         """The loop's ONLY device->host read: one batched device_get per
         call, counted with its payload bytes so stats() can prove the
         per-tick transfer contract (device_gets_per_tick == 1.0, and
         bytes_fetched_per_tick == B*4 on the device-sampled path vs
-        B*vocab*4 on the host-sampler fallback). kind="tick" is a tick
-        delivery (admission first tokens piggyback on it for free);
-        kind="admission" is the standalone batched first-token fetch an
-        idle engine performs so TTFT never waits for a decode tick."""
+        B*vocab*4 on the host-sampler fallback; with the k-tick device
+        loop ONE fetch covers k inner ticks — device_gets_per_token ==
+        1/k). kind="tick" is a tick delivery (admission first tokens
+        piggyback on it for free); kind="admission" is the standalone
+        batched first-token fetch an idle engine performs so TTFT never
+        waits for a decode tick. ``ticks`` attributes the fetch phase over
+        the inner ticks the fetched flush carried."""
         self._stats["device_gets"] += 1
         self._stats["tick_fetches" if kind == "tick"
                      else "admission_fetches"] += 1
@@ -2961,7 +3035,7 @@ class ServingEngine:
         # is the time the host blocks for the in-flight tick to finish —
         # the device-bound share of the tick, attributed separately from
         # the Python bookkeeping phases
-        self._prof.note("fetch", time.perf_counter() - t0)
+        self._prof.note("fetch", time.perf_counter() - t0, ticks=ticks)
         return out
 
     def _note_host_ms(self, seconds: float) -> None:
@@ -2977,7 +3051,7 @@ class ServingEngine:
             else 0.9 * self._admission_ms_ema + 0.1 * ms)
 
     def _note_kv_window(self, kv_bucket: int, lens: list[int],
-                        t: int = 1) -> None:
+                        t: int = 1, ticks: int = 1) -> None:
         """Per-dispatch read-window telemetry. kv_bucket_hist surfaces the
         global read tax: every dispatched tick's window, set by the LONGEST
         live sequence — on the dense path that window is streamed verbatim
@@ -2985,17 +3059,22 @@ class ServingEngine:
         length THIS tick will read up to (exclusive of the +1 applied
         here); under paging the live-page counters quantify how much of
         the window each slot actually maps (the rest dedupes onto the null
-        block instead of streaming distinct lines)."""
+        block instead of streaming distinct lines). ``ticks`` (> 1 for a
+        k-tick device-loop flush) scales every per-tick counter so the
+        window/route accounting stays denominated in INNER ticks; the
+        live-page figures use the dispatch-time lengths for all k (a
+        bounded undercount of at most one page per slot per flush — the
+        loop advances lengths on device, invisible between flushes)."""
         hist = self._stats["kv_bucket_hist"]
         key = int(kv_bucket) or int(self.model.max_context or 0)
-        hist[key] = hist.get(key, 0) + 1
+        hist[key] = hist.get(key, 0) + ticks
         if self._paged and lens:
             page = self._page
             live = sum(-(-(ln + 1) // page) for ln in lens)
-            self._stats["read_pages_live"] += live
-            self._stats["read_pages_window"] += (key // page) * len(lens)
+            self._stats["read_pages_live"] += live * ticks
+            self._stats["read_pages_window"] += (key // page) * len(lens) * ticks
             rh = self._stats["read_pages_hist"]
-            rh[live] = rh.get(live, 0) + 1
+            rh[live] = rh.get(live, 0) + ticks
             # kernel-vs-gather route accounting: the trunk resolves the
             # route statically from the same (override, window, chunk
             # width, quantization) inputs, so this host-side count IS what
@@ -3003,7 +3082,7 @@ class ServingEngine:
             route = paged_attn_route(
                 self._paged_attn, key, t=t, quant="k_scale" in self.state)
             self._stats["paged_attn_kernel_ticks" if route == "kernel"
-                        else "paged_attn_gather_ticks"] += 1
+                        else "paged_attn_gather_ticks"] += ticks
 
     def _note_itl(self, slot: int, now: float) -> None:
         """Record one inter-token gap for *slot* into the trace substrate
@@ -3217,6 +3296,20 @@ class ServingEngine:
         s["host_ms_per_tick"] = (
             round(self._host_ms_ema, 4)
             if self._host_ms_ema is not None else None)
+        # multi-tick device loop: decode_ticks counts INNER ticks (k per
+        # flush), so the transfer ratio above generalizes on its own —
+        # device_gets_per_token is the explicit per-token reading of the
+        # same contract (1.0 with the loop off, 1/k with a k-tick loop),
+        # and host_ms_per_token amortizes the per-DELIVERY host EMA over
+        # the k tokens each delivery now carries per slot. These are the
+        # headline numbers decode_bench --loop-k sweeps.
+        k_eff = self._loop_k or 1
+        s["decode_loop_k"] = k_eff
+        s["device_gets_per_token"] = (
+            round(s["tick_fetches"] / ticks, 4) if ticks else None)
+        s["host_ms_per_token"] = (
+            round(self._host_ms_ema / k_eff, 4)
+            if self._host_ms_ema is not None else None)
         # admission data plane: host ms spent in _tick_head (EMA — the
         # stall batched-async admission takes off the decode loop) and the
         # engine's own inter-token-latency percentiles as its streams
@@ -3391,7 +3484,16 @@ class ServingEngine:
         tokens = jnp.zeros((b,), jnp.int32)
         inactive = jnp.zeros((b,), bool)
         for bucket in (self._kv_buckets if self._use_kv_buckets else (0,)):
-            if self._device_sampling:
+            if self._loop_k:
+                # the k-tick flush executable replaces the single-tick
+                # sampled step as the loop's only decode dispatch; warm it
+                # per read bucket (all-inactive, zero caps: k masked ticks
+                # advance nothing)
+                _, _, _, _, self.state, self._rng = self._decode_loop(
+                    self.params, self.state, tokens, inactive, self._rng,
+                    jnp.zeros((b,), jnp.int32), bucket, unroll=self._unroll,
+                )
+            elif self._device_sampling:
                 _, _, self.state, self._rng = self._decode_sampled(
                     self.params, self.state, tokens, inactive, self._rng,
                     bucket, unroll=self._unroll,
@@ -3506,7 +3608,9 @@ class ServingEngine:
             self._warm_executables()
             if self._disagg is not None:
                 self._disagg.started.set()
-            if self._pipeline:
+            if self._loop_k:
+                self._loop_device()
+            elif self._pipeline:
                 self._loop_pipelined()
             else:
                 self._loop_sync()
@@ -3553,7 +3657,7 @@ class ServingEngine:
             t_sw = time.perf_counter()
             self._drain_swap_outs()
             swap_s = time.perf_counter() - t_sw
-            self._prof.note("swap_drain", swap_s)
+            self._prof.note("swap_drain", swap_s, ticks=self._loop_k or 1)
         if self._disagg is not None and self._swap_enabled:
             # reclaim assist: a prefill worker's allocator miss posts the
             # needed block count — eviction of parked pages runs HERE, on
@@ -3596,8 +3700,12 @@ class ServingEngine:
         self._note_admission_ms(time.perf_counter() - t0)
         # phase attribution: the admission head minus the swap drain
         # (profiled on its own above) — where a TTFT outlier's host share
-        # of the tick actually went
-        self._prof.note("admission", time.perf_counter() - t0 - swap_s)
+        # of the tick actually went. Under the k-tick device loop this
+        # head runs once per FLUSH, so its cost amortizes over k inner
+        # ticks — exactly the per-token attribution the loop exists to
+        # shrink (tick_phase_ms mean_ms_per_tick).
+        self._prof.note("admission", time.perf_counter() - t0 - swap_s,
+                        ticks=self._loop_k or 1)
         return admitted
 
     def _idle_wait(self, admitted: bool) -> None:
@@ -3785,6 +3893,278 @@ class ServingEngine:
             # client loses nothing the sync loop would have given it (and
             # the device_gets == decode_ticks contract survives shutdown)
             self._deliver(inflight)
+
+    def _loop_device(self) -> None:
+        """Multi-tick device-resident decode loop (decode_loop_k = k > 1):
+        every dispatch is a k-tick FLUSH — one compiled executable runs k
+        decode ticks with on-device token feedback (inner tick i's sampled
+        token feeds tick i+1 without visiting the host), per-slot
+        early-exit masks (budget wall / eos freeze a slot in place, its
+        writes masked like any inactive lane), and paged scatters walking
+        the table with device-side t//page arithmetic. The host performs
+        ONE batched [B, k] fetch + deliver per flush, and ALL lifecycle
+        machinery — admission, park/evict/swap drains, disagg handoff
+        installs, repartitioning — runs at flush boundaries only (the same
+        _tick_head, 1/k as often).
+
+        Pipelining is flush-deep, the PR-1 discipline generalized:
+
+            dispatch flush t   -> device starts k ticks immediately
+            deliver flush t-1  -> ONE batched device_get, then Python
+                                  bookkeeping for k tokens per slot runs
+                                  WHILE the device works on t
+
+        Flush t's token inputs are flush t-1's final sampled tokens
+        (``carry``), still device-resident. The host runs one FLUSH
+        behind, so the lookahead rules generalize k-deep:
+
+        - budget exhaustion is PREDICTED at dispatch: each slot's cap is
+          its remaining budget minus the in-flight flush's predicted
+          emissions, and a slot whose cap hits zero is excluded (it will
+          retire at delivery) — the device length never runs past the
+          budget wall, so paged reservations are never exceeded;
+        - eos is not predictable: an eos inside flush t freezes the slot
+          ON DEVICE for the rest of t (early exit — no wasted inner
+          ticks), wastes at most one slot-flush of device work at t+1,
+          and _deliver_flush's request-identity check drops the orphaned
+          column (retire/admit invalidate ONE slot's k-deep lookahead,
+          never the flush);
+        - a park request defers to the next flush boundary: the slot is
+          excluded from the new dispatch, its in-flight tokens land at
+          delivery, and the settled slot parks with host/device lengths
+          reconciled.
+
+        pipeline_decode=False degenerates to a synchronous flush loop
+        (dispatch, deliver, repeat — still one fetch per k ticks)."""
+        b = self.serving.slots
+        k = self._loop_k
+        inflight: Optional[dict] = None
+        active = None
+        active_key: Optional[tuple] = None
+        locking = self._disagg is not None
+        while not self._stop.is_set():
+            if locking:
+                self._state_mu.acquire()
+            locked = locking
+            try:
+                admitted = self._tick_head()
+                firsts = self._pending_firsts
+                self._pending_firsts = []
+                t_disp = time.perf_counter()
+                fed = [
+                    inflight is not None
+                    and inflight["reqs"][i] is not None
+                    and inflight["reqs"][i] is self._slot_req[i]
+                    for i in range(b)
+                ]
+                # budget remaining after the in-flight flush's PREDICTED
+                # emissions (exact unless the slot eos'd mid-flight — and
+                # an eos'd slot retires at delivery, so over-subtraction
+                # only ever excludes a slot that is leaving anyway)
+                rem = [
+                    self._slot_budget[i]
+                    - (inflight["pred"][i] if fed[i] else 0)
+                    for i in range(b)
+                ]
+                dispatch = [
+                    i for i in range(b)
+                    if self._slot_req[i] is not None
+                    and self._slot_req[i] not in self._want_park
+                    and rem[i] > 0
+                ]
+                new_inflight = None
+                disp_s = 0.0
+                if dispatch:
+                    live = set(dispatch)
+                    if inflight is not None and all(fed[i] for i in dispatch):
+                        # steady state: feed the in-flight flush's final
+                        # tokens straight back — no host upload, no merge
+                        tokens = inflight["carry"]
+                    elif inflight is None:
+                        tokens = jnp.asarray(self._tokens, jnp.int32)
+                    else:
+                        tokens = self._merge_tokens(
+                            jnp.asarray(fed, bool), inflight["carry"],
+                            jnp.asarray(self._tokens, jnp.int32))
+                    over = [i for i in dispatch if self._admit_mask[i]]
+                    if over:
+                        # freshly admitted slots: first tokens still
+                        # device-resident in _admit_buf (see _loop_pipelined)
+                        tokens = self._merge_tokens(
+                            jnp.asarray([i in over for i in range(b)], bool),
+                            self._admit_buf, tokens)
+                        for i in over:
+                            self._admit_mask[i] = False
+                    if active_key != tuple(dispatch):
+                        active = jnp.asarray(
+                            [i in live for i in range(b)], bool)
+                        active_key = tuple(dispatch)
+                    # per-slot early-exit caps: remaining budget clamped to
+                    # k — the device freezes the slot after its cap'th
+                    # emission, so a flush can never overdraw a budget (or
+                    # the paged reservation denominated in it)
+                    pred = [min(rem[i], k) if i in live else 0
+                            for i in range(b)]
+                    cap = jnp.asarray(pred, jnp.int32)
+                    if self._use_kv_buckets:
+                        # the read window must cover the DEVICE length at
+                        # the END of this flush: host mirror + in-flight
+                        # predicted emissions + k more
+                        need = k + max(
+                            self._slot_len[i]
+                            + (inflight["pred"][i] if fed[i] else 0)
+                            for i in dispatch)
+                        kv_bucket = next(
+                            (bkt for bkt in self._kv_buckets if bkt >= need),
+                            self.model.max_context,
+                        )
+                    else:
+                        kv_bucket = 0
+                    self._note_kv_window(
+                        kv_bucket,
+                        [self._slot_len[i]
+                         + (inflight["pred"][i] if fed[i] else 0)
+                         for i in dispatch],
+                        ticks=k)
+                    out_d, cnt_d, carry_d, lp_d, self.state, self._rng = \
+                        self._decode_loop(
+                            self.params, self.state, tokens, active,
+                            self._rng, cap, kv_bucket, unroll=self._unroll)
+                    self._stats["decode_ticks"] += k
+                    self._stats["loop_flushes"] += 1
+                    if self._disagg is not None:
+                        # k decode ticks elapsed in one dispatch: the
+                        # controller's token bucket refills per inner tick
+                        # so the prefill partition is flush-rate-invariant
+                        for _ in range(k):
+                            self._disagg.on_tick()
+                    if inflight is not None:
+                        self._stats["pipelined_ticks"] += k
+                    new_inflight = {
+                        "tokens": out_d, "counts": cnt_d, "carry": carry_d,
+                        "logprobs": lp_d, "pred": pred,
+                        "t_disp_ns": time.monotonic_ns(),
+                        "reqs": [self._slot_req[i] if i in live else None
+                                 for i in range(b)],
+                    }
+                    disp_s = time.perf_counter() - t_disp
+                    self._prof.note("dispatch", disp_s, ticks=k)
+            finally:
+                if locked:
+                    self._state_mu.release()
+            if not dispatch and inflight is None:
+                if firsts:
+                    self._deliver_firsts(firsts)
+                else:
+                    self._idle_wait(admitted)
+                continue
+            if not self._pipeline:
+                # synchronous flush loop (pipeline_decode=False): deliver
+                # the flush just dispatched before the next one — the host
+                # tax still amortizes over k, only the overlap is missing
+                if new_inflight is not None:
+                    self._deliver_flush(
+                        new_inflight, extra_host_s=disp_s, firsts=firsts)
+                elif firsts:
+                    self._deliver_firsts(firsts)
+                self._inflight_slots = set()
+                continue
+            if inflight is not None:
+                self._deliver_flush(inflight, extra_host_s=disp_s,
+                                    firsts=firsts)
+            elif firsts:
+                # no flush in flight to piggyback on (the engine was idle):
+                # one standalone batched fetch for the admission wave
+                self._deliver_firsts(firsts)
+            inflight = new_inflight
+            # what the NEXT _tick_head must treat as in flight: a park for
+            # one of these slots defers to the flush boundary
+            self._inflight_slots = (
+                {i for i in range(b) if inflight["reqs"][i] is not None}
+                if inflight is not None else set())
+        if inflight is not None:
+            # stop() landed between dispatch and delivery: the flush's
+            # tokens are already computed — deliver them (same contract as
+            # the one-tick pipelined loop's shutdown delivery)
+            self._deliver_flush(inflight)
+
+    def _deliver_flush(self, flush: dict, extra_host_s: float = 0.0,
+                       firsts: Optional[list] = None) -> None:
+        """Deliver one k-tick flush: ONE batched fetch for the [B, k]
+        token matrix + per-slot emitted counts (+ optional logprobs), then
+        the same budget/eos/retire bookkeeping as _deliver — amortized
+        over up to k tokens per slot. ``flush["reqs"]`` snapshots each
+        slot's Request at dispatch; the identity check drops a retired or
+        recycled slot's whole in-flight COLUMN (the PR-1 single-token
+        lookahead invalidation, k-deep). Host-replicated state reconciles
+        here: the length mirror advances by exactly the device's per-slot
+        count, so the page-table rows the host holds stay truthful at
+        every flush boundary.
+
+        Trace fidelity: the k per-token events share one host observation,
+        so they are recorded with timestamps INTERPOLATED across the flush
+        window (dispatch -> delivery, floored at the previous flush's
+        delivery) and flagged via val=1; a ``loop_flush`` event carrying k
+        marks each delivery. Derived ITL spans stay well-defined — the
+        user-visible reservoir records one inter-flush gap per slot, the
+        spec-tick convention for burst deliveries."""
+        k = self._loop_k
+        extra = tuple(f["tokens"] for f in firsts) if firsts else ()
+        if flush["logprobs"] is not None:
+            toks, counts, lps, *first_arrs = self._fetch(
+                (flush["tokens"], flush["counts"], flush["logprobs"])
+                + extra, ticks=k)
+        else:
+            toks, counts, *first_arrs = self._fetch(
+                (flush["tokens"], flush["counts"]) + extra, ticks=k)
+            lps = None
+        t0 = time.perf_counter()
+        if firsts:
+            self._deliver_firsts(firsts, fetched=first_arrs)
+        now = time.perf_counter()
+        now_ns = time.monotonic_ns()
+        # interpolation window: this flush's tokens were computed between
+        # its dispatch and this delivery, but a PIPELINED flush dispatches
+        # before the previous delivery — flooring at the previous
+        # delivery keeps synthesized stamps monotonic per slot
+        start_ns = max(flush["t_disp_ns"], self._last_flush_ns)
+        self.trace.record("loop_flush", -1, -1, k)
+        eos = self.serving.eos_token
+        for slot, req in enumerate(flush["reqs"]):
+            if req is None or req is not self._slot_req[slot]:
+                continue
+            cnt = int(counts[slot])
+            if cnt < k:
+                # froze inside the loop: budget wall (cap < k) or eos
+                self._stats["loop_early_exits"] += 1
+            if cnt == 0:
+                continue
+            emitted = [int(t) for t in toks[slot, :cnt]]
+            # host/device reconciliation: mirror the device's length
+            # advance BEFORE any retire below, exactly like the spec path
+            self._slot_len[slot] += cnt
+            self._slot_budget[slot] -= cnt
+            span = max(now_ns - start_ns, 0)
+            for j, tok in enumerate(emitted):
+                ts = start_ns + ((j + 1) * span) // cnt
+                self.trace.record_at(ts, "token", req.rid, slot, 1)
+                # logprob BEFORE the queue put (see _emit)
+                if lps is not None:
+                    req.logprobs.append(float(lps[slot, j]))
+                req.out.put(tok)
+            self._stats["generated_tokens"] += cnt
+            if self._track_history:
+                self._history[slot].extend(emitted)
+            self._tokens[slot] = emitted[-1]
+            # one ITL gap per (slot, flush): the burst reaches the client
+            # in one delivery, so the user-visible ITL is the inter-flush
+            # gap — the spec-tick convention
+            self._note_itl(slot, now)
+            if self._slot_budget[slot] <= 0 or emitted[-1] == eos:
+                self._retire(slot)
+        self._last_flush_ns = now_ns
+        self._prof.note("deliver", time.perf_counter() - t0, ticks=k)
+        self._note_host_ms(extra_host_s + time.perf_counter() - t0)
 
     def _loop_sync(self) -> None:
         """Synchronous tick loop: dispatch, deliver, repeat. Used when a
