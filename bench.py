@@ -327,7 +327,14 @@ def tenant_main(a: argparse.Namespace) -> None:
                 "swap_out_bytes", "swap_in_bytes",
                 "swap_faults", "fault_recomputes",
                 "pool_blocked_resumes",
-                "swap_host_blocks", "swap_host_free")},
+                "swap_host_blocks", "swap_host_free",
+                # failure domains: typed sheds (deadline / overload
+                # policy), contained per-request faults, prefill-worker
+                # restarts, watchdog degradation steps, and FaultPlan
+                # injections — the blast-radius audit per tenant
+                "shed_deadline", "shed_overload", "faulted_requests",
+                "worker_restarts", "watchdog_degrades",
+                "faults_injected")},
         }), flush=True)
     eng.stop()
     if os.environ.get("VTPU_BENCH_REGISTER") == "1":

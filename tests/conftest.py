@@ -62,3 +62,74 @@ def _clean_state():
     yield
     reset_registry()
     nodelock.reset_for_test()
+
+
+def _engine_leaks(eng) -> list:
+    """The resource invariants every STOPPED engine must satisfy: the
+    allocator free list accounts for every block not legitimately pinned
+    by a registered prefix, no slot holds a request or blocks, nothing is
+    parked or mid-swap, and the host swap pool is fully free. A violation
+    is a leak in whatever lifecycle path the test exercised."""
+    errs = []
+    if getattr(eng, "_alloc", None) is not None:
+        pinned = sum(len(e["blocks"]) for e in eng._prefixes.values())
+        free = eng._alloc.free_blocks
+        total = eng._n_blocks - 1
+        if free + pinned != total:
+            errs.append(
+                f"allocator leak: {free} free + {pinned} prefix-pinned "
+                f"!= {total} usable blocks")
+    occupied = [i for i, r in enumerate(eng._slot_req) if r is not None]
+    if occupied:
+        errs.append(f"slots still occupied after stop: {occupied}")
+    held = [i for i, b in enumerate(eng._slot_blocks) if b]
+    if held:
+        errs.append(f"slots still holding blocks after stop: {held}")
+    if eng._parked:
+        errs.append(f"{len(eng._parked)} sessions still parked after stop")
+    if eng._swap_pending:
+        errs.append(f"{len(eng._swap_pending)} swap-outs still pending")
+    if eng._swap_enabled and len(eng._host_free) != eng._swap_host_blocks:
+        errs.append(
+            f"host swap pool leak: {len(eng._host_free)} free of "
+            f"{eng._swap_host_blocks}")
+    if eng._admitting:
+        errs.append(f"admissions still in flight: {sorted(eng._admitting)}")
+    return errs
+
+
+@pytest.fixture(autouse=True)
+def leak_check(request):
+    """Failure-domain invariant net over EVERY engine-constructing test
+    (ISSUE 12 satellite): each ServingEngine built during the test is
+    stopped at teardown and checked for leaks — allocator free list, host
+    swap pool, slot occupancy, parked set. A recovery path (shed, fault
+    containment, worker restart, swap loss) that forgets to release what
+    a dead request held fails HERE, in whatever suite happened to drive
+    it, not only in the dedicated fault tests."""
+    try:
+        from vtpu.serving import engine as _engine_mod
+    except Exception:  # minimal environments without the serving deps
+        yield
+        return
+    built: list = []
+    orig_init = _engine_mod.ServingEngine.__init__
+
+    def tracking_init(self, *args, **kwargs):
+        orig_init(self, *args, **kwargs)
+        built.append(self)
+
+    _engine_mod.ServingEngine.__init__ = tracking_init
+    try:
+        yield
+    finally:
+        _engine_mod.ServingEngine.__init__ = orig_init
+    errs = []
+    for eng in built:
+        try:
+            eng.stop()  # idempotent; never-started engines drain inline
+        except Exception as exc:  # pragma: no cover - diagnostic only
+            errs.append(f"stop() raised: {exc!r}")
+            continue
+        errs.extend(_engine_leaks(eng))
+    assert not errs, "engine resource leaks at teardown: " + "; ".join(errs)

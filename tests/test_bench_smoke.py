@@ -76,6 +76,8 @@ QUICK_RUNS = {
                "--burst-steps", "8"],
     "obs": [str(ROOT / "benchmarks" / "obs_bench.py"), "--quick",
             "--slots", "2", "--max-new", "8", "--requests", "4"],
+    "chaos": [str(ROOT / "benchmarks" / "chaos_bench.py"), "--quick",
+              "--sessions", "2", "--max-new", "10"],
 }
 
 
@@ -87,6 +89,7 @@ QUICK_WAVES = (
     ("paged_kv_tp2", "overcommit", "decode"),
     ("disagg", "paged_kv", "obs"),
     ("paged_attn", "prefill", "decode_loop_k"),
+    ("chaos",),
 )
 
 # runs that force a multi-virtual-device platform stay OFF the shared
@@ -117,6 +120,7 @@ TEST_TO_RUN = {
     "test_prefill_bench_quick_two_slot_iteration": "prefill",
     "test_disagg_bench_quick_small_iteration": "disagg",
     "test_obs_bench_quick_small_iteration": "obs",
+    "test_chaos_bench_quick_small_iteration": "chaos",
 }
 
 
@@ -429,3 +433,44 @@ def test_obs_bench_quick_small_iteration(quick):
     assert on["trace_events_recorded"] > 0
     assert summary["summary"] and summary["verdict"] == "pass"
     assert summary["added_host_syncs"] == 0
+
+
+def test_chaos_bench_help_parses():
+    r = _run([str(ROOT / "benchmarks" / "chaos_bench.py"), "--help"])
+    assert r.returncode == 0, r.stderr
+    assert "--quick" in r.stdout and "--seed" in r.stdout
+
+
+def test_chaos_bench_quick_small_iteration(quick):
+    """chaos_bench --quick at smoke scale: the seeded fault schedule
+    fires across the pool/swap/dispatch/worker/fetch seams and EVERY
+    deterministic gate holds — typed terminals on all requests,
+    unaffected streams token-equal to the fault-free reference, zero
+    leaks after the soak (allocator free count, host swap pool, slot
+    occupancy back to initial), the tick transfer contract intact on
+    every scenario (no recovery path adds a host sync), and each
+    configured seam actually injected. These ARE the acceptance gates
+    (all deterministic), so unlike the perf benches nothing here is
+    full-run-only."""
+    r = quick["chaos"]
+    assert r.returncode == 0, r.stderr
+    lines = [ln for ln in r.stdout.splitlines() if ln.strip()]
+    artifact = json.loads(lines[0])
+    summary = json.loads(lines[-1])
+    assert artifact["metric"] == "chaos_soak_deterministic_gates"
+    assert artifact["pass"] is True
+    scenarios = {s["name"]: s for s in artifact["scenarios"]}
+    assert set(scenarios) == {"core", "disagg", "device_loop"}
+    for sc in scenarios.values():
+        assert sc["pass"], sc
+        assert all(sc["gates"].values()), sc["gates"]
+    core = scenarios["core"]
+    assert core["terminals"].get("SHED_DEADLINE", 0) >= 1
+    assert core["terminals"].get("SHED_OVERLOAD", 0) >= 1
+    assert core["stats"]["fault_recomputes"] >= 1
+    assert core["stats"]["device_gets_per_tick"] == 1.0
+    assert scenarios["disagg"]["stats"]["worker_restarts"] == 1
+    assert scenarios["disagg"]["stats"]["handoff_copies"] == 0
+    assert scenarios["device_loop"]["stats"]["watchdog_degrades"] >= 1
+    assert artifact["faults_injected_total"] >= 4
+    assert summary["summary"] and summary["verdict"] == "pass"

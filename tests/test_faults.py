@@ -1,0 +1,609 @@
+"""Failure domains (ISSUE 12): typed terminals, deadlines + shedding,
+crash containment, worker supervision, the fetch watchdog, and the
+deterministic fault-injection plane (vtpu/serving/faults).
+
+Fast tier. The organizing claim under test: every failure has a DOMAIN
+(exactly one request, one worker, or one degraded route — never the
+engine) and every seam has a SWITCH (a FaultPlan injection that drives
+its recovery path reproducibly). Each test pairs one injection seam with
+its promised recovery, asserts the typed terminal the affected request
+ends with, and — via the conftest ``leak_check`` fixture riding every
+engine-constructing test — that nothing the failure touched leaked.
+"""
+
+import queue as _queue
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from vtpu.models import ModelConfig, init_params
+from vtpu.serving import (
+    FaultPlan,
+    FaultSpec,
+    PriorityDeadlineShedPolicy,
+    Request,
+    ServingConfig,
+    ServingEngine,
+    Status,
+    Terminal,
+)
+from vtpu.serving.shed import ShedPolicy, load_shed_policy
+
+CFG = ModelConfig(
+    vocab=64, d_model=32, n_heads=2, n_layers=1, d_ff=64,
+    max_seq=64, head_dim=16, dtype=jnp.float32, use_pallas=False,
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(jax.random.key(0), CFG)
+
+
+def _prompt(seed, n):
+    return [int(t) for t in jax.random.randint(
+        jax.random.key(seed), (n,), 1, CFG.vocab, jnp.int32)]
+
+
+def _serving(**kw):
+    base = dict(slots=2, prefill_buckets=(16,), max_new_tokens=6)
+    base.update(kw)
+    return ServingConfig(**base)
+
+
+def _drain_all(reqs):
+    return [list(r.stream()) for r in reqs]
+
+
+# ------------------------------------------------------- typed terminals
+
+
+def test_terminal_status_ok_and_cancelled(params):
+    """Every stream ends with exactly one typed terminal: a clean run is
+    OK, a cancel is CANCELLED — and the sentinel is a Terminal object on
+    the queue, never a silent close."""
+    eng = ServingEngine(params, CFG, _serving())
+    eng.start()
+    try:
+        ok = eng.submit(_prompt(1, 5), max_new_tokens=4)
+        assert len(list(ok.stream())) == 4
+        assert ok.status == Status.OK
+        victim = eng.submit(_prompt(2, 5), max_new_tokens=64)
+        assert victim.out.get(timeout=30) is not None  # streaming
+        victim.cancel()
+        victim.cancel()  # idempotent
+        tail = list(victim.stream())
+        assert victim.status == Status.CANCELLED
+        assert all(isinstance(t, int) for t in tail)
+    finally:
+        eng.stop()
+
+
+def test_finish_idempotent_single_sentinel():
+    """Request.finish delivers ONE Terminal no matter how many enders
+    race it; the first status wins and later ones are dropped."""
+    req = Request(tokens=jnp.zeros((1,), jnp.int32))
+    assert req.finish(Status.SHED_DEADLINE) is True
+    assert req.finish(Status.FAULTED) is False
+    assert req.status == Status.SHED_DEADLINE
+    sentinels = []
+    while True:
+        try:
+            sentinels.append(req.out.get_nowait())
+        except _queue.Empty:
+            break
+    assert len(sentinels) == 1
+    assert isinstance(sentinels[0], Terminal)
+    assert sentinels[0].status == Status.SHED_DEADLINE
+    # stream() terminates on the typed sentinel (already consumed above)
+    req2 = Request(tokens=jnp.zeros((1,), jnp.int32))
+    req2.out.put(7)
+    req2.finish(Status.OK)
+    assert list(req2.stream()) == [7]
+
+
+# -------------------------------------------------- deadlines + shedding
+
+
+def test_deadline_shed_before_admission(params):
+    """A request already past its deadline sheds from the WaitQueue
+    before admission: empty stream, typed SHED_DEADLINE terminal, shed
+    counter + trace event — and the line behind it is untouched."""
+    eng = ServingEngine(params, CFG, _serving())
+    eng.start()
+    try:
+        late = eng.submit(_prompt(3, 5), max_new_tokens=4, deadline_ms=0)
+        live = eng.submit(_prompt(4, 5), max_new_tokens=4)
+        assert list(late.stream()) == []
+        assert late.status == Status.SHED_DEADLINE
+        assert len(list(live.stream())) == 4
+        assert live.status == Status.OK
+        stats = eng.stats()
+        events = {e["event"] for e in eng.trace.events()
+                  if e["rid"] == late.rid}
+    finally:
+        eng.stop()
+    assert stats["shed_deadline"] == 1
+    assert stats["shed_overload"] == 0
+    assert "shed" in events
+
+
+def test_deadline_shed_mid_stream_at_flush_boundary(params):
+    """A deadline elapsing mid-stream aborts at the next flush boundary:
+    the stream is cut short with SHED_DEADLINE, tokens already delivered
+    stand, and the slot frees for other traffic."""
+    eng = ServingEngine(params, CFG, _serving())
+    eng.start()
+    try:
+        req = eng.submit(_prompt(5, 5), max_new_tokens=48,
+                         deadline_ms=60_000.0)
+        got = [req.out.get(timeout=30) for _ in range(2)]
+        assert all(isinstance(t, int) for t in got)
+        # the deadline elapses mid-stream (rewound white-box so the test
+        # never races engine warmup or box speed): the next tick head
+        # must shed at the flush boundary
+        req.deadline_ns = time.monotonic_ns() - 1
+        got += list(req.stream())
+        assert req.status == Status.SHED_DEADLINE
+        assert 2 <= len(got) < 48
+        follow = eng.submit(_prompt(6, 5), max_new_tokens=4)
+        assert len(list(follow.stream())) == 4
+        stats = eng.stats()
+    finally:
+        eng.stop()
+    assert stats["shed_deadline"] == 1
+
+
+def test_overload_shed_default_policy_lowest_priority_first(params):
+    """shed_queue_depth bounds the waiting line; the default policy sheds
+    lowest QoS first, so whatever the submission/tick interleaving, the
+    highest-priority burst member is the one that survives to stream."""
+    eng = ServingEngine(params, CFG, _serving(
+        slots=1, shed_queue_depth=1))
+    eng.start()
+    try:
+        hog = eng.submit(_prompt(7, 5), max_new_tokens=48)
+        assert hog.out.get(timeout=30) is not None  # slot occupied
+        burst = [eng.submit(_prompt(10 + i, 5), max_new_tokens=4,
+                            priority=i) for i in range(4)]
+        streams = _drain_all(burst)
+        assert list(hog.stream()) is not None
+        stats = eng.stats()
+    finally:
+        eng.stop()
+    shed = [r for r in burst if r.status == Status.SHED_OVERLOAD]
+    served = [r for r in burst if r.status == Status.OK]
+    assert len(shed) == 3 and len(served) == 1
+    assert served[0] is burst[-1]  # highest priority survives
+    assert len(streams[-1]) == 4
+    assert stats["shed_overload"] == 3
+
+
+class _ShedHighestFirst(ShedPolicy):
+    def select(self, waiters, need):
+        return sorted(waiters, key=lambda r: -r.priority)[:need]
+
+
+def test_custom_shed_policy_loads_and_applies(params):
+    """The policy is a pluggable program: an instance (or class, or
+    'module:attr' string) replaces the default — here an inverted policy
+    sheds the HIGHEST priority, so the survivor flips."""
+    # the user-loadable string form resolves classes and instances alike
+    assert isinstance(load_shed_policy(
+        "vtpu.serving.shed:PriorityDeadlineShedPolicy"),
+        PriorityDeadlineShedPolicy)
+    with pytest.raises(ValueError, match="module:attr"):
+        load_shed_policy("not-a-spec")
+    eng = ServingEngine(params, CFG, _serving(
+        slots=1, shed_queue_depth=1, shed_policy=_ShedHighestFirst))
+    eng.start()
+    try:
+        hog = eng.submit(_prompt(7, 5), max_new_tokens=48)
+        assert hog.out.get(timeout=30) is not None
+        burst = [eng.submit(_prompt(20 + i, 5), max_new_tokens=4,
+                            priority=i) for i in range(4)]
+        _drain_all(burst)
+        list(hog.stream())
+    finally:
+        eng.stop()
+    served = [r for r in burst if r.status == Status.OK]
+    assert len(served) == 1 and served[0] is burst[0]  # lowest survives
+
+
+class _BrokenPolicy(ShedPolicy):
+    def select(self, waiters, need):
+        raise TypeError("policy bug")
+
+
+def test_broken_shed_policy_does_not_kill_the_loop(params):
+    """A user-loaded policy program raising inside select() is contained
+    like any other pluggable user code: the tick skips that shed pass,
+    the engine keeps serving, and the line drains normally (nothing
+    shed, nothing lost)."""
+    eng = ServingEngine(params, CFG, _serving(
+        slots=1, shed_queue_depth=1, shed_policy=_BrokenPolicy))
+    eng.start()
+    try:
+        hog = eng.submit(_prompt(25, 5), max_new_tokens=16)
+        assert hog.out.get(timeout=30) is not None
+        burst = [eng.submit(_prompt(26 + i, 5), max_new_tokens=4)
+                 for i in range(3)]
+        streams = _drain_all(burst)
+        list(hog.stream())
+        stats = eng.stats()
+    finally:
+        eng.stop()
+    assert all(r.status == Status.OK for r in burst)
+    assert all(len(s) == 4 for s in streams)
+    assert stats["shed_overload"] == 0
+
+
+# ----------------------------------------------------- crash containment
+
+
+def test_dispatch_exception_contained_to_one_request(params):
+    """An exception escaping one request's deliver path retires ONLY that
+    slot (typed FAULTED); the other stream is token-equal to a fault-free
+    run and the engine keeps serving afterwards."""
+    prompts = [_prompt(30, 5), _prompt(31, 7)]
+    ref_eng = ServingEngine(params, CFG, _serving())
+    ref_eng.start()
+    try:
+        ref = _drain_all([ref_eng.submit(p, max_new_tokens=6)
+                          for p in prompts])
+    finally:
+        ref_eng.stop()
+
+    plan = FaultPlan([FaultSpec("dispatch_exc", at=3)])
+    eng = ServingEngine(params, CFG, _serving(faults=plan))
+    eng.start()
+    try:
+        reqs = [eng.submit(p, max_new_tokens=6) for p in prompts]
+        streams = _drain_all(reqs)
+        follow = eng.submit(_prompt(32, 5), max_new_tokens=4)
+        assert len(list(follow.stream())) == 4
+        stats = eng.stats()
+        events = [e for e in eng.trace.events() if e["event"] == "fault"]
+    finally:
+        eng.stop()
+    faulted = [i for i, r in enumerate(reqs) if r.status == Status.FAULTED]
+    ok = [i for i, r in enumerate(reqs) if r.status == Status.OK]
+    assert len(faulted) == 1 and len(ok) == 1
+    assert streams[ok[0]] == ref[ok[0]]
+    assert stats["faulted_requests"] == 1
+    assert stats["faults_injected"] == 1
+    assert events and events[0]["rid"] == reqs[faulted[0]].rid
+
+
+def test_dispatch_exception_contained_under_decode_loop_k(params):
+    """Containment is k-deep under the device loop: a fault in one slot's
+    flush column kills only that request; the other stream stays
+    token-equal to its fault-free (k=1-equal) reference."""
+    prompts = [_prompt(33, 5), _prompt(34, 7)]
+    ref_eng = ServingEngine(params, CFG, _serving())
+    ref_eng.start()
+    try:
+        ref = _drain_all([ref_eng.submit(p, max_new_tokens=8)
+                          for p in prompts])
+    finally:
+        ref_eng.stop()
+    plan = FaultPlan([FaultSpec("dispatch_exc", at=2)])
+    eng = ServingEngine(params, CFG, _serving(
+        decode_loop_k=4, faults=plan))
+    eng.start()
+    try:
+        reqs = [eng.submit(p, max_new_tokens=8) for p in prompts]
+        streams = _drain_all(reqs)
+        stats = eng.stats()
+    finally:
+        eng.stop()
+    faulted = [i for i, r in enumerate(reqs) if r.status == Status.FAULTED]
+    ok = [i for i, r in enumerate(reqs) if r.status == Status.OK]
+    assert len(faulted) == 1 and len(ok) == 1
+    assert streams[ok[0]] == ref[ok[0]]
+    assert stats["faulted_requests"] == 1
+    assert stats["decode_loop_k"] == 4
+
+
+@pytest.mark.parametrize("tp", [2])
+def test_dispatch_exception_contained_under_tp(params, tp):
+    """Containment under a tensor-parallel paged engine: the head-sharded
+    pool's blocks release exactly like single-chip (the leak_check
+    fixture audits the allocator), and the surviving stream matches the
+    fault-free tp run."""
+    from vtpu.parallel.mesh import make_axis_mesh
+
+    if len(jax.devices()) < tp:
+        pytest.skip("needs >= 2 devices")
+    mesh = make_axis_mesh("tp", tp)
+    prompts = [_prompt(35, 5), _prompt(36, 7)]
+    serving = _serving(kv_page=8)
+    ref_eng = ServingEngine(params, CFG, serving, mesh=mesh)
+    ref_eng.start()
+    try:
+        ref = _drain_all([ref_eng.submit(p, max_new_tokens=6)
+                          for p in prompts])
+    finally:
+        ref_eng.stop()
+    plan = FaultPlan([FaultSpec("dispatch_exc", at=3)])
+    eng = ServingEngine(params, CFG, _serving(kv_page=8, faults=plan),
+                        mesh=mesh)
+    eng.start()
+    try:
+        reqs = [eng.submit(p, max_new_tokens=6) for p in prompts]
+        streams = _drain_all(reqs)
+    finally:
+        eng.stop()
+    faulted = [i for i, r in enumerate(reqs) if r.status == Status.FAULTED]
+    ok = [i for i, r in enumerate(reqs) if r.status == Status.OK]
+    assert len(faulted) == 1 and len(ok) == 1
+    assert streams[ok[0]] == ref[ok[0]]
+
+
+# --------------------------------------------------- injection seams: pool
+
+
+def test_alloc_exhaust_injection_exercises_backpressure(params):
+    """Injected allocator exhaustion runs the real backpressure path —
+    the admission parks, is retried, and completes token-equal to an
+    uninjected run (the fault changes WHEN, never WHAT)."""
+    prompts = [_prompt(40, 5)]
+    serving_kw = dict(kv_page=8, kv_pool_blocks=16)
+    ref_eng = ServingEngine(params, CFG, _serving(**serving_kw))
+    ref_eng.start()
+    try:
+        ref = _drain_all([ref_eng.submit(p, max_new_tokens=6)
+                          for p in prompts])
+    finally:
+        ref_eng.stop()
+    plan = FaultPlan([FaultSpec("alloc_exhaust", at=0, count=2)])
+    eng = ServingEngine(params, CFG, _serving(faults=plan, **serving_kw))
+    eng.start()
+    try:
+        streams = _drain_all([eng.submit(p, max_new_tokens=6)
+                              for p in prompts])
+        stats = eng.stats()
+    finally:
+        eng.stop()
+    assert streams == ref
+    assert stats["pool_blocked_admissions"] >= 1
+    assert stats["faults_injected"] >= 1
+
+
+def _overcommit_serving(**kw):
+    page, prompt_len, new = 8, 8, 24
+    pages_per = -(-(prompt_len + new) // page)
+    base = dict(slots=2, prefill_buckets=(16,), max_new_tokens=new,
+                prefill_chunk=16, kv_page=page,
+                kv_pool_blocks=2 * pages_per, kv_swap=2 * pages_per)
+    base.update(kw)
+    return ServingConfig(**base), prompt_len, new
+
+
+def _park_evict_resume(params, plan):
+    """One park -> evict (pool pressure) -> resume round trip under the
+    given FaultPlan; returns (stream, stats, engine-free-blocks-ok)."""
+    serving, prompt_len, new = _overcommit_serving(faults=plan)
+    eng = ServingEngine(params, CFG, serving)
+    eng.start()
+    try:
+        victim = eng.submit(_prompt(50, prompt_len), max_new_tokens=new)
+        got = [victim.out.get(timeout=60) for _ in range(2)]
+        assert all(isinstance(t, int) for t in got)
+        eng.park(victim)
+        t0 = time.perf_counter()
+        while eng.stats()["parked_sessions"] < 1:
+            assert time.perf_counter() - t0 < 60, "park stalled"
+            time.sleep(0.002)
+        # pool pressure: a second wave forces the parked pages out
+        wave = [eng.submit(_prompt(60 + i, prompt_len), max_new_tokens=new)
+                for i in range(2)]
+        _drain_all(wave)
+        eng.resume(victim)
+        got += list(victim.stream())
+        stats = eng.stats()
+    finally:
+        eng.stop()
+    return got, stats, victim
+
+
+def test_swap_d2h_loss_routes_to_recompute(params):
+    """An eviction whose host spill is lost (injected D2H loss) drops the
+    pages; resume rebuilds through recompute-on-fault and the stream is
+    token-equal to the fault-free park/resume run."""
+    ref, ref_stats, _ = _park_evict_resume(params, None)
+    got, stats, victim = _park_evict_resume(
+        params, FaultPlan([FaultSpec("swap_d2h_loss", at=0)]))
+    assert got == ref
+    assert victim.status == Status.OK
+    assert stats["fault_recomputes"] >= 1
+    assert stats["faults_injected"] >= 1
+    # the lost spill never paid D2H bytes for the victim's pages
+    assert stats["swap_out_bytes"] <= ref_stats["swap_out_bytes"]
+
+
+def test_swap_h2d_loss_routes_to_recompute(params):
+    """A resume whose host restore is lost (injected H2D loss) drops its
+    host pages and rebuilds through prefill — token-equal, typed OK, and
+    the host pool pages return (leak_check audits the engine)."""
+    ref, _, _ = _park_evict_resume(params, None)
+    got, stats, victim = _park_evict_resume(
+        params, FaultPlan([FaultSpec("swap_h2d_loss", at=0)]))
+    assert got == ref
+    assert victim.status == Status.OK
+    assert stats["fault_recomputes"] >= 1
+    assert stats["faults_injected"] >= 1
+
+
+# ------------------------------------------------- worker crash recovery
+
+
+def _disagg_serving(**kw):
+    from vtpu.serving import DisaggConfig
+
+    base = dict(slots=2, prefill_buckets=(16,), max_new_tokens=6,
+                prefill_chunk=16, kv_page=8,
+                disagg=DisaggConfig(prefill_workers=1),
+                worker_retry_backoff_ms=5.0)
+    base.update(kw)
+    return ServingConfig(**base)
+
+
+def test_worker_death_requeues_and_restarts(params):
+    """A prefill worker dying mid-claim has a one-request blast radius:
+    the supervisor releases its reservation, re-queues the request
+    (bounded backoff), restarts the worker, and the stream completes
+    token-equal to the fault-free disagg run."""
+    prompts = [_prompt(70, 12)]
+    ref_eng = ServingEngine(params, CFG, _disagg_serving())
+    ref_eng.start()
+    try:
+        ref = _drain_all([ref_eng.submit(p, max_new_tokens=6)
+                          for p in prompts])
+    finally:
+        ref_eng.stop()
+    plan = FaultPlan([FaultSpec("worker_death", at=0)])
+    eng = ServingEngine(params, CFG, _disagg_serving(faults=plan))
+    eng.start()
+    try:
+        req = eng.submit(prompts[0], max_new_tokens=6)
+        stream = list(req.stream())
+        stats = eng.stats()
+        restarts = [e for e in eng.trace.events()
+                    if e["event"] == "worker_restart"]
+    finally:
+        eng.stop()
+    assert stream == ref[0]
+    assert req.status == Status.OK
+    assert stats["worker_restarts"] == 1
+    assert stats["faulted_requests"] == 0
+    assert restarts and restarts[0]["rid"] == req.rid
+
+
+def test_worker_death_bounded_retries_then_faulted(params):
+    """Past worker_retry_limit deaths the request terminates FAULTED —
+    and the restarted worker serves the next request normally (the fault
+    plan's schedule has run dry by then)."""
+    limit = 2
+    plan = FaultPlan([FaultSpec("worker_death", at=0, count=limit + 1)])
+    eng = ServingEngine(params, CFG, _disagg_serving(
+        faults=plan, worker_retry_limit=limit))
+    eng.start()
+    try:
+        doomed = eng.submit(_prompt(71, 12), max_new_tokens=6)
+        assert list(doomed.stream()) == []
+        assert doomed.status == Status.FAULTED
+        follow = eng.submit(_prompt(72, 12), max_new_tokens=6)
+        assert len(list(follow.stream())) == 6
+        assert follow.status == Status.OK
+        stats = eng.stats()
+    finally:
+        eng.stop()
+    assert stats["worker_restarts"] == limit + 1
+    assert stats["faulted_requests"] == 1
+    assert stats["faults_injected"] == limit + 1
+
+
+# ------------------------------------------------------- fetch watchdog
+
+
+def test_watchdog_degrades_device_loop_to_per_token(params):
+    """A stalled fetch (injected delay) trips the watchdog, which clamps
+    the k-tick device loop to per-token flushes — same executable, no
+    recompile, stream token-equal to the classic loop."""
+    prompts = [_prompt(80, 5)]
+    ref_eng = ServingEngine(params, CFG, _serving())
+    ref_eng.start()
+    try:
+        ref = _drain_all([ref_eng.submit(p, max_new_tokens=10)
+                          for p in prompts])
+    finally:
+        ref_eng.stop()
+    plan = FaultPlan([FaultSpec("delayed_fetch", at=1, arg=0.05)])
+    eng = ServingEngine(params, CFG, _serving(
+        decode_loop_k=4, fetch_watchdog_ms=10.0, faults=plan))
+    eng.start()
+    try:
+        streams = _drain_all([eng.submit(p, max_new_tokens=10)
+                              for p in prompts])
+        stats = eng.stats()
+        degrades = [e for e in eng.trace.events()
+                    if e["event"] == "degrade"]
+    finally:
+        eng.stop()
+    assert streams == ref
+    assert stats["watchdog_degrades"] == 1
+    assert degrades and degrades[0]["val"] == 1
+    assert eng._loop_cap == 1
+
+
+def test_watchdog_reroutes_paged_attn_to_gather(params):
+    """The second degradation rung: a forced-kernel paged engine whose
+    fetch stalls reroutes to the gather chain (token-equal by contract);
+    subsequent ticks attribute to the gather counter."""
+    prompts = [_prompt(81, 5)]
+    serving_kw = dict(kv_page=8, max_new_tokens=12)
+    ref_eng = ServingEngine(params, CFG, _serving(
+        paged_attn="gather", **serving_kw))
+    ref_eng.start()
+    try:
+        ref = _drain_all([ref_eng.submit(p, max_new_tokens=12)
+                          for p in prompts])
+    finally:
+        ref_eng.stop()
+    plan = FaultPlan([FaultSpec("delayed_fetch", at=1, arg=0.05)])
+    eng = ServingEngine(params, CFG, _serving(
+        paged_attn="kernel", fetch_watchdog_ms=10.0, faults=plan,
+        **serving_kw))
+    eng.start()
+    try:
+        streams = _drain_all([eng.submit(p, max_new_tokens=12)
+                              for p in prompts])
+        stats = eng.stats()
+    finally:
+        eng.stop()
+    assert streams == ref
+    assert stats["watchdog_degrades"] == 1
+    assert stats["paged_attn_kernel_ticks"] > 0   # before the trip
+    assert stats["paged_attn_gather_ticks"] > 0   # after the reroute
+    assert eng._paged_attn == "gather"
+
+
+# ------------------------------------------------------- FaultPlan unit
+
+
+def test_fault_plan_schedule_and_counters():
+    plan = FaultPlan([FaultSpec("dispatch_exc", at=1, count=2),
+                      FaultSpec("delayed_fetch", at=0, arg=0.25)])
+    assert plan.fire("dispatch_exc") is None          # arrival 0
+    assert plan.fire("dispatch_exc") is not None      # arrival 1
+    assert plan.fire("dispatch_exc") is not None      # arrival 2
+    assert plan.fire("dispatch_exc") is None          # arrival 3
+    spec = plan.fire("delayed_fetch")
+    assert spec is not None and spec.arg == 0.25
+    snap = plan.snapshot()
+    assert snap["arrivals"]["dispatch_exc"] == 4
+    assert snap["injected"]["dispatch_exc"] == 2
+    assert plan.injected_total == 3
+    with pytest.raises(ValueError, match="unknown fault seam"):
+        FaultSpec("nope")
+
+
+def test_fault_plan_seeded_is_deterministic():
+    """The seeded schedule is a pure function of (seed, rates): two plans
+    from the same seed fire at identical arrival indices; a different
+    seed yields a different schedule (at these rates, overwhelmingly)."""
+    rates = {"dispatch_exc": 0.3, "alloc_exhaust": 0.2}
+
+    def fire_pattern(plan, n=64):
+        return [(s, i) for s in sorted(rates)
+                for i in range(n) if plan._sched[s].get(i)]
+
+    a = FaultPlan.seeded(7, rates)
+    b = FaultPlan.seeded(7, rates)
+    c = FaultPlan.seeded(8, rates)
+    assert fire_pattern(a) == fire_pattern(b)
+    assert fire_pattern(a) != fire_pattern(c)
+    assert a.injected_total == 0  # schedules don't count until fired

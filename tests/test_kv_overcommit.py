@@ -299,12 +299,17 @@ def test_cancel_mid_swap_and_racing_resume_release_all(params):
         return req
 
     def ended(req):
+        # a cancelled stream now ends with ONE typed Terminal sentinel
+        # (ISSUE 12), never a silent close or a bare None
+        from vtpu.serving import Terminal
         items = []
         while True:
             try:
                 items.append(req.out.get_nowait())
             except _queue.Empty:
-                return items and items[-1] is None
+                return (bool(items) and isinstance(items[-1], Terminal)
+                        and items[-1].status == "CANCELLED"
+                        and req.status == "CANCELLED")
 
     # (a) cancel with the snapshot still pending host-copy finalization
     req = park_one(50)

@@ -329,6 +329,53 @@ def test_trace_off_engine_still_reports_percentiles(params):
     assert stats["device_gets_per_tick"] == 1.0
 
 
+def test_shed_and_fault_events_attribute_stream_ends(params):
+    """Failure-domain trace fidelity (ISSUE 12 satellite): a shed and a
+    contained fault land as ``shed``/``fault`` events in the ring, the
+    retire event carries the typed terminal code, and the derived spans
+    say WHY each stream ended (``terminal``/``sheds``/``faults``) — the
+    post-mortem a JSONL consumer reads. The Chrome dump stays valid with
+    the new instants aboard."""
+    from vtpu.serving import FaultPlan, FaultSpec, Status
+
+    plan = FaultPlan([FaultSpec("dispatch_exc", at=3)])
+    eng = ServingEngine(params, CFG, ServingConfig(
+        slots=2, prefill_buckets=(8,), max_new_tokens=6, faults=plan))
+    eng.start()
+    try:
+        shed = eng.submit(_prompt(40, 5), max_new_tokens=6, deadline_ms=0)
+        assert list(shed.stream()) == []
+        reqs = [eng.submit(_prompt(41 + i, 5), max_new_tokens=6)
+                for i in range(2)]
+        for r in reqs:
+            list(r.stream())
+        events = eng.trace.events()
+        spans = eng.trace.spans()
+        chrome = eng.trace.chrome_trace()
+    finally:
+        eng.stop()
+    assert shed.status == Status.SHED_DEADLINE
+    faulted = [r for r in reqs if r.status == Status.FAULTED]
+    ok = [r for r in reqs if r.status == Status.OK]
+    assert len(faulted) == 1 and len(ok) == 1
+    by_rid = {}
+    for e in events:
+        by_rid.setdefault(e["rid"], []).append(e)
+    assert any(e["event"] == "shed" for e in by_rid[shed.rid])
+    assert any(e["event"] == "fault" for e in by_rid[faulted[0].rid])
+    # retire events carry the typed terminal code the spans decode
+    assert spans[shed.rid]["terminal"] == "SHED_DEADLINE"
+    assert spans[shed.rid]["sheds"] == 1
+    assert spans[faulted[0].rid]["terminal"] == "FAULTED"
+    assert spans[faulted[0].rid]["faults"] == 1
+    assert spans[ok[0].rid]["terminal"] == "OK"
+    assert spans[ok[0].rid]["faults"] == 0
+    # the dump stays loadable with shed/fault instants aboard
+    assert json.loads(json.dumps(chrome)) == chrome
+    names = {e["name"] for e in chrome["traceEvents"] if e["ph"] == "i"}
+    assert {"shed", "fault"} <= names
+
+
 # ---------------------------------------------------------------- exporter
 
 

@@ -94,6 +94,18 @@ COUNTERS = {
                        "Device copies performed by handoffs (contract: 0)"),
     "repartitions": ("repartitions",
                      "Disagg controller prefill-share level changes"),
+    "shed_deadline": ("shed_deadline",
+                      "Requests shed past their submit deadline"),
+    "shed_overload": ("shed_overload",
+                      "Requests shed by the overload policy"),
+    "faulted_requests": ("faulted_requests",
+                         "Requests a contained failure terminated"),
+    "worker_restarts": ("worker_restarts",
+                        "Dead prefill workers the supervisor replaced"),
+    "watchdog_degrades": ("watchdog_degrades",
+                          "Fetch-watchdog degradation-ladder steps"),
+    "faults_injected": ("faults_injected",
+                        "Deterministic FaultPlan injections fired"),
 }
 
 # stats() key -> (family suffix, help, scale). Point-in-time gauges; a

@@ -70,8 +70,31 @@ EVENT_KINDS = (
     "swap_in",         # pages restored from the host tier (val: bytes)
     "fault_recompute", # KV rebuilt through prefill (val: sequence length)
     "resume",          # resume command accepted for a parked session
-    "retire",          # stream ended (eos / budget / cancel)
+    "retire",          # stream ended; val carries the typed terminal
+                       # status code (TERMINAL_CODES) so a post-mortem
+                       # JSONL says WHY — OK / CANCELLED / SHED_* / FAULTED
+    "shed",            # request shed by deadline or overload policy
+                       # (val: TERMINAL_CODES of the shed kind)
+    "fault",           # an exception was contained to this one request
+                       # (crash containment / worker-death exhaustion)
+    "worker_restart",  # a dead disagg prefill worker was restarted by the
+                       # loop-thread supervisor (slot field: worker id)
+    "degrade",         # the fetch watchdog stepped the degradation ladder
+                       # (val: ladder level after the step)
 )
+
+# Typed terminal status -> the small int the retire/shed events carry in
+# ``val`` (0 is OK, so legacy retire records without a code read as OK).
+# Single-sourced here so the engine, spans() and every post-mortem
+# consumer decode the same vocabulary.
+TERMINAL_CODES = {
+    "OK": 0,
+    "CANCELLED": 1,
+    "SHED_DEADLINE": 2,
+    "SHED_OVERLOAD": 3,
+    "FAULTED": 4,
+}
+TERMINAL_NAMES = {v: k for k, v in TERMINAL_CODES.items()}
 
 # The disaggregated handoff lifecycle (prefill worker -> decode loop) as an
 # in-order subsequence — single-sourced like the restore sequences below so
@@ -249,6 +272,8 @@ class RequestTrace:
                     "fault_recomputes": 0,
                     "prefill_start_ns": None, "handoff_ns": None,
                     "pool_install_ns": None, "handoffs": 0,
+                    "sheds": 0, "faults": 0, "worker_restarts": 0,
+                    "terminal": None,
                     "_last_tok_ns": None, "_park_ns": None,
                     "_resume_ns": None,
                 }
@@ -295,6 +320,12 @@ class RequestTrace:
                     s["parked_ms"] += (ts - s["_park_ns"]) / 1e6
                     s["_park_ns"] = None
                 s["_resume_ns"] = ts
+            elif event == "shed":
+                s["sheds"] += 1
+            elif event == "fault":
+                s["faults"] += 1
+            elif event == "worker_restart":
+                s["worker_restarts"] += 1
             elif event == "retire":
                 # cancel-while-parked retires with no resume: the parked
                 # window still closes here, or parked_ms would undercount
@@ -302,6 +333,10 @@ class RequestTrace:
                     s["parked_ms"] += (ts - s["_park_ns"]) / 1e6
                     s["_park_ns"] = None
                 s["retire_ns"] = ts
+                # why the stream ended, straight off the event's typed
+                # terminal code — the post-mortem attribution this span
+                # exists for (unknown codes read as OK for forward compat)
+                s["terminal"] = TERMINAL_NAMES.get(val, "OK")
         for s in out.values():
             sub, adm, ft = s["submit_ns"], s["admit_ns"], s["first_token_ns"]
             dep = s["queue_depart_ns"] or adm

@@ -6,18 +6,28 @@ from vtpu.serving.engine import (
     Request,
     ServingConfig,
     ServingEngine,
+    Status,
+    Terminal,
     WaitQueue,
     batched_decode_step,
     prefill_into_slot,
     prefill_into_slots,
 )
+from vtpu.serving.faults import FaultPlan, FaultSpec
+from vtpu.serving.shed import PriorityDeadlineShedPolicy, ShedPolicy
 
 __all__ = [
     "BlockAllocator",
     "DisaggConfig",
+    "FaultPlan",
+    "FaultSpec",
+    "PriorityDeadlineShedPolicy",
     "Request",
     "ServingConfig",
     "ServingEngine",
+    "ShedPolicy",
+    "Status",
+    "Terminal",
     "WaitQueue",
     "batched_decode_step",
     "prefill_into_slot",

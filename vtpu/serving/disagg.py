@@ -69,6 +69,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from vtpu.obs.trace import TERMINAL_CODES
+from vtpu.serving.faults import WorkerDeath
+
 log = logging.getLogger(__name__)
 
 
@@ -227,7 +230,18 @@ class DisaggRuntime:
             # engine merges this into stats()['admissions'] to keep the
             # counter's meaning (requests that began service) mode-equal
             "worker_retired": 0,
+            # failure-domain counters the engine merges into its own
+            # totals: deadline sheds at the worker claim path, and
+            # requests a worker-side failure terminated FAULTED
+            "shed_deadline": 0,
+            "faulted_requests": 0,
         }
+        # worker-death recovery (loop thread only, via watch()): requests
+        # waiting out their re-queue backoff. The per-request death count
+        # feeding the bounded-retries-then-FAULTED policy lives ON the
+        # request (_worker_deaths) so it dies with it — a runtime-side
+        # map would accumulate one entry per recovered death forever
+        self._retry: list = []  # [(eligible_monotonic_ns, Request)]
         self.workers = [
             PrefillWorker(self, i) for i in range(cfg.prefill_workers)]
 
@@ -328,6 +342,88 @@ class DisaggRuntime:
     def on_tick(self) -> None:
         self.controller.on_tick(self.backlog())
 
+    # ---------------------------------------------- worker crash recovery
+
+    def watch(self) -> None:
+        """Loop-thread supervisor, called from every tick head: a prefill
+        worker that DIED (thread exited without cleanup — an escaped
+        exception, an injected WorkerDeath) has a defined blast radius of
+        exactly its claimed request. The supervisor releases the dead
+        worker's reservation, re-queues the request with exponential
+        backoff (bounded by ServingConfig.worker_retry_limit, then a
+        typed FAULTED terminal), restarts the worker, and re-admits retry
+        entries whose backoff elapsed. Runs only on the serving-loop
+        thread — the owner of the parked/waiting/trace structures the
+        recovery touches — so none of this races the live workers."""
+        eng = self.engine
+        from vtpu.serving.engine import Status
+
+        now = time.monotonic_ns()
+        for i, w in enumerate(self.workers):
+            # ident is None until the thread starts: a not-yet-started
+            # worker is pending, not dead (start() may still be running)
+            if w.ident is None or w.is_alive() or eng._stop.is_set():
+                continue
+            cur, w.current = w.current, None
+            eng._stats["worker_restarts"] += 1
+            eng.trace.record(
+                "worker_restart",
+                cur["req"].rid if cur is not None else -1, i)
+            log.warning("prefill worker %d died%s; restarting", i,
+                        f" holding request {cur['req'].rid}"
+                        if cur is not None else "")
+            if cur is not None:
+                req, res = cur["req"], cur["res"]
+                with self._mu:
+                    in_ready = any(e["req"] is req for e in self._ready)
+                handed_off = (req.status is not None
+                              or req in eng._slot_req
+                              or in_ready)
+                # the reservation is worker-held only until push_ready
+                # moved ownership to the handoff entry (res emptied) —
+                # releasing what remains is safe in every death window
+                blocks = res["shared"] + res["priv"]
+                if blocks:
+                    eng._alloc.release(blocks)
+                res["shared"], res["priv"] = [], []
+                self.unclaim(req)
+                if handed_off:
+                    pass  # the handoff survives the worker: normal path
+                elif req.cancelled:
+                    eng._end_stream(req, req._abort or Status.CANCELLED)
+                elif cur["delivered"]:
+                    # the dead worker already delivered the first token:
+                    # a re-prefill would replay it into the stream —
+                    # fault instead of corrupting
+                    eng._stats["faulted_requests"] += 1
+                    eng.trace.record("fault", req.rid, -1)
+                    eng._end_stream(req, Status.FAULTED)
+                else:
+                    attempts = getattr(req, "_worker_deaths", 0) + 1
+                    req._worker_deaths = attempts
+                    if attempts > eng.serving.worker_retry_limit:
+                        eng._stats["faulted_requests"] += 1
+                        eng.trace.record("fault", req.rid, -1)
+                        eng._end_stream(req, Status.FAULTED)
+                    else:
+                        backoff = int(
+                            eng.serving.worker_retry_backoff_ms * 1e6
+                        ) * (2 ** (attempts - 1))
+                        self._retry.append((now + backoff, req))
+            replacement = PrefillWorker(self, w.wid)
+            self.workers[i] = replacement
+            replacement.start()
+        if self._retry and not eng._stop.is_set():
+            due = [r for t, r in self._retry if t <= now]
+            self._retry = [(t, r) for t, r in self._retry if t > now]
+            for req in due:
+                if req.cancelled:
+                    eng._end_stream(req, req._abort or Status.CANCELLED)
+                    continue
+                eng._waiting.append(req)
+            if due:
+                self.notify_work()
+
     def drain(self) -> None:
         """Shutdown sweep (loop thread, workers already joined): release
         every ready entry's blocks and end their streams — nothing a
@@ -335,6 +431,8 @@ class DisaggRuntime:
         worker was abandoned mid-join still gets its end-of-stream
         sentinel (its blocks die with the engine)."""
         eng = self.engine
+        from vtpu.serving.engine import Status
+
         while True:
             e = self.pop_ready()
             if e is None:
@@ -347,13 +445,14 @@ class DisaggRuntime:
             # engine stopped before a slot freed (its co-scheduled
             # analog was counted at _begin_slot before stop)
             eng._stats["admissions"] += 1
-            eng.trace.record("retire", e["req"].rid)
-            e["req"].out.put(None)
+            eng._end_stream(e["req"], e["req"]._abort or Status.CANCELLED)
         with self._mu:
             leftover = list(self._claimed)
             self._claimed.clear()
-        for req in leftover:
-            req.out.put(None)
+            retry = [r for _, r in self._retry]
+            self._retry = []
+        for req in leftover + retry:
+            eng._end_stream(req, req._abort or Status.CANCELLED)
 
 
 class PrefillWorker(threading.Thread):
@@ -366,6 +465,13 @@ class PrefillWorker(threading.Thread):
         super().__init__(daemon=True, name=f"vtpu-prefill-{wid}")
         self.rt = rt
         self.wid = wid
+        # what this worker holds RIGHT NOW ({"req", "res", "delivered"}),
+        # for the loop-thread supervisor (DisaggRuntime.watch): a dead
+        # worker's claim is recovered from here — set on claim, cleared
+        # on every graceful exit, deliberately LEFT SET by WorkerDeath
+        # (a crash whose cleanup never ran is the state watch() exists
+        # to mop up)
+        self.current: Optional[dict] = None
         eng = rt.engine
         # per-worker PRNG stream for temperature>0 first tokens (the loop's
         # _admit_key is loop-thread state a worker must never split)
@@ -396,12 +502,25 @@ class PrefillWorker(threading.Thread):
                 self.rt.wait_work(0.05)
                 continue
             req, res = claim
+            self.current = {"req": req, "res": res, "delivered": False}
             try:
                 self._prefill_one(req, res)
+                self.current = None
+            except WorkerDeath:
+                # injected crash: die WITHOUT cleanup (self.current stays
+                # set, blocks stay reserved, the claim stays claimed) —
+                # precisely the wreckage the supervisor must recover
+                return
             except Exception:
                 log.exception("prefill worker %d failed on request %s",
                               self.wid, req.rid)
-                self._release_all(req, res)
+                # a worker-side failure is contained to this one request:
+                # typed FAULTED terminal, reservation released, thread
+                # lives on to serve the next claim
+                self.rt.bump("faulted_requests")
+                eng.trace.record("fault", req.rid, -1)
+                self._release_all(req, res, status="FAULTED")
+                self.current = None
 
     # ------------------------------------------------------------- claim
 
@@ -424,11 +543,23 @@ class PrefillWorker(threading.Thread):
                 return None
             if head.cancelled:
                 if eng._waiting.take(head):
-                    eng.trace.record("retire", head.rid)
-                    head.out.put(None)
+                    eng._end_stream(head, head._abort or "CANCELLED")
                 # re-examine the NEW head immediately: returning None here
                 # would sleep out a work-condvar timeout while a live
                 # request sits right behind the cancelled one
+                continue
+            if (head.deadline_ns is not None
+                    and time.monotonic_ns() > head.deadline_ns):
+                # deadline shedding at the claim path, atomic via take():
+                # the worker and the loop's tick-head shed can never both
+                # own the request, and the counter merges into the same
+                # stats()['shed_deadline'] total the co-scheduled engine
+                # bumps
+                if eng._waiting.take(head):
+                    self.rt.bump("shed_deadline")
+                    eng.trace.record("shed", head.rid, -1,
+                                     TERMINAL_CODES["SHED_DEADLINE"])
+                    eng._end_stream(head, "SHED_DEADLINE")
                 continue
             res = self._reserve(head)
             if res == "unregistered":
@@ -440,8 +571,9 @@ class PrefillWorker(threading.Thread):
                 if eng._waiting.take(head):
                     log.warning("request references unregistered prefix %s; "
                                 "retiring it unserved", head.prefix)
-                    eng.trace.record("retire", head.rid)
-                    head.out.put(None)
+                    self.rt.bump("faulted_requests")
+                    eng.trace.record("fault", head.rid, -1)
+                    eng._end_stream(head, "FAULTED")
                 continue
             if res is None:
                 return None
@@ -506,7 +638,13 @@ class PrefillWorker(threading.Thread):
         # block handling must land in BOTH places.
         base, budget, full, need_priv = eng._reserve_plan(req, entry)
         shared = entry["blocks"][:full] if entry is not None else []
-        priv = eng._alloc.alloc(need_priv) if need_priv > 0 else []
+        if need_priv > 0 and eng._fire_fault("alloc_exhaust"):
+            # injected exhaustion at the WORKER reserve: the same
+            # backpressure path a genuinely dry free list takes — post
+            # the reclaim and retry on the next claim pass
+            priv = None
+        else:
+            priv = eng._alloc.alloc(need_priv) if need_priv > 0 else []
         if priv is None:
             self.rt.bump("pool_blocked_prefills")
             self.rt.request_blocks(need_priv)
@@ -536,16 +674,20 @@ class PrefillWorker(threading.Thread):
 
     # ----------------------------------------------------------- prefill
 
-    def _release_all(self, req, res: dict, retire: bool = True) -> None:
+    def _release_all(self, req, res: dict,
+                     status: Optional[str] = None) -> None:
+        """Release the claim's reservation; with ``status``, also end the
+        stream with that typed terminal (the request's own requested
+        abort — cancel or shed — wins over a generic status, and finish's
+        idempotence makes the worker-vs-loop race single-sentinel)."""
         eng = self.rt.engine
         blocks = res["shared"] + res["priv"]
         if blocks:
             eng._alloc.release(blocks)
         res["shared"], res["priv"] = [], []
         self.rt.unclaim(req)
-        if retire:
-            eng.trace.record("retire", req.rid)
-            req.out.put(None)
+        if status is not None:
+            eng._end_stream(req, req._abort or status)
 
     def _idle(self) -> bool:
         eng = self.rt.engine
@@ -563,6 +705,11 @@ class PrefillWorker(threading.Thread):
         # Chrome dump splits the prefill lane into one track per worker
         # (overlapping slices on one tid would render as nested frames)
         eng.trace.record("prefill_start", req.rid, self.wid, n)
+        if eng._fire_fault("worker_death"):
+            # injected crash: the thread dies with its claim intact (run()
+            # lets WorkerDeath escape) — the loop-thread supervisor owns
+            # the recovery
+            raise WorkerDeath(f"injected worker_death (worker {self.wid})")
         stop = eng._stop.is_set
         logits = None
         if n:
@@ -571,10 +718,10 @@ class PrefillWorker(threading.Thread):
             padded[0, :n] = np.asarray(req.tokens)
             for i in range(pad // c):
                 if not self.rt.controller.acquire(c, self._idle, stop):
-                    self._release_all(req, res)
+                    self._release_all(req, res, status="CANCELLED")
                     return
                 if req.cancelled:
-                    self._release_all(req, res)
+                    self._release_all(req, res, status="CANCELLED")
                     return
                 off = i * c
                 need = base + off + c
@@ -612,7 +759,7 @@ class PrefillWorker(threading.Thread):
         self.rt.bump("fetches")
         self.rt.bump("bytes_fetched", 4)
         if req.cancelled or eng._stop.is_set():
-            self._release_all(req, res)
+            self._release_all(req, res, status="CANCELLED")
             return
         t_first = time.perf_counter()
         now_ns = time.monotonic_ns()
@@ -622,6 +769,11 @@ class PrefillWorker(threading.Thread):
         if req.t_depart_ns:
             eng.trace.note_prefill_exec((now_ns - req.t_depart_ns) / 1e9)
         req.out.put(tok)
+        if self.current is not None:
+            # past this point a dead worker's request cannot be re-queued
+            # (a re-prefill would replay the delivered first token): the
+            # supervisor faults it instead
+            self.current["delivered"] = True
         self.rt.bump("first_tokens")
         if res["budget"] - 1 <= 0 or tok == serving.eos_token:
             # the whole budget was the first token (or eos): the session
@@ -630,7 +782,7 @@ class PrefillWorker(threading.Thread):
             # began service", matching the co-scheduled _begin_slot bump
             # (installed handoffs are bumped by _install_handoffs).
             self.rt.bump("worker_retired")
-            self._release_all(req, res, retire=True)
+            self._release_all(req, res, status="OK")
             return
         entry = {
             "req": req,

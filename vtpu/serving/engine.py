@@ -33,8 +33,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from vtpu.obs.tickprof import TickProfiler
-from vtpu.obs.trace import RequestTrace, pct
+from vtpu.obs.trace import RequestTrace, TERMINAL_CODES, pct
 from vtpu.ops.decode_attn import paged_attn_route
+from vtpu.serving.faults import FaultInjected, FaultPlan
+from vtpu.serving.shed import load_shed_policy
 
 from vtpu.models.transformer import (
     ModelConfig,
@@ -250,6 +252,41 @@ class ServingConfig:
     # unsatisfiable k > 1 raises at construction, like pipeline_decode.
     # Composes with paged pools, int8 KV, tp meshes, and disagg.
     decode_loop_k: Optional[int] = None
+    # --- failure domains (deadlines, shedding, containment, faults) ------
+    # Overload shedding: bound the waiting line at this depth. 0 = off
+    # (unbounded queueing, the pre-PR-12 behavior). When the line
+    # overflows at a tick head, the shed policy picks waiters to shed
+    # with a typed SHED_OVERLOAD terminal instead of letting every
+    # submit age in an unbounded queue — the first concrete actuator of
+    # the ROADMAP monitor->scheduler feedback loop.
+    shed_queue_depth: int = 0
+    # WHICH waiters shed under overload: None = the built-in
+    # priority-then-deadline policy (vtpu/serving/shed); a
+    # "module:attr" string loads a user policy program (the gpu_ext
+    # pluggable-policy move), a class is instantiated, an instance is
+    # used as-is.
+    shed_policy: Optional[Any] = None
+    # Fetch watchdog: a device->host fetch stalling past this many ms
+    # trips one step of the degradation ladder (drop the k-tick device
+    # loop to per-token flushes, then force the paged-attention route to
+    # gather) instead of letting a wedged device transfer hang the host
+    # indefinitely with no diagnostic. 0 = off. Degrading is lossless —
+    # both rungs are token-equal routes by contract — but the second
+    # rung pays a mid-serving re-lower of the decode executables (the
+    # one sanctioned breach of the warm-executables invariant: the
+    # engine is already in a failure mode).
+    fetch_watchdog_ms: float = 0.0
+    # Disagg worker-death recovery: a request whose prefill worker died
+    # mid-claim is re-queued with exponential backoff up to this many
+    # retries, then terminates FAULTED. (Worker restarts themselves are
+    # unbounded — the supervisor always replaces a dead worker.)
+    worker_retry_limit: int = 2
+    worker_retry_backoff_ms: float = 10.0
+    # Deterministic fault injection (vtpu/serving/faults.FaultPlan):
+    # None = no seams consult anything (one attribute check per seam).
+    # A plan makes the recovery paths above reproducible — the chaos
+    # soak and tests/test_faults.py drive every seam through it.
+    faults: Optional[Any] = None
 
 
 def choose_kv_int8(slots: int, max_window: int) -> bool:
@@ -445,6 +482,48 @@ class WaitQueue:
                 yield r
 
 
+class Status:
+    """Typed terminal status on a Request (replacing the bare
+    ``cancelled: bool`` a stream used to end on silently). Exactly one is
+    delivered per request, as a ``Terminal`` sentinel on the stream and as
+    ``Request.status``:
+
+    - OK             the stream ran to its natural end (budget or eos)
+    - CANCELLED      the client abandoned it (cancel(), or engine stop
+                     ended a still-running stream)
+    - SHED_DEADLINE  the request outlived its submit(deadline_ms=) —
+                     shed from the waiting line before admission, or
+                     aborted at the next flush boundary mid-stream
+    - SHED_OVERLOAD  the shed policy dropped it from an overflowing
+                     waiting line (ServingConfig.shed_queue_depth)
+    - FAULTED        a failure was contained to this one request: an
+                     exception escaped its dispatch/deliver path, or its
+                     prefill worker died past the retry budget
+    """
+
+    OK = "OK"
+    CANCELLED = "CANCELLED"
+    SHED_DEADLINE = "SHED_DEADLINE"
+    SHED_OVERLOAD = "SHED_OVERLOAD"
+    FAULTED = "FAULTED"
+
+    ALL = (OK, CANCELLED, SHED_DEADLINE, SHED_OVERLOAD, FAULTED)
+
+
+class Terminal:
+    """The typed end-of-stream sentinel ``Request.finish`` delivers —
+    clients iterating ``stream()`` stop on it and read ``Request.status``
+    for the reason; raw ``out.get()`` consumers can type-check it."""
+
+    __slots__ = ("status",)
+
+    def __init__(self, status: str):
+        self.status = status
+
+    def __repr__(self) -> str:
+        return f"Terminal({self.status})"
+
+
 @dataclasses.dataclass(eq=False)
 class Request:
     # eq=False: requests compare by IDENTITY. The engine's lifecycle checks
@@ -470,24 +549,63 @@ class Request:
     # worker); with t_submit_ns it splits TTFT into queue-wait vs
     # prefill-execution (the trace's prefill_exec reservoir); 0 until then
     t_depart_ns: int = 0
-    out: "queue.Queue[Optional[int]]" = dataclasses.field(default_factory=queue.Queue)
-    cancelled: bool = False
+    # absolute service deadline (monotonic_ns), set by submit(deadline_ms=);
+    # None = no deadline. Past it the engine sheds the request — from the
+    # waiting line before admission, or at the next flush boundary
+    # mid-stream — with a typed SHED_DEADLINE terminal.
+    deadline_ns: Optional[int] = None
+    out: "queue.Queue" = dataclasses.field(default_factory=queue.Queue)
+    # the typed terminal (Status.*), set EXACTLY ONCE by finish(); None
+    # while the request is still in flight
+    status: Optional[str] = None
     # per-token log p under the engine's sampling distribution, appended at
     # delivery when ServingConfig.logprobs is on (device-sampled path only;
     # index i pairs with the i-th DECODED token, the prefill first token has
     # no entry)
     logprobs: list = dataclasses.field(default_factory=list)
+    # the REQUESTED terminal (cancel()/shed set it; the engine applies it
+    # at the next safe boundary) — what the `cancelled` property reads
+    _abort: Optional[str] = dataclasses.field(default=None, repr=False)
+    _final_lock: Any = dataclasses.field(
+        default_factory=threading.Lock, repr=False)
+
+    @property
+    def cancelled(self) -> bool:
+        """Whether an abort (cancel or shed) has been requested: the engine
+        retires the slot / tombstones the waiter at its next boundary.
+        Kept as the name every lifecycle check predates — a shed request
+        rides exactly the cancel machinery, only its terminal differs."""
+        return self._abort is not None
 
     def cancel(self) -> None:
         """Abandon the request: the engine retires its slot on the next tick
-        instead of decoding tokens nobody will read."""
-        self.cancelled = True
+        instead of decoding tokens nobody will read. Idempotent, and safe
+        against a concurrent shed or disagg worker claim — whichever abort
+        lands first names the terminal."""
+        if self._abort is None:
+            self._abort = Status.CANCELLED
+
+    def finish(self, status: str) -> bool:
+        """Deliver the typed terminal exactly once: sets ``self.status``
+        and puts ONE Terminal sentinel on the stream. Idempotent and
+        thread-safe — a disagg worker retiring a claim and the serving
+        loop shedding the same request can both call this; exactly one
+        wins (returns True), the other is a no-op. The losers' statuses
+        are dropped, never double-delivered."""
+        with self._final_lock:
+            if self.status is not None:
+                return False
+            self.status = status
+        self.out.put(Terminal(status))
+        return True
 
     def stream(self):
-        """Yield generated token ids until the engine signals completion."""
+        """Yield generated token ids until the engine delivers the typed
+        terminal (read it from ``self.status`` afterwards). A bare None is
+        accepted as a legacy end-of-stream for external producers."""
         while True:
             tok = self.out.get()
-            if tok is None:
+            if tok is None or isinstance(tok, Terminal):
                 return
             yield tok
 
@@ -1563,7 +1681,16 @@ class ServingEngine:
                        "parks": 0, "resumes": 0, "evicted_blocks": 0,
                        "swap_out_bytes": 0, "swap_in_bytes": 0,
                        "swap_faults": 0, "fault_recomputes": 0,
-                       "pool_blocked_resumes": 0}
+                       "pool_blocked_resumes": 0,
+                       # failure domains: typed sheds (deadline misses /
+                       # overload-policy drops), requests a contained
+                       # failure terminated (FAULTED), dead prefill
+                       # workers the supervisor replaced, and watchdog
+                       # degradation-ladder steps. faults_injected (the
+                       # FaultPlan's own count) is added by stats().
+                       "shed_deadline": 0, "shed_overload": 0,
+                       "faulted_requests": 0, "worker_restarts": 0,
+                       "watchdog_degrades": 0}
         # per-slot token history (prompt + emitted) is maintained for
         # speculation drafts AND for overcommit (a parked session's cache
         # contents must be recomputable from tokens when its pages fault)
@@ -1632,6 +1759,41 @@ class ServingEngine:
             self._disagg = DisaggRuntime(self, serving.disagg)
         else:
             self._disagg = None
+        # --- failure domains (PR 12) -------------------------------------
+        # deterministic fault plan: every instrumented seam consults it
+        # through _fire_fault (one attribute check when None — the seams
+        # cost nothing on a clean engine)
+        if serving.faults is not None and not isinstance(
+                serving.faults, FaultPlan):
+            raise ValueError(
+                "ServingConfig.faults must be a vtpu.serving.faults."
+                f"FaultPlan, got {type(serving.faults).__name__}")
+        self._faults = serving.faults
+        # overload shedding: the policy is resolved HERE (a bad
+        # "module:attr" string fails the constructor, never the loop)
+        if serving.shed_queue_depth < 0:
+            raise ValueError(
+                f"shed_queue_depth must be >= 0, got "
+                f"{serving.shed_queue_depth}")
+        self._shed_policy = load_shed_policy(serving.shed_policy)
+        # fetch-watchdog degradation ladder: each trip applies the next
+        # APPLICABLE rung — (1) clamp the k-tick device loop to one token
+        # per flush (the executable is unchanged; the per-slot cap does
+        # the clamping, so the host regains per-token control with zero
+        # recompiles), then (2) force the paged-attention route to gather
+        # (re-lowering the decode executables — the one sanctioned
+        # mid-serving compile, paid only in a failure mode). Rungs that
+        # don't apply to this engine's shape are skipped at construction.
+        # one-way latch: set by the first submit(deadline_ms=) so the
+        # per-tick deadline sweep costs nothing on deadline-free engines
+        self._deadlines_seen = False
+        self._loop_cap = self._loop_k  # clamped to 1 by rung "loop_k1"
+        self._degrade_rungs: list[str] = []
+        if self._loop_k:
+            self._degrade_rungs.append("loop_k1")
+        if self._paged and self._paged_attn != "gather":
+            self._degrade_rungs.append("paged_gather")
+        self._degrade_level = 0
 
     # ------------------------------------------------------------------ API
 
@@ -1855,7 +2017,17 @@ class ServingEngine:
             jnp.int32(entry["len"]))
 
     def submit(self, tokens, max_new_tokens: int = 0,
-               prefix: Optional[int] = None, priority: int = 0) -> Request:
+               prefix: Optional[int] = None, priority: int = 0,
+               deadline_ms: Optional[float] = None) -> Request:
+        """``deadline_ms`` bounds the request's whole service time from
+        this call: past the deadline it is shed from the waiting line
+        before admission, or aborted at the next flush boundary
+        mid-stream, with a typed ``SHED_DEADLINE`` terminal — under
+        overload a request fails fast instead of aging in an unbounded
+        queue. None = no deadline; 0 is legal (sheds at the first
+        boundary — the probe a load-shedding client uses)."""
+        if deadline_ms is not None and deadline_ms < 0:
+            raise ValueError(f"deadline_ms must be >= 0, got {deadline_ms}")
         if self._stop.is_set():
             raise RuntimeError("ServingEngine is stopped")
         if self._thread is None:
@@ -1920,6 +2092,11 @@ class ServingEngine:
                       priority=priority)
         req.rid = next(self._req_ctr)
         req.t_submit_ns = time.monotonic_ns()
+        if deadline_ms is not None:
+            req.deadline_ns = req.t_submit_ns + int(deadline_ms * 1e6)
+            # one-way latch read by _shed_deadlines: engines that never
+            # see a deadline never pay the per-tick deadline sweep
+            self._deadlines_seen = True
         self.trace.record("submit", req.rid, -1, int(tokens.shape[0]))
         self._pending.put(req)
         self._wake.set()
@@ -1929,10 +2106,94 @@ class ServingEngine:
             self._disagg.notify_work()
         if self._stop.is_set():
             # raced with stop(): its drain may have missed this request; an
-            # extra end-of-stream sentinel is harmless, a missing one hangs
-            # the client in Request.stream()
-            req.out.put(None)
+            # extra end-of-stream sentinel is harmless (finish is
+            # idempotent), a missing one hangs the client in stream()
+            self._end_stream(req, Status.CANCELLED)
         return req
+
+    # ------------------------------------------- failure-domain helpers
+
+    def _end_stream(self, req: Request, status: str, slot: int = -1) -> None:
+        """Deliver *req*'s typed terminal exactly once (finish is
+        idempotent — racing enders collapse to one sentinel, one trace
+        retire carrying the terminal code, one status)."""
+        if req.finish(status):
+            self.trace.record("retire", req.rid, slot,
+                              TERMINAL_CODES.get(status, 0))
+
+    def _fire_fault(self, seam: str):
+        """Consult the configured FaultPlan at *seam*: the FaultSpec to
+        inject (truthy) or None. One attribute check when no plan is
+        configured — the seams are free on a clean engine."""
+        plan = self._faults
+        if plan is None:
+            return None
+        return plan.fire(seam)
+
+    def _maybe_inject_dispatch(self) -> None:
+        """The dispatch_exc seam: raise inside one request's deliver path
+        so crash containment (the per-slot try/except in the delivery
+        loops) is exercised exactly like an organic per-request bug."""
+        if self._fire_fault("dispatch_exc"):
+            raise FaultInjected("injected dispatch_exc")
+
+    def _contain_fault(self, slot: int) -> None:
+        """Crash containment: an exception escaped ONE request's
+        dispatch/deliver path — retire only that slot with a typed
+        FAULTED terminal and release everything it held; the tick loop
+        and every other stream keep going. The slot's device state goes
+        stale exactly like any retire's (reads masked, writes drop,
+        overwritten wholesale at the next admission)."""
+        req = self._slot_req[slot]
+        self._stats["faulted_requests"] += 1
+        if req is not None:
+            self.trace.record("fault", req.rid, slot)
+        log.exception("request %s faulted in slot %d; containing",
+                      getattr(req, "rid", None), slot)
+        self._retire(slot, status=Status.FAULTED)
+
+    def _trip_watchdog(self, stalled_s: float) -> None:
+        """A device fetch stalled past fetch_watchdog_ms: step the
+        degradation ladder (see __init__) rather than hanging the host.
+        Counted per APPLIED rung; an exhausted ladder logs and carries on
+        — by then the engine is already in its most host-controlled,
+        gather-routed shape."""
+        if not self._degrade_rungs:
+            log.warning("fetch watchdog: fetch stalled %.0f ms with the "
+                        "degradation ladder exhausted", stalled_s * 1e3)
+            return
+        rung = self._degrade_rungs.pop(0)
+        self._degrade_level += 1
+        self._stats["watchdog_degrades"] += 1
+        self.trace.record("degrade", -1, -1, self._degrade_level)
+        if rung == "loop_k1":
+            # the k-tick flush executable stays; every slot's per-flush
+            # cap clamps to 1, so the host observes (and can re-plan
+            # around) every single token again — zero recompiles
+            self._loop_cap = 1
+            log.warning("fetch watchdog: fetch stalled %.0f ms — "
+                        "degrading decode_loop_k=%d to per-token flushes",
+                        stalled_s * 1e3, self._loop_k)
+        elif rung == "paged_gather":
+            # force the fused-kernel route back to the gather chain
+            # (token-equal by contract) for every dispatch from here on:
+            # the adapter attribute is what the trunk reads at trace
+            # time, so clearing the decode jit caches re-lowers the next
+            # dispatch on the gather route — a mid-serving compile, the
+            # explicit price of degrading instead of hanging
+            self._paged_attn = "gather"
+            if hasattr(self.model, "paged_attn"):
+                self.model.paged_attn = "gather"
+            for fn in (self._decode_loop, self._decode_sampled,
+                       self._decode, self._spec):
+                if fn is not None:
+                    try:
+                        fn.clear_cache()
+                    except AttributeError:
+                        pass
+            log.warning("fetch watchdog: fetch stalled %.0f ms — "
+                        "degrading paged_attn to the gather route",
+                        stalled_s * 1e3)
 
     def park(self, req: Request) -> None:
         """Take a live request out of the decode batch without ending its
@@ -1994,14 +2255,17 @@ class ServingEngine:
         if self._disagg is not None:
             self._disagg.drain()
         for slot in range(len(self._slot_req)):
-            self._retire(slot)
+            # a stream still running at shutdown did not complete: its
+            # terminal is CANCELLED (the engine abandoned it), never OK
+            self._retire(slot, status=Status.CANCELLED)
         for slot, adm in self._admitting.items():
-            adm["req"].out.put(None)
+            self._end_stream(adm["req"],
+                             adm["req"]._abort or Status.CANCELLED)
             self._free_slot_blocks(slot)
         self._admitting.clear()
         for req in list(self._parked):
             self._release_parked(self._parked.pop(req))
-            req.out.put(None)
+            self._end_stream(req, req._abort or Status.CANCELLED)
         self._want_park.clear()
         self._park_unseen.clear()
         self._want_resume.clear()
@@ -2016,14 +2280,14 @@ class ServingEngine:
                 item["error"] = RuntimeError("engine stopped")
                 item["done"].set()
         for req in self._waiting:
-            req.out.put(None)
+            self._end_stream(req, req._abort or Status.CANCELLED)
         self._waiting.clear()
         while True:
             try:
                 req = self._pending.get_nowait()
             except queue.Empty:
                 break
-            req.out.put(None)
+            self._end_stream(req, req._abort or Status.CANCELLED)
 
     # ----------------------------------------------------------------- loop
 
@@ -2143,6 +2407,11 @@ class ServingEngine:
         reclaimable covers the request, evict until it fits and retry.
         ``exclude`` protects the entry being resumed from evicting itself.
         Returns the blocks or None (nothing reserved) exactly like alloc."""
+        if self._fire_fault("alloc_exhaust"):
+            # injected exhaustion: report a dry free list so the caller's
+            # backpressure path (park the admission / retry the resume)
+            # runs exactly as it would under a genuinely full pool
+            return None
         got = self._alloc.alloc(n)
         if got is not None or not self._swap_enabled:
             return got
@@ -2202,7 +2471,14 @@ class ServingEngine:
         resume recomputes (the _evictable gate guaranteed it can)."""
         priv = e["priv"]
         m = len(priv)
-        if m <= len(self._host_free) and self._swap_host_blocks:
+        # injected D2H loss: the spill "fails in transit" — recomputable
+        # entries drop their pages (resume rides recompute-on-fault); an
+        # unrecomputable entry ignores the injection and spills normally
+        # (dropping it would wedge the resume: correctness over chaos)
+        d2h_lost = (e["recompute_ok"]
+                    and self._fire_fault("swap_d2h_loss") is not None)
+        if (not d2h_lost and m <= len(self._host_free)
+                and self._swap_host_blocks):
             e["host"] = [self._host_free.pop() for _ in range(m)]
             snaps = []
             w = self._swap_stage
@@ -2439,8 +2715,7 @@ class ServingEngine:
                 self._do_park(slot)
         for req in [r for r, e in self._parked.items() if r.cancelled]:
             self._release_parked(self._parked.pop(req))
-            self.trace.record("retire", req.rid)
-            req.out.put(None)
+            self._end_stream(req, req._abort or Status.CANCELLED)
 
     def _advance_resumes(self, budget: float = float("inf")) -> float:
         """Bring resumed sessions back into slots, FIFO over resume order,
@@ -2507,6 +2782,14 @@ class ServingEngine:
         async H2D; under a mesh the staging lands pre-sharded on the head
         axis so each chip uploads only its shard), remap the table row, and
         restore the slot. No blocking host sync anywhere on this path."""
+        if e["recompute_ok"] and self._fire_fault("swap_h2d_loss"):
+            # injected H2D loss: the host restore "fails in transit" —
+            # the entry drops its host pages and rebuilds through the
+            # prefill path (the same recompute-on-fault route a dropped
+            # eviction takes); unrecomputable entries ignore the
+            # injection and restore normally
+            e["dropped"] = True
+            return self._begin_recompute(slot, e)
         need = e["n_pages"] - len(e["shared"])
         priv = self._alloc_reclaim(need, exclude=e["req"])
         if priv is None:
@@ -2670,7 +2953,9 @@ class ServingEngine:
                 log.warning("request references unregistered prefix %s; "
                             "retiring it unserved", req.prefix)
                 self._free_slot_blocks(slot)
-                req.out.put(None)
+                self._stats["faulted_requests"] += 1
+                self.trace.record("fault", req.rid, slot)
+                self._end_stream(req, Status.FAULTED, slot)
                 return
             if self._paged:
                 # zero-copy: _reserve_paged already mapped the prefix's
@@ -2803,8 +3088,7 @@ class ServingEngine:
             head = self._waiting.head()
             if head.cancelled:
                 self._waiting.popleft()
-                self.trace.record("retire", head.rid)
-                head.out.put(None)
+                self._end_stream(head, head._abort or Status.CANCELLED)
                 continue
             n_head = int(head.tokens.shape[0])
             if head.prefix is not None or self._bucket(n_head) is None:
@@ -2908,8 +3192,7 @@ class ServingEngine:
                 if blocks:
                     self._alloc.release(blocks)
                 self._stats["admissions"] += 1
-                self.trace.record("retire", req.rid)
-                req.out.put(None)
+                self._end_stream(req, req._abort or Status.CANCELLED)
             n_pages, seq_len = e["n_pages"], e["seq_len"]
             # the handoff entry is park-shaped by construction, so the
             # resume remap IS the install: one fused table-row + length
@@ -2943,54 +3226,68 @@ class ServingEngine:
             if req.cancelled:
                 del self._admitting[slot]
                 self._free_slot_blocks(slot)
-                self.trace.record("retire", req.rid, slot)
-                req.out.put(None)
+                self._end_stream(req, req._abort or Status.CANCELLED, slot)
                 continue
             c = self._chunk
             if c > budget:
                 break  # remaining admitting slots advance next tick
-            # off indexes the (suffix-)padded array; base is the installed
-            # prefix length, so the device offset is base + off
-            need = base + off + c
-            kv_bucket = next(
-                (bkt for bkt in self._kv_buckets if bkt >= need),
-                self.model.max_context,
-            )
-            extra = {}
-            if self._paged:
-                # the slot's mapped blocks, window-sized and null-padded:
-                # chunk gathers/scatters are page-granular over the pool
-                wp = kv_bucket // self._page
-                row = np.zeros((wp,), np.int32)
-                blocks = self._slot_blocks[slot]
-                m = min(len(blocks), wp)
-                row[:m] = blocks[:m]
-                extra["block_ids"] = row
-            logits, self.state = self._prefill_chunk(
-                self.params, self.state, adm["padded"][:, off:off + c],
-                jnp.int32(slot), jnp.int32(base + off),
-                jnp.int32(min(base + off + c, n)),
-                kv_bucket=kv_bucket, unroll=self._unroll, **extra,
-            )
-            adm["off"] = off + c
-            budget -= c
-            self._stats["prefill_chunks"] += 1
-            self.trace.record("prefill_chunk", req.rid, slot, c)
-            if adm["off"] >= adm["padded"].shape[1]:  # final chunk
-                del self._admitting[slot]
-                if adm.get("resume") is not None:
-                    # chunked recompute-on-fault: the cache is rebuilt and
-                    # the pending token was delivered BEFORE the park —
-                    # restore the slot, sample and emit nothing
-                    self._restore_slot(slot, adm["resume"])
-                    continue
-                pad = adm["padded"].shape[1]
-                last_row = logits[0, (n - base - 1) - (pad - c)]
-                if self._async_admission:
-                    self._begin_slot_async(slot, req, last_row, n)
-                else:
-                    self._finish_admit(
-                        slot, req, self._sample_first(last_row), n)
+            try:
+                # off indexes the (suffix-)padded array; base is the
+                # installed prefix length, so the device offset is base+off
+                need = base + off + c
+                kv_bucket = next(
+                    (bkt for bkt in self._kv_buckets if bkt >= need),
+                    self.model.max_context,
+                )
+                extra = {}
+                if self._paged:
+                    # the slot's mapped blocks, window-sized and
+                    # null-padded: chunk gathers/scatters are
+                    # page-granular over the pool
+                    wp = kv_bucket // self._page
+                    row = np.zeros((wp,), np.int32)
+                    blocks = self._slot_blocks[slot]
+                    m = min(len(blocks), wp)
+                    row[:m] = blocks[:m]
+                    extra["block_ids"] = row
+                logits, self.state = self._prefill_chunk(
+                    self.params, self.state, adm["padded"][:, off:off + c],
+                    jnp.int32(slot), jnp.int32(base + off),
+                    jnp.int32(min(base + off + c, n)),
+                    kv_bucket=kv_bucket, unroll=self._unroll, **extra,
+                )
+                adm["off"] = off + c
+                budget -= c
+                self._stats["prefill_chunks"] += 1
+                self.trace.record("prefill_chunk", req.rid, slot, c)
+                if adm["off"] >= adm["padded"].shape[1]:  # final chunk
+                    del self._admitting[slot]
+                    if adm.get("resume") is not None:
+                        # chunked recompute-on-fault: the cache is rebuilt
+                        # and the pending token was delivered BEFORE the
+                        # park — restore the slot, sample and emit nothing
+                        self._restore_slot(slot, adm["resume"])
+                        continue
+                    pad = adm["padded"].shape[1]
+                    last_row = logits[0, (n - base - 1) - (pad - c)]
+                    if self._async_admission:
+                        self._begin_slot_async(slot, req, last_row, n)
+                    else:
+                        self._finish_admit(
+                            slot, req, self._sample_first(last_row), n)
+            except Exception:
+                # crash containment on the per-request admission path: the
+                # one admitting request faults (typed terminal, reserved
+                # blocks released); live streams and the other admissions
+                # keep going
+                self._admitting.pop(slot, None)
+                self._stats["faulted_requests"] += 1
+                self.trace.record("fault", req.rid, slot)
+                log.exception("request %s faulted mid-admission in slot "
+                              "%d; containing", req.rid, slot)
+                self._free_slot_blocks(slot)
+                self._slot_req[slot] = None
+                self._end_stream(req, Status.FAULTED, slot)
         return budget
 
     def _sample_first(self, logits) -> int:
@@ -3030,12 +3327,21 @@ class ServingEngine:
             a.size * a.dtype.itemsize
             for a in jax.tree_util.tree_leaves(arrays))
         t0 = time.perf_counter()
+        spec = self._fire_fault("delayed_fetch")
+        if spec is not None:
+            # injected device stall: the fetch blocks like a wedged
+            # transfer would — what the watchdog below exists to catch
+            time.sleep(spec.arg or 0.05)
         out = jax.device_get(arrays)
         # fetch phase = device wait + transfer: on the pipelined loop this
         # is the time the host blocks for the in-flight tick to finish —
         # the device-bound share of the tick, attributed separately from
         # the Python bookkeeping phases
-        self._prof.note("fetch", time.perf_counter() - t0, ticks=ticks)
+        dt = time.perf_counter() - t0
+        self._prof.note("fetch", dt, ticks=ticks)
+        wd = self.serving.fetch_watchdog_ms
+        if wd and dt * 1e3 > wd:
+            self._trip_watchdog(dt)
         return out
 
     def _note_host_ms(self, seconds: float) -> None:
@@ -3134,7 +3440,13 @@ class ServingEngine:
                 if req.cancelled:
                     self._retire(slot)
                     continue
-                self._emit_first(slot, int(arr if idx is None else arr[idx]))
+                try:
+                    self._emit_first(
+                        slot, int(arr if idx is None else arr[idx]))
+                except Exception:
+                    # containment: a first-token delivery failure kills
+                    # only its own admission
+                    self._contain_fault(slot)
 
     def _emit_first(self, slot: int, tok: int) -> None:
         """Deliver an async-admitted request's FIRST token (its budget
@@ -3187,9 +3499,15 @@ class ServingEngine:
         for slot, req in enumerate(tick["reqs"]):
             if req is None or req is not self._slot_req[slot]:
                 continue
-            self._emit(slot, int(toks[slot]),
-                       float(lps[slot]) if lps is not None else None,
-                       now=now)
+            try:
+                self._emit(slot, int(toks[slot]),
+                           float(lps[slot]) if lps is not None else None,
+                           now=now)
+            except Exception:
+                # crash containment: an exception in ONE request's deliver
+                # path retires only that slot (typed FAULTED, blocks
+                # released) — the tick and every other stream keep going
+                self._contain_fault(slot)
         self._prof.note("deliver", time.perf_counter() - t0)
         self._note_host_ms(extra_host_s + time.perf_counter() - t0)
 
@@ -3201,6 +3519,7 @@ class ServingEngine:
         fork between the two paths. Mirrors the device first: its cache
         length advanced for this slot at dispatch, unconditionally of what
         eos does below."""
+        self._maybe_inject_dispatch()
         req = self._slot_req[slot]
         self._tokens[slot] = tok
         self._slot_len[slot] += 1
@@ -3404,6 +3723,11 @@ class ServingEngine:
         # (capacity/free in blocks); the flow counters — parks/resumes,
         # evicted_blocks, swap_out/in_bytes, swap_faults, fault_recomputes
         # — ride the _stats copy above
+        # failure domains: the FaultPlan's own injection count (0 with no
+        # plan — the seams are inert), next to the shed/fault/restart/
+        # degrade counters riding the _stats copy above
+        s["faults_injected"] = (
+            self._faults.injected_total if self._faults is not None else 0)
         s["kv_swap"] = self.serving.kv_swap if self._swap_enabled else None
         s["parked_sessions"] = len(self._parked)
         s["swap_host_blocks"] = (
@@ -3439,6 +3763,11 @@ class ServingEngine:
             s["prefix_blocks_shared"] += rtc["prefix_blocks_shared"]
             s["prefix_cow_copies"] += rtc["prefix_cow_copies"]
             s["pool_blocked_admissions"] += rtc["pool_blocked_prefills"]
+            # worker-side failure-domain counters: deadline sheds at the
+            # claim path and faults a worker terminated, merged so the
+            # totals stay mode-equal with the co-scheduled loop
+            s["shed_deadline"] += rtc["shed_deadline"]
+            s["faulted_requests"] += rtc["faulted_requests"]
         else:
             s["disagg"] = False
             s["handoffs"] = 0
@@ -3455,11 +3784,13 @@ class ServingEngine:
         vtpu_serving_tick_phase_seconds family."""
         return self._prof
 
-    def _retire(self, slot: int) -> None:
+    def _retire(self, slot: int, status: Optional[str] = None) -> None:
         req = self._slot_req[slot]
         if req is not None:
-            self.trace.record("retire", req.rid, slot)
-            req.out.put(None)
+            # terminal resolution: an explicit status (FAULTED, shutdown
+            # CANCELLED) wins; otherwise the request's own requested abort
+            # (cancel/shed) names the reason; a clean budget/eos end is OK
+            self._end_stream(req, status or req._abort or Status.OK, slot)
         self._slot_req[slot] = None
         self._slot_budget[slot] = 0
         self._slot_len[slot] = 0
@@ -3649,6 +3980,7 @@ class ServingEngine:
                 self._waiting.append(self._pending.get_nowait())
             except queue.Empty:
                 break
+        self._shed_deadlines()
         if self._swap_enabled:
             # overcommit housekeeping, all non-blocking: apply settled
             # parks, land READY swap-out transfers in the host pool (a
@@ -3679,6 +4011,12 @@ class ServingEngine:
             # _advance_admissions path above on subsequent ticks)
             budget = self._advance_resumes(budget)
         if self._disagg is not None:
+            # crash containment, worker domain: detect dead prefill
+            # workers, recover what they held (release + bounded-backoff
+            # re-queue or typed FAULTED), restart them, and re-admit
+            # retry entries whose backoff elapsed — all on THIS thread,
+            # the owner of every structure the recovery touches
+            self._disagg.watch()
             # role split: the loop never admits from the waiting line —
             # prefill workers own it; the loop only INSTALLS completed
             # handoffs (one fused table-row write per session, zero
@@ -3693,6 +4031,7 @@ class ServingEngine:
                 self._disagg.notify_work()
         else:
             admitted, _ = self._admit_waiting(budget)
+        self._shed_overload()
         for slot in range(self.serving.slots):
             req = self._slot_req[slot]
             if req is not None and req.cancelled:
@@ -3707,6 +4046,75 @@ class ServingEngine:
         self._prof.note("admission", time.perf_counter() - t0 - swap_s,
                         ticks=self._loop_k or 1)
         return admitted
+
+    def _shed_deadlines(self) -> None:
+        """Deadline enforcement at the tick head (the flush boundary).
+        A waiting request past its deadline is shed BEFORE admission —
+        atomically (WaitQueue.take), so a racing disagg worker claim and
+        this shed can never both own it. A live or mid-chunked-admission
+        request past its deadline is marked for abort; the cancel sweep
+        at the end of this same tick head retires it, delivering the
+        typed SHED_DEADLINE terminal through the exact machinery a
+        client cancel rides (shed and cancel stay idempotent against
+        each other by construction: whichever abort lands first names
+        the terminal)."""
+        if not self._deadlines_seen:
+            # no submit has ever carried a deadline: the sweep below
+            # would be pure per-tick overhead (a waiting-line snapshot +
+            # a slot scan) — keep the clean-engine cost at one attribute
+            # check, the same bar as the fault seams
+            return
+        now = time.monotonic_ns()
+        for req in self._waiting:
+            if (req.deadline_ns is not None and now > req.deadline_ns
+                    and not req.cancelled):
+                if self._waiting.take(req):
+                    self._stats["shed_deadline"] += 1
+                    self.trace.record(
+                        "shed", req.rid, -1,
+                        TERMINAL_CODES[Status.SHED_DEADLINE])
+                    self._end_stream(req, Status.SHED_DEADLINE)
+        live = [r for r in self._slot_req if r is not None]
+        live += [adm["req"] for adm in self._admitting.values()]
+        for req in live:
+            if (req.deadline_ns is not None and now > req.deadline_ns
+                    and req._abort is None):
+                req._abort = Status.SHED_DEADLINE
+                self._stats["shed_deadline"] += 1
+                self.trace.record("shed", req.rid, -1,
+                                  TERMINAL_CODES[Status.SHED_DEADLINE])
+
+    def _shed_overload(self) -> None:
+        """Overload shedding, AFTER this tick's admissions: whatever
+        still overflows shed_queue_depth is genuine excess (a burst that
+        free slots could absorb is never shed), and the pluggable
+        ShedPolicy picks the victims — lowest QoS first by default —
+        instead of the line growing without bound. Stale picks (claimed
+        or cancelled in the window) lose the atomic take and are skipped."""
+        depth = self.serving.shed_queue_depth
+        if not depth:
+            return
+        excess = len(self._waiting) - depth
+        if excess <= 0:
+            return
+        try:
+            victims = list(self._shed_policy.select(
+                list(self._waiting), excess))[:excess]
+        except Exception:
+            # a user-loaded policy program raising must not take the
+            # serving loop down with it (the same containment bar as a
+            # custom sample= callable): log, shed nothing this tick, and
+            # let the next tick head retry — the line stays bounded by
+            # retries, the engine stays alive
+            log.exception("shed policy %r raised; skipping this tick's "
+                          "overload shed", type(self._shed_policy).__name__)
+            return
+        for req in victims:
+            if self._waiting.take(req):
+                self._stats["shed_overload"] += 1
+                self.trace.record("shed", req.rid, -1,
+                                  TERMINAL_CODES[Status.SHED_OVERLOAD])
+                self._end_stream(req, Status.SHED_OVERLOAD)
 
     def _idle_wait(self, admitted: bool) -> None:
         """Nothing to decode and nothing in flight: block briefly on the
@@ -4002,8 +4410,11 @@ class ServingEngine:
                     # per-slot early-exit caps: remaining budget clamped to
                     # k — the device freezes the slot after its cap'th
                     # emission, so a flush can never overdraw a budget (or
-                    # the paged reservation denominated in it)
-                    pred = [min(rem[i], k) if i in live else 0
+                    # the paged reservation denominated in it). _loop_cap
+                    # is k unless the fetch watchdog degraded the engine
+                    # to per-token flushes (then 1: same executable, the
+                    # cap does the clamping).
+                    pred = [min(rem[i], self._loop_cap) if i in live else 0
                             for i in range(b)]
                     cap = jnp.asarray(pred, jnp.int32)
                     if self._use_kv_buckets:
@@ -4133,35 +4544,45 @@ class ServingEngine:
         for slot, req in enumerate(flush["reqs"]):
             if req is None or req is not self._slot_req[slot]:
                 continue
-            cnt = int(counts[slot])
-            if cnt < k:
-                # froze inside the loop: budget wall (cap < k) or eos
-                self._stats["loop_early_exits"] += 1
-            if cnt == 0:
-                continue
-            emitted = [int(t) for t in toks[slot, :cnt]]
-            # host/device reconciliation: mirror the device's length
-            # advance BEFORE any retire below, exactly like the spec path
-            self._slot_len[slot] += cnt
-            self._slot_budget[slot] -= cnt
-            span = max(now_ns - start_ns, 0)
-            for j, tok in enumerate(emitted):
-                ts = start_ns + ((j + 1) * span) // cnt
-                self.trace.record_at(ts, "token", req.rid, slot, 1)
-                # logprob BEFORE the queue put (see _emit)
-                if lps is not None:
-                    req.logprobs.append(float(lps[slot, j]))
-                req.out.put(tok)
-            self._stats["generated_tokens"] += cnt
-            if self._track_history:
-                self._history[slot].extend(emitted)
-            self._tokens[slot] = emitted[-1]
-            # one ITL gap per (slot, flush): the burst reaches the client
-            # in one delivery, so the user-visible ITL is the inter-flush
-            # gap — the spec-tick convention
-            self._note_itl(slot, now)
-            if self._slot_budget[slot] <= 0 or emitted[-1] == eos:
-                self._retire(slot)
+            try:
+                self._maybe_inject_dispatch()
+                cnt = int(counts[slot])
+                if cnt < k:
+                    # froze inside the loop: budget wall (cap < k) or eos
+                    # (or the watchdog's per-token degrade clamped the cap)
+                    self._stats["loop_early_exits"] += 1
+                if cnt == 0:
+                    continue
+                emitted = [int(t) for t in toks[slot, :cnt]]
+                # host/device reconciliation: mirror the device's length
+                # advance BEFORE any retire below, exactly like the spec
+                # path
+                self._slot_len[slot] += cnt
+                self._slot_budget[slot] -= cnt
+                span = max(now_ns - start_ns, 0)
+                for j, tok in enumerate(emitted):
+                    ts = start_ns + ((j + 1) * span) // cnt
+                    self.trace.record_at(ts, "token", req.rid, slot, 1)
+                    # logprob BEFORE the queue put (see _emit)
+                    if lps is not None:
+                        req.logprobs.append(float(lps[slot, j]))
+                    req.out.put(tok)
+                self._stats["generated_tokens"] += cnt
+                if self._track_history:
+                    self._history[slot].extend(emitted)
+                self._tokens[slot] = emitted[-1]
+                # one ITL gap per (slot, flush): the burst reaches the
+                # client in one delivery, so the user-visible ITL is the
+                # inter-flush gap — the spec-tick convention
+                self._note_itl(slot, now)
+                if self._slot_budget[slot] <= 0 or emitted[-1] == eos:
+                    self._retire(slot)
+            except Exception:
+                # crash containment, k-deep: one request's whole flush
+                # column dies with its slot — the flush and every other
+                # stream keep going (the PR-1 identity-check discipline
+                # applied to failures instead of recycles)
+                self._contain_fault(slot)
         self._last_flush_ns = now_ns
         self._prof.note("deliver", time.perf_counter() - t0, ticks=k)
         self._note_host_ms(extra_host_s + time.perf_counter() - t0)
@@ -4266,42 +4687,52 @@ class ServingEngine:
                 t0 = time.perf_counter()
                 emitted_total = 0
                 for slot in active_slots:
-                    emitted = [int(x) for x in pred[slot, : int(count[slot])]]
-                    # the device advanced this slot's cache length by
-                    # count[slot]; mirror it BEFORE any eos truncation so
-                    # host and device lengths can never diverge
-                    self._slot_len[slot] += int(count[slot])
-                    eos = self.serving.eos_token
-                    if eos in emitted:
-                        emitted = emitted[: emitted.index(eos) + 1]
-                    req = self._slot_req[slot]
-                    for tok in emitted:
-                        self.trace.record("token", req.rid, slot)
-                        req.out.put(tok)
-                    # acceptance accounting uses DELIVERED tokens (post-eos
-                    # truncation): the device's raw count includes tokens
-                    # past eos nobody receives
-                    emitted_total += len(emitted)
-                    # acceptance histogram: delivered tokens per (slot,
-                    # spec tick) — the measured distribution behind any
-                    # speedup claim (index 0 = slot emitted nothing usable)
-                    hist = self._stats["spec_emitted_hist"]
-                    bucket_i = min(len(emitted), len(hist) - 1)
-                    hist[bucket_i] += 1
-                    self._stats["generated_tokens"] += len(emitted)
-                    self._slot_budget[slot] -= len(emitted)
-                    self._history[slot].extend(emitted)
-                    if emitted:
-                        self._tokens[slot] = emitted[-1]
-                        # one gap per (slot, spec tick): the burst reaches
-                        # the client in one flush, so the user-visible ITL
-                        # is the inter-flush gap, not intra-burst zeros
-                        self._note_itl(slot, t0)
-                    if (
-                        self._slot_budget[slot] <= 0
-                        or (emitted and emitted[-1] == eos)
-                    ):
-                        self._retire(slot)
+                    try:
+                        self._maybe_inject_dispatch()
+                        emitted = [int(x)
+                                   for x in pred[slot, : int(count[slot])]]
+                        # the device advanced this slot's cache length by
+                        # count[slot]; mirror it BEFORE any eos truncation
+                        # so host and device lengths can never diverge
+                        self._slot_len[slot] += int(count[slot])
+                        eos = self.serving.eos_token
+                        if eos in emitted:
+                            emitted = emitted[: emitted.index(eos) + 1]
+                        req = self._slot_req[slot]
+                        for tok in emitted:
+                            self.trace.record("token", req.rid, slot)
+                            req.out.put(tok)
+                        # acceptance accounting uses DELIVERED tokens
+                        # (post-eos truncation): the device's raw count
+                        # includes tokens past eos nobody receives
+                        emitted_total += len(emitted)
+                        # acceptance histogram: delivered tokens per
+                        # (slot, spec tick) — the measured distribution
+                        # behind any speedup claim (index 0 = slot
+                        # emitted nothing usable)
+                        hist = self._stats["spec_emitted_hist"]
+                        bucket_i = min(len(emitted), len(hist) - 1)
+                        hist[bucket_i] += 1
+                        self._stats["generated_tokens"] += len(emitted)
+                        self._slot_budget[slot] -= len(emitted)
+                        self._history[slot].extend(emitted)
+                        if emitted:
+                            self._tokens[slot] = emitted[-1]
+                            # one gap per (slot, spec tick): the burst
+                            # reaches the client in one flush, so the
+                            # user-visible ITL is the inter-flush gap,
+                            # not intra-burst zeros
+                            self._note_itl(slot, t0)
+                        if (
+                            self._slot_budget[slot] <= 0
+                            or (emitted and emitted[-1] == eos)
+                        ):
+                            self._retire(slot)
+                    except Exception:
+                        # crash containment on the spec deliver path too:
+                        # one request's burst dies with its slot, the
+                        # verify tick and every other stream keep going
+                        self._contain_fault(slot)
                 self._stats["spec_ticks"] += 1
                 self._stats["spec_slot_ticks"] += len(active_slots)
                 self._stats["spec_emitted"] += emitted_total
@@ -4357,6 +4788,12 @@ class ServingEngine:
             logits = self._fetch(logits)
             t0 = time.perf_counter()
             for slot in active_slots:
-                self._emit(slot, self.sample(logits[slot]))
+                try:
+                    # the custom sampler runs INSIDE the containment: a
+                    # callable raising on one row faults one request,
+                    # never the loop serving everyone
+                    self._emit(slot, self.sample(logits[slot]))
+                except Exception:
+                    self._contain_fault(slot)
             self._prof.note("deliver", time.perf_counter() - t0)
             self._note_host_ms(disp_s + time.perf_counter() - t0)

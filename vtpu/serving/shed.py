@@ -1,0 +1,79 @@
+"""Pluggable overload-shedding policy for the serving engine's WaitQueue.
+
+Under sustained overload the only pre-PR-12 behavior was unbounded
+queueing: every submit joined the waiting line and aged there. The engine
+now bounds the line (``ServingConfig.shed_queue_depth``) and, when it
+overflows, asks a ShedPolicy WHICH waiters to shed with a typed
+``SHED_OVERLOAD`` terminal — the admission-side actuator of the ROADMAP's
+monitor->scheduler feedback loop, and (per gpu_ext's argument in
+PAPERS.md) a policy PROGRAM rather than a hardcoded heuristic: deployments
+load their own policy without forking the engine, exactly like the QoS
+knobs the PR-6 eviction order exposed.
+
+The contract is deliberately small: ``select(waiters, need)`` sees a
+snapshot of the live waiting line and returns the requests to shed, most
+shed-worthy first. The engine sheds at tick heads (so the decision always
+runs on the loop thread against a coherent snapshot) and tolerates a
+policy returning fewer or stale entries — a request that was claimed or
+cancelled in the window simply isn't shed.
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Iterable, List
+
+
+class ShedPolicy:
+    """Which waiters leave the line when it overflows. Implementations
+    must be pure decisions over the snapshot (no engine mutation): the
+    engine owns the actual shed — atomic ``WaitQueue.take`` per victim,
+    typed terminal delivery, counters, trace events."""
+
+    def select(self, waiters: List, need: int) -> Iterable:
+        """Return up to ``need`` requests to shed, most shed-worthy
+        first. ``waiters`` is a FIFO snapshot of live waiting Requests
+        (fields: priority, deadline_ns, t_submit_ns, tokens...)."""
+        raise NotImplementedError
+
+
+class PriorityDeadlineShedPolicy(ShedPolicy):
+    """The default: shed the lowest QoS ``priority`` first (the same axis
+    the PR-6 eviction policy spills on); within a tier, shed the waiter
+    whose deadline is nearest (it is the likeliest to miss anyway — a
+    deadline-less waiter has infinite slack and sheds last); among
+    deadline-less equals, shed the youngest (oldest-first service keeps
+    the FIFO promise to whoever has waited longest)."""
+
+    def select(self, waiters: List, need: int) -> Iterable:
+        order = sorted(
+            waiters,
+            key=lambda r: (
+                r.priority,
+                r.deadline_ns if r.deadline_ns is not None else float("inf"),
+                -r.t_submit_ns,
+            ),
+        )
+        return order[:need]
+
+
+def load_shed_policy(spec) -> ShedPolicy:
+    """Resolve ``ServingConfig.shed_policy``: None -> the default;
+    a ``"module:attr"`` string -> imported (class or instance — the
+    user-loadable policy-program hook); a class -> instantiated; anything
+    else is used as-is (must quack like ShedPolicy)."""
+    if spec is None:
+        return PriorityDeadlineShedPolicy()
+    if isinstance(spec, str):
+        mod, sep, attr = spec.partition(":")
+        if not sep or not attr:
+            raise ValueError(
+                f"shed_policy string must be 'module:attr', got {spec!r}")
+        obj = getattr(importlib.import_module(mod), attr)
+        spec = obj
+    if isinstance(spec, type):
+        spec = spec()
+    if not callable(getattr(spec, "select", None)):
+        raise ValueError(
+            f"shed_policy {spec!r} does not implement select(waiters, need)")
+    return spec
