@@ -4,8 +4,10 @@ Every recovery path PR 12 added — deadline/overload shedding, crash
 containment, swap-loss recompute, worker restart, watchdog degradation —
 is exercised here IN COMBINATION, over the traffic mixes that stress the
 seams: paged + int8 + overcommit park/evict/resume pressure (co-scheduled),
-disaggregated prefill/decode with a dying worker, and the multi-tick
-device loop under a stalling fetch. The schedule is deterministic (a
+disaggregated prefill/decode with a dying worker, the multi-tick
+device loop under a stalling fetch, and (ISSUE 13) live cross-engine
+migration whose source dies mid-transfer — the destination rebuilds the
+session from token history via recompute-on-fault. The schedule is deterministic (a
 seeded FaultPlan / explicit FaultSpecs — see vtpu/serving/faults), so the
 gates are exact, not statistical:
 
@@ -61,7 +63,7 @@ def main() -> None:
                     help="decode tokens per session")
     ap.add_argument("--page", type=int, default=8)
     ap.add_argument("--out", default=None,
-                    help="artifact path (default FAULTS_r14.json on full "
+                    help="artifact path (default FAULTS_r15.json on full "
                          "runs; quick runs only write when set)")
     a = ap.parse_args()
     waves = a.sessions or (2 if a.quick else 4)
@@ -73,7 +75,7 @@ def main() -> None:
 
     from vtpu.serving import (
         DisaggConfig, FaultPlan, FaultSpec, ServingConfig, ServingEngine,
-        Status, Terminal)
+        Status, Terminal, migrate)
     from vtpu.models import ModelConfig, init_params
 
     # tiny on purpose (the overcommit/paged bench discipline): the CPU
@@ -427,12 +429,106 @@ def main() -> None:
     })
     log(f"device_loop: pass={loop_pass} gates={gates}")
 
+    # -------------------------------------------------------------- migrate
+    log("=== scenario: migrate (source dies mid-transfer) ===")
+    n_mig = 2 if a.quick else 3
+
+    def migrate_serving(faults=None):
+        return ServingConfig(
+            slots=n_mig, prefill_buckets=(16,), max_new_tokens=a.max_new,
+            prefill_chunk=16, kv_page=a.page, kv_swap=8, faults=faults)
+
+    ref_eng = ServingEngine(params16, cfg_bf16, migrate_serving())
+    ref_eng.start()
+    try:
+        ref_reqs = [ref_eng.submit(prompt(700 + j),
+                                   max_new_tokens=a.max_new)
+                    for j in range(n_mig)]
+        ref_streams = [drain(r) for r in ref_reqs]
+    finally:
+        ref_eng.stop()
+    # the FIRST migration's source dies after the metadata handshake (the
+    # kill-source-mid-migration case): the destination rebuilds that
+    # session from token history; the rest transfer resident
+    plan_m = FaultPlan([FaultSpec("migrate_src_death", at=0)])
+    src = ServingEngine(params16, cfg_bf16, migrate_serving(faults=plan_m))
+    dst = ServingEngine(params16, cfg_bf16, migrate_serving())
+    src.start()
+    dst.start()
+    try:
+        reqs, streams, paths = [], [], []
+        for j in range(n_mig):
+            req = src.submit(prompt(700 + j), max_new_tokens=a.max_new)
+            reqs.append(req)
+            streams.append(take(req, 2))
+        # park everyone FIRST: a parked session cannot finish, so the
+        # extraction order (and which session the src-death seam hits)
+        # is deterministic regardless of box speed vs the tiny budgets
+        for req in reqs:
+            src.park(req)
+        t0 = time.perf_counter()
+        while src.stats()["parked_sessions"] < n_mig:
+            if time.perf_counter() - t0 > 60:
+                break
+            time.sleep(0.002)
+        for j, req in enumerate(reqs):
+            rep = migrate(req, src, dst)
+            paths.append(rep["path"])
+        for j, req in enumerate(reqs):
+            streams[j] += drain(req)
+        settled_src = wait_drained(src)
+        settled_dst = wait_drained(dst)
+        stats_src, stats_dst = src.stats(), dst.stats()
+    finally:
+        src.stop()
+        dst.stop()
+    gates = {
+        "all_terminal": all(r.status is not None for r in reqs),
+        "all_ok": all(r.status == Status.OK for r in reqs),
+        "token_equal": streams == ref_streams,
+        "src_death_recovered": paths[0] == "recompute"
+                                and stats_dst["migrate_recomputes"] >= 1,
+        # everyone parked before the first transfer, so the rest move
+        # resident deterministically (a parked session cannot finish)
+        "rest_resident": all(p == "resident" for p in paths[1:]),
+        "zero_extra_copies": stats_src["migration_copies"] == 0
+                              and stats_dst["migration_copies"] == 0,
+        "zero_leaks": (
+            settled_src["kv_pool_free"] == settled_src["kv_pool_blocks"]
+            and settled_src["swap_host_free"]
+            == settled_src["swap_host_blocks"]
+            and settled_src["active_slots"] == 0
+            and settled_src["parked_sessions"] == 0
+            and settled_dst["kv_pool_free"] == settled_dst["kv_pool_blocks"]
+            and settled_dst["active_slots"] == 0
+            and settled_dst["parked_sessions"] == 0),
+        "tick_contract": (
+            stats_src["device_gets_per_tick"] in (None, 1.0)
+            and stats_dst["device_gets_per_tick"] == 1.0),
+        "seams_fired": (
+            plan_m.snapshot()["injected"]["migrate_src_death"] == 1),
+    }
+    mig_pass = all(gates.values())
+    all_pass &= mig_pass
+    artifact["scenarios"].append({
+        "name": "migrate", "pass": mig_pass, "gates": gates,
+        "paths": paths,
+        "fault_plan": plan_m.snapshot(),
+        "stats": {key: stats_src[key] for key in (
+            "migrations_out", "migrate_out_bytes", "migration_copies",
+            "faults_injected")} | {
+            "dst_" + key: stats_dst[key] for key in (
+                "migrations_in", "migrate_in_bytes", "migrate_recomputes",
+                "fault_recomputes", "generated_tokens")},
+    })
+    log(f"migrate: pass={mig_pass} gates={gates}")
+
     # ------------------------------------------------------------ artifact
     artifact["pass"] = bool(all_pass)
     injected_total = sum(
         sc["stats"]["faults_injected"] for sc in artifact["scenarios"])
     artifact["faults_injected_total"] = injected_total
-    out_path = a.out or (None if a.quick else "FAULTS_r14.json")
+    out_path = a.out or (None if a.quick else "FAULTS_r15.json")
     if out_path:
         Path(out_path).write_text(json.dumps(artifact, indent=2) + "\n")
         log(f"artifact -> {out_path}")

@@ -95,18 +95,27 @@ def _engine_leaks(eng) -> list:
             f"{eng._swap_host_blocks}")
     if eng._admitting:
         errs.append(f"admissions still in flight: {sorted(eng._admitting)}")
+    lq = getattr(eng, "_lifecycle_q", None)
+    if lq is not None and not lq.empty():
+        # a migrate ticket left unanswered would strand its caller; the
+        # engine's shutdown sweep must have failed every outstanding one
+        errs.append(f"{lq.qsize()} lifecycle commands unserved after stop")
     return errs
 
 
 @pytest.fixture(autouse=True)
 def leak_check(request):
     """Failure-domain invariant net over EVERY engine-constructing test
-    (ISSUE 12 satellite): each ServingEngine built during the test is
-    stopped at teardown and checked for leaks — allocator free list, host
-    swap pool, slot occupancy, parked set. A recovery path (shed, fault
-    containment, worker restart, swap loss) that forgets to release what
-    a dead request held fails HERE, in whatever suite happened to drive
-    it, not only in the dedicated fault tests."""
+    (ISSUE 12 satellite; extended by ISSUE 13): each ServingEngine built
+    during the test is stopped at teardown and checked for leaks —
+    allocator free list, host swap pool, slot occupancy, parked set,
+    unserved lifecycle tickets. EVERY engine the test built is audited —
+    for a migration test that means the source AND the destination, so a
+    transfer path that leaks blocks on either side fails here. A recovery
+    path (shed, fault containment, worker restart, swap loss, migration
+    fallback) that forgets to release what a dead request held fails
+    HERE, in whatever suite happened to drive it, not only in the
+    dedicated fault tests."""
     try:
         from vtpu.serving import engine as _engine_mod
     except Exception:  # minimal environments without the serving deps

@@ -78,6 +78,8 @@ QUICK_RUNS = {
             "--slots", "2", "--max-new", "8", "--requests", "4"],
     "chaos": [str(ROOT / "benchmarks" / "chaos_bench.py"), "--quick",
               "--sessions", "2", "--max-new", "10"],
+    "migrate": [str(ROOT / "benchmarks" / "migrate_bench.py"), "--quick",
+                "--sessions", "2", "--max-new", "8"],
 }
 
 
@@ -89,14 +91,14 @@ QUICK_WAVES = (
     ("paged_kv_tp2", "overcommit", "decode"),
     ("disagg", "paged_kv", "obs"),
     ("paged_attn", "prefill", "decode_loop_k"),
-    ("chaos",),
+    ("chaos", "migrate"),
 )
 
 # runs that force a multi-virtual-device platform stay OFF the shared
 # compilation cache: a cache-deserialized CPU executable with collectives
 # has been observed to stall its cross_module rendezvous under concurrent
 # load (the single-device runs cache fine and are the bulk of the cost)
-MULTI_DEVICE_RUNS = {"paged_kv_tp2", "decode_loop_k"}
+MULTI_DEVICE_RUNS = {"paged_kv_tp2", "decode_loop_k", "migrate"}
 
 
 def _env_for(name):
@@ -121,6 +123,7 @@ TEST_TO_RUN = {
     "test_disagg_bench_quick_small_iteration": "disagg",
     "test_obs_bench_quick_small_iteration": "obs",
     "test_chaos_bench_quick_small_iteration": "chaos",
+    "test_migrate_bench_quick_small_iteration": "migrate",
 }
 
 
@@ -460,7 +463,7 @@ def test_chaos_bench_quick_small_iteration(quick):
     assert artifact["metric"] == "chaos_soak_deterministic_gates"
     assert artifact["pass"] is True
     scenarios = {s["name"]: s for s in artifact["scenarios"]}
-    assert set(scenarios) == {"core", "disagg", "device_loop"}
+    assert set(scenarios) == {"core", "disagg", "device_loop", "migrate"}
     for sc in scenarios.values():
         assert sc["pass"], sc
         assert all(sc["gates"].values()), sc["gates"]
@@ -472,5 +475,54 @@ def test_chaos_bench_quick_small_iteration(quick):
     assert scenarios["disagg"]["stats"]["worker_restarts"] == 1
     assert scenarios["disagg"]["stats"]["handoff_copies"] == 0
     assert scenarios["device_loop"]["stats"]["watchdog_degrades"] >= 1
+    assert scenarios["migrate"]["stats"]["migration_copies"] == 0
+    assert scenarios["migrate"]["stats"]["dst_migrate_recomputes"] >= 1
     assert artifact["faults_injected_total"] >= 4
     assert summary["summary"] and summary["verdict"] == "pass"
+
+
+def test_migrate_bench_help_parses():
+    r = _run([str(ROOT / "benchmarks" / "migrate_bench.py"), "--help"])
+    assert r.returncode == 0
+    assert "--quick" in r.stdout and "--blackout-ms" in r.stdout
+
+
+def test_migrate_bench_quick_small_iteration(quick):
+    """migrate_bench --quick at smoke scale (ISSUE 13 acceptance): every
+    deterministic gate holds — migrated streams token-equal with the
+    stay-put run for exact/int8/tp2, drain leaves the source EMPTY (pool
+    free == capacity, nothing live/parked/waiting, admission refused)
+    with every stream completing on the destination, the migration copy
+    counter at 0 beyond the swap-tier D2H/H2D pair on BOTH engines,
+    blackout p99 reported and under its bound, and both migrate_* fault
+    seams firing with a typed terminal ONLY on the one configured-
+    unrebuildable session."""
+    r = quick["migrate"]
+    assert r.returncode == 0, r.stderr
+    lines = [ln for ln in r.stdout.splitlines() if ln.strip()]
+    artifact = json.loads(lines[0])
+    summary = json.loads(lines[-1])
+    assert artifact["metric"] == "migrate_deterministic_gates"
+    assert artifact["pass"] is True
+    scenarios = {s["name"]: s for s in artifact["scenarios"]}
+    assert {"token_equal[exact]", "token_equal[int8]", "drain",
+            "crash_recovery"} <= set(scenarios)
+    assert "token_equal[tp2]" in scenarios  # forced 2 virtual devices
+    for sc in scenarios.values():
+        assert sc["pass"], sc
+        assert all(sc["gates"].values()), sc["gates"]
+    for name in ("token_equal[exact]", "token_equal[int8]",
+                 "token_equal[tp2]"):
+        assert scenarios[name]["gates"]["zero_extra_copies"]
+        assert scenarios[name]["migrate_out_bytes"] > 0
+        assert (scenarios[name]["migrate_out_bytes"]
+                == scenarios[name]["migrate_in_bytes"])
+    assert scenarios["drain"]["gates"]["src_empty"]
+    assert scenarios["drain"]["gates"]["admission_refused"]
+    assert scenarios["crash_recovery"]["gates"]["seams_fired"]
+    assert scenarios["crash_recovery"]["paths"][-1] == "faulted"
+    bl = artifact["blackout_ms"]
+    assert bl["samples"] >= 2 and bl["p99"] is not None
+    assert bl["p99"] <= bl["bound"] and bl["pass"]
+    assert summary["summary"] and summary["verdict"] == "pass"
+    assert summary["unit"] == "blackout_p99_ms"

@@ -571,6 +571,159 @@ def test_watchdog_reroutes_paged_attn_to_gather(params):
     assert eng._paged_attn == "gather"
 
 
+def test_watchdog_recovers_device_loop_after_grace_window(params):
+    """ISSUE 13 satellite: the full degrade->recover cycle on rung 1.
+    A stalled fetch clamps the k-tick device loop to per-token flushes;
+    once fetch latency stays under the watchdog for the
+    fetch_watchdog_recover_ms grace window, the ladder un-degrades —
+    the flush cap returns to k, the recovery is counted and traced, and
+    the rung re-arms (a relapse can trip it again). Streams token-equal
+    throughout (both transitions are lossless by contract)."""
+    prompts = [_prompt(85, 5), _prompt(86, 5)]
+    ref_eng = ServingEngine(params, CFG, _serving())
+    ref_eng.start()
+    try:
+        ref = [list(ref_eng.submit(p, max_new_tokens=12).stream())
+               for p in prompts]
+    finally:
+        ref_eng.stop()
+    plan = FaultPlan([FaultSpec("delayed_fetch", at=1, arg=0.05)])
+    eng = ServingEngine(params, CFG, _serving(
+        decode_loop_k=4, fetch_watchdog_ms=10.0,
+        fetch_watchdog_recover_ms=1.0, faults=plan))
+    eng.start()
+    try:
+        # two sequential sessions: the first trips the degrade, and the
+        # healthy fetches across both carry the recovery streak past the
+        # (tiny) grace window
+        streams = [list(eng.submit(p, max_new_tokens=12).stream())
+                   for p in prompts]
+        stats = eng.stats()
+        events = [e["event"] for e in eng.trace.events()]
+    finally:
+        eng.stop()
+    assert streams == ref
+    assert stats["watchdog_degrades"] == 1
+    assert stats["watchdog_recoveries"] == 1
+    assert "degrade" in events and "recover" in events
+    assert eng._loop_cap == eng._loop_k == 4   # the clamp lifted
+    assert eng._degrade_level == 0
+    assert "loop_k1" in eng._degrade_rungs     # re-armed for a relapse
+
+
+def test_watchdog_recovery_restores_paged_attn_route(params):
+    """The rung-2 recovery: a forced-kernel paged engine degraded to the
+    gather route re-lowers BACK to the kernel once latency recovers —
+    kernel ticks resume after the recovery, streams token-equal across
+    both re-lowers."""
+    prompts = [_prompt(87, 5), _prompt(88, 5)]
+    serving_kw = dict(kv_page=8, max_new_tokens=12)
+    ref_eng = ServingEngine(params, CFG, _serving(
+        paged_attn="gather", **serving_kw))
+    ref_eng.start()
+    try:
+        ref = [list(ref_eng.submit(p, max_new_tokens=12).stream())
+               for p in prompts]
+    finally:
+        ref_eng.stop()
+    plan = FaultPlan([FaultSpec("delayed_fetch", at=1, arg=0.05)])
+    eng = ServingEngine(params, CFG, _serving(
+        paged_attn="kernel", fetch_watchdog_ms=10.0,
+        fetch_watchdog_recover_ms=1.0, faults=plan, **serving_kw))
+    eng.start()
+    try:
+        streams = [list(eng.submit(p, max_new_tokens=12).stream())
+                   for p in prompts]
+        stats = eng.stats()
+    finally:
+        eng.stop()
+    assert streams == ref
+    assert stats["watchdog_degrades"] == 1
+    assert stats["watchdog_recoveries"] == 1
+    assert stats["paged_attn_gather_ticks"] > 0   # while degraded
+    assert eng._paged_attn == "kernel"            # the route came back
+
+
+# -------------------------------------------- shed policy engine signals
+
+
+def test_shed_policy_receives_engine_signals(params):
+    """ISSUE 13 satellite: a three-argument policy receives the
+    EngineSignals pressure snapshot (queue depth, pool free/HWM, parked
+    sessions, prefill backlog) so overload victims can be chosen by
+    MEMORY pressure — here, the longest-prompt waiter sheds first when
+    the pool is tight."""
+    from vtpu.serving import EngineSignals
+
+    seen = []
+
+    class MemoryPressurePolicy(ShedPolicy):
+        def select(self, waiters, need, signals=None):
+            seen.append(signals)
+            # memory-pressure order: biggest worst-case page need first
+            return sorted(
+                waiters, key=lambda r: -int(r.tokens.shape[0]))[:need]
+
+    # white-box tick driving (the _tick_head discipline the overcommit
+    # suite uses): a started engine this small drains its streams faster
+    # than a burst can overflow the line, so the overload is staged
+    # deterministically between two manual tick heads instead
+    eng = ServingEngine(params, CFG, _serving(
+        slots=1, kv_page=8, kv_swap=4, prefill_chunk=8,
+        prefill_buckets=(16,), shed_queue_depth=1,
+        shed_policy=MemoryPressurePolicy))
+    try:
+        live = eng.submit(_prompt(90, 5), max_new_tokens=8)
+        eng._tick_head()  # live takes the only slot
+        assert eng._slot_req[0] is live
+        short = eng.submit(_prompt(91, 4), max_new_tokens=2)
+        long_ = eng.submit(_prompt(92, 14), max_new_tokens=2)
+        eng._tick_head()  # line overflows depth 1: the policy picks
+        assert eng._stats["shed_overload"] == 1
+    finally:
+        eng.stop()
+    # the longest waiter shed (memory pressure), the short one survived
+    # to the line (the stop ends it CANCELLED, never SHED)
+    assert long_.status == Status.SHED_OVERLOAD
+    assert short.status == Status.CANCELLED
+    assert seen and all(s is not None for s in seen)
+    sig = seen[0]
+    assert sig.queue_depth == 2
+    assert sig.active_slots == 1
+    assert sig.pool_free is not None and sig.pool_used_hwm is not None
+    assert sig.parked_sessions == 0
+    assert sig.now_ns > 0
+
+
+def test_legacy_two_arg_shed_policy_still_works(params):
+    """Back-compat pin: a policy program written against the PR-11
+    two-argument select signature keeps working — the engine detects the
+    arity at construction and omits the signals. Default policy behavior
+    is unchanged (signals are delivered but ignored)."""
+
+    class LegacyPolicy:
+        def select(self, waiters, need):
+            return sorted(waiters, key=lambda r: r.priority)[:need]
+
+    from vtpu.serving.shed import accepts_signals
+
+    assert accepts_signals(LegacyPolicy()) is False
+    assert accepts_signals(PriorityDeadlineShedPolicy()) is True
+    eng = ServingEngine(params, CFG, _serving(
+        slots=1, shed_queue_depth=1, shed_policy=LegacyPolicy))
+    try:
+        live = eng.submit(_prompt(93, 5), max_new_tokens=8)
+        eng._tick_head()  # live takes the only slot
+        keep = eng.submit(_prompt(94, 5), max_new_tokens=2, priority=5)
+        drop = eng.submit(_prompt(95, 5), max_new_tokens=2, priority=0)
+        eng._tick_head()  # overflow: the legacy policy sheds priority 0
+        assert eng._stats["shed_overload"] == 1
+    finally:
+        eng.stop()
+    assert drop.status == Status.SHED_OVERLOAD
+    assert keep.status == Status.CANCELLED  # survived to the stop
+
+
 # ------------------------------------------------------- FaultPlan unit
 
 

@@ -104,8 +104,28 @@ COUNTERS = {
                         "Dead prefill workers the supervisor replaced"),
     "watchdog_degrades": ("watchdog_degrades",
                           "Fetch-watchdog degradation-ladder steps"),
+    "watchdog_recoveries": ("watchdog_recoveries",
+                            "Watchdog ladder rungs restored after the "
+                            "recovery grace window"),
     "faults_injected": ("faults_injected",
                         "Deterministic FaultPlan injections fired"),
+    "migrations_out": ("migrations_out",
+                       "Sessions extracted by live cross-engine migration"),
+    "migrations_in": ("migrations_in",
+                      "Sessions installed by live cross-engine migration"),
+    "migrate_out_bytes": ("migrate_out_bytes",
+                          "KV payload bytes shipped by outbound migrations"),
+    "migrate_in_bytes": ("migrate_in_bytes",
+                         "KV payload bytes landed by inbound migrations"),
+    "migration_copies": ("migration_copies",
+                         "Device copies by the migration path beyond the "
+                         "staging D2H/H2D pair (contract: 0)"),
+    "migrate_recomputes": ("migrate_recomputes",
+                           "Migrations installed payload-less, rebuilt "
+                           "via the recompute-on-fault prefill path"),
+    "migrate_failures": ("migrate_failures",
+                         "Migrations that could neither transfer nor "
+                         "rebuild (typed FAULTED terminals)"),
 }
 
 # stats() key -> (family suffix, help, scale). Point-in-time gauges; a
@@ -166,6 +186,8 @@ GAUGES = {
     "paged": ("paged", "1 when the KV cache is a paged pool", 1),
     "disagg": ("disagg",
                "1 when prefill/decode are disaggregated roles", 1),
+    "draining": ("draining",
+                 "1 while admission is closed for a drain/redeploy", 1),
     "prefill_backlog": ("prefill_backlog",
                         "Requests queued or mid-prefill on the worker side",
                         1),

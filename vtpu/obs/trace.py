@@ -81,6 +81,12 @@ EVENT_KINDS = (
                        # loop-thread supervisor (slot field: worker id)
     "degrade",         # the fetch watchdog stepped the degradation ladder
                        # (val: ladder level after the step)
+    "recover",         # the watchdog ladder re-escalated one rung after
+                       # the recovery grace window (val: level after)
+    "migrate_out",     # session extracted from this engine for a live
+                       # cross-engine migration (val: pages shipped)
+    "migrate_in",      # session installed into this engine's parked set
+                       # by a migration (val: pages; resume continues it)
 )
 
 # Typed terminal status -> the small int the retire/shed events carry in
@@ -119,6 +125,15 @@ SWAP_RESTORE_SEQUENCE = (
 DROP_RESTORE_SEQUENCE = (
     "submit", "admit", "first_token", "token", "park", "evict", "resume",
     "fault_recompute", "token", "retire")
+
+# Live migration splits one session's lifecycle across TWO engines' traces
+# (the destination assigns a fresh rid at install): the source trace ends
+# at migrate_out, the destination trace starts at migrate_in and carries
+# the stream to its retire. Single-sourced so tests/test_migrate.py and
+# benchmarks/migrate_bench.py assert the same handshake.
+MIGRATE_SRC_SEQUENCE = (
+    "submit", "admit", "first_token", "token", "park", "migrate_out")
+MIGRATE_DST_SEQUENCE = ("migrate_in", "resume", "token", "retire")
 
 
 def subsequence(needle, haystack) -> bool:
@@ -273,6 +288,7 @@ class RequestTrace:
                     "prefill_start_ns": None, "handoff_ns": None,
                     "pool_install_ns": None, "handoffs": 0,
                     "sheds": 0, "faults": 0, "worker_restarts": 0,
+                    "migrations": 0,
                     "terminal": None,
                     "_last_tok_ns": None, "_park_ns": None,
                     "_resume_ns": None,
@@ -322,6 +338,14 @@ class RequestTrace:
                 s["_resume_ns"] = ts
             elif event == "shed":
                 s["sheds"] += 1
+            elif event in ("migrate_out", "migrate_in"):
+                # a migrated-out session leaves this engine parked: its
+                # parked window closes here (the stream continues under a
+                # fresh rid on the destination's trace)
+                if s["_park_ns"] is not None:
+                    s["parked_ms"] += (ts - s["_park_ns"]) / 1e6
+                    s["_park_ns"] = None
+                s["migrations"] += 1
             elif event == "fault":
                 s["faults"] += 1
             elif event == "worker_restart":

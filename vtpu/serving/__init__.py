@@ -14,13 +14,20 @@ from vtpu.serving.engine import (
     prefill_into_slots,
 )
 from vtpu.serving.faults import FaultPlan, FaultSpec
-from vtpu.serving.shed import PriorityDeadlineShedPolicy, ShedPolicy
+from vtpu.serving.migrate import MigrationError, drain_engine, migrate
+from vtpu.serving.shed import (
+    EngineSignals,
+    PriorityDeadlineShedPolicy,
+    ShedPolicy,
+)
 
 __all__ = [
     "BlockAllocator",
     "DisaggConfig",
+    "EngineSignals",
     "FaultPlan",
     "FaultSpec",
+    "MigrationError",
     "PriorityDeadlineShedPolicy",
     "Request",
     "ServingConfig",
@@ -30,6 +37,8 @@ __all__ = [
     "Terminal",
     "WaitQueue",
     "batched_decode_step",
+    "drain_engine",
+    "migrate",
     "prefill_into_slot",
     "prefill_into_slots",
 ]

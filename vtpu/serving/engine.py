@@ -36,7 +36,7 @@ from vtpu.obs.tickprof import TickProfiler
 from vtpu.obs.trace import RequestTrace, TERMINAL_CODES, pct
 from vtpu.ops.decode_attn import paged_attn_route
 from vtpu.serving.faults import FaultInjected, FaultPlan
-from vtpu.serving.shed import load_shed_policy
+from vtpu.serving.shed import EngineSignals, accepts_signals, load_shed_policy
 
 from vtpu.models.transformer import (
     ModelConfig,
@@ -276,6 +276,14 @@ class ServingConfig:
     # one sanctioned breach of the warm-executables invariant: the
     # engine is already in a failure mode).
     fetch_watchdog_ms: float = 0.0
+    # Watchdog RE-ESCALATION grace window: once fetch latency has stayed
+    # under fetch_watchdog_ms continuously for this many ms, the ladder
+    # un-degrades one rung (2->1->0: restore the paged_attn route, then
+    # decode_loop_k) — a transient device stall should not leave the
+    # engine gather-routed and per-token-flushed forever. Each further
+    # rung needs its own full grace window, and any stalled fetch resets
+    # the clock. 0 = degradation is one-way (the PR-11 behavior).
+    fetch_watchdog_recover_ms: float = 0.0
     # Disagg worker-death recovery: a request whose prefill worker died
     # mid-claim is re-queued with exponential backoff up to this many
     # retries, then terminates FAULTED. (Worker restarts themselves are
@@ -1525,19 +1533,18 @@ class ServingEngine:
             # snapshot (the async-D2H source) / scatter W staged blocks
             # back into the pool (the async-H2D sink); ids pad with the
             # null block 0, whose reads are always masked and whose writes
-            # are the established junk sink. kv_swap=0 (recompute-only
-            # tier) can never spill or swap in, so it skips both compiles.
-            if self._swap_host_blocks:
-                self._swap_gather = jax.jit(swap_page_gather(model))
-                self._swap_scatter = jax.jit(
-                    swap_page_scatter(model), donate_argnums=(0,))
-            else:
-                self._swap_gather = None
-                self._swap_scatter = None
+            # are the established junk sink. Compiled for EVERY swap tier
+            # including kv_swap=0 (which can never spill or swap in): the
+            # cross-engine migration path (vtpu/serving/migrate) snapshots
+            # and installs block payloads through this same staging pair,
+            # host-tier or not.
+            self._swap_gather = jax.jit(swap_page_gather(model))
+            self._swap_scatter = jax.jit(
+                swap_page_scatter(model), donate_argnums=(0,))
             # an explicitly-passed adapter carries its own mesh; the ctor
             # arg only covers the default-constructed transformer
             mesh = getattr(model, "mesh", mesh)
-            if mesh is not None and self._swap_host_blocks:
+            if mesh is not None:
                 from vtpu.parallel.sharding import head_sharding
 
                 # H2D staging lands PRE-SHARDED on the head axis, so the
@@ -1690,7 +1697,24 @@ class ServingEngine:
                        # FaultPlan's own count) is added by stats().
                        "shed_deadline": 0, "shed_overload": 0,
                        "faulted_requests": 0, "worker_restarts": 0,
-                       "watchdog_degrades": 0}
+                       "watchdog_degrades": 0,
+                       # watchdog ladder re-escalation: rungs restored
+                       # after the recovery grace window
+                       # (fetch_watchdog_recover_ms)
+                       "watchdog_recoveries": 0,
+                       # live session migration (vtpu/serving/migrate):
+                       # sessions extracted from / installed into this
+                       # engine, the D2H/H2D payload traffic, device
+                       # copies the migration path performed beyond the
+                       # staging pair (contract: 0 — the handoff_copies
+                       # bar applied across engines), sessions installed
+                       # payload-less that will rebuild via the
+                       # recompute-on-fault prefill path, and migrations
+                       # that could neither transfer nor rebuild
+                       "migrations_out": 0, "migrations_in": 0,
+                       "migrate_out_bytes": 0, "migrate_in_bytes": 0,
+                       "migration_copies": 0, "migrate_recomputes": 0,
+                       "migrate_failures": 0}
         # per-slot token history (prompt + emitted) is maintained for
         # speculation drafts AND for overcommit (a parked session's cache
         # contents must be recomputable from tokens when its pages fault)
@@ -1776,6 +1800,10 @@ class ServingEngine:
                 f"shed_queue_depth must be >= 0, got "
                 f"{serving.shed_queue_depth}")
         self._shed_policy = load_shed_policy(serving.shed_policy)
+        # signature resolved ONCE: policies with a third parameter receive
+        # the EngineSignals pressure snapshot, legacy two-argument policy
+        # programs keep working unchanged
+        self._shed_signals = accepts_signals(self._shed_policy)
         # fetch-watchdog degradation ladder: each trip applies the next
         # APPLICABLE rung — (1) clamp the k-tick device loop to one token
         # per flush (the executable is unchanged; the per-slot cap does
@@ -1794,6 +1822,16 @@ class ServingEngine:
         if self._paged and self._paged_attn != "gather":
             self._degrade_rungs.append("paged_gather")
         self._degrade_level = 0
+        # re-escalation state: the rungs currently APPLIED (popped back in
+        # LIFO order by _recover_watchdog), the route to restore, and the
+        # start of the current healthy-fetch streak (None = no streak)
+        self._applied_rungs: list[str] = []
+        self._paged_attn_orig = self._paged_attn
+        self._healthy_since: Optional[float] = None
+        # drain/migration: admission closes while the engine evacuates its
+        # sessions to a peer (ServingEngine.drain) — submit() then raises
+        # instead of queueing a stream the engine will never serve
+        self._draining = False
 
     # ------------------------------------------------------------------ API
 
@@ -2030,6 +2068,14 @@ class ServingEngine:
             raise ValueError(f"deadline_ms must be >= 0, got {deadline_ms}")
         if self._stop.is_set():
             raise RuntimeError("ServingEngine is stopped")
+        if self._draining:
+            # drain() closed admission: this engine is evacuating its
+            # sessions to a peer and will never serve a new stream —
+            # failing fast here is what lets a fleet router retarget the
+            # submit instead of queueing it into a dead end
+            raise RuntimeError(
+                "ServingEngine is draining (admission closed); submit to "
+                "the drain destination instead")
         if self._thread is None:
             # legal (requests queue until start()) but a classic trap: a
             # caller that then blocks in stream() waits forever with no
@@ -2163,6 +2209,8 @@ class ServingEngine:
                         "degradation ladder exhausted", stalled_s * 1e3)
             return
         rung = self._degrade_rungs.pop(0)
+        self._applied_rungs.append(rung)
+        self._healthy_since = None  # a recovery streak ends at any stall
         self._degrade_level += 1
         self._stats["watchdog_degrades"] += 1
         self.trace.record("degrade", -1, -1, self._degrade_level)
@@ -2195,6 +2243,43 @@ class ServingEngine:
                         "degrading paged_attn to the gather route",
                         stalled_s * 1e3)
 
+    def _recover_watchdog(self) -> None:
+        """Un-degrade ONE rung after fetch latency has stayed healthy for
+        the fetch_watchdog_recover_ms grace window (2->1->0, LIFO over the
+        applied rungs — the last degradation undoes first). Each restored
+        rung goes back onto the ladder head so a relapse re-trips it in
+        the original order. Restoring the paged_attn route pays the same
+        mid-serving re-lower the degrade paid — both transitions are
+        token-equal routes by contract, so recovery is lossless exactly
+        like degradation was."""
+        if not self._applied_rungs:
+            return
+        rung = self._applied_rungs.pop()
+        self._degrade_rungs.insert(0, rung)
+        self._degrade_level -= 1
+        self._stats["watchdog_recoveries"] += 1
+        self.trace.record("recover", -1, -1, self._degrade_level)
+        if rung == "loop_k1":
+            # lift the per-slot flush cap back to the configured k: the
+            # k-tick executable never left, so this is zero recompiles —
+            # the exact inverse of the degrade
+            self._loop_cap = self._loop_k
+            log.warning("fetch watchdog: latency recovered — restoring "
+                        "decode_loop_k=%d flushes", self._loop_k)
+        elif rung == "paged_gather":
+            self._paged_attn = self._paged_attn_orig
+            if hasattr(self.model, "paged_attn"):
+                self.model.paged_attn = self._paged_attn_orig
+            for fn in (self._decode_loop, self._decode_sampled,
+                       self._decode, self._spec):
+                if fn is not None:
+                    try:
+                        fn.clear_cache()
+                    except AttributeError:
+                        pass
+            log.warning("fetch watchdog: latency recovered — restoring "
+                        "paged_attn=%r route", self._paged_attn_orig)
+
     def park(self, req: Request) -> None:
         """Take a live request out of the decode batch without ending its
         stream: token production pauses, the slot frees for other traffic,
@@ -2224,6 +2309,23 @@ class ServingEngine:
             raise ValueError("resume() requires ServingConfig.kv_swap")
         self._lifecycle_q.put(("resume", req))
         self._wake.set()
+
+    def drain(self, dst: "ServingEngine", timeout: float = 120.0) -> dict:
+        """Evacuate EVERY session this engine holds — live slots, parked,
+        waiting, mid-admission, worker-owned — onto *dst* via live
+        migration, so the engine can be redeployed without dropping a
+        stream. Admission closes first (submit() raises for the rest of
+        this engine's life); each session parks at its flush boundary,
+        moves as a park-shaped entry (one D2H/H2D staging pair, zero
+        extra copies), and resumes on the destination at exactly its next
+        token. Sessions the caller explicitly abandoned (cancel()) retire
+        here with their typed terminal — drain itself never ends a
+        stream. Returns the migration report
+        ({"migrated", "completed", "ms"}); raises MigrationError if the
+        evacuation cannot finish inside *timeout*."""
+        from vtpu.serving.migrate import drain_engine
+
+        return drain_engine(self, dst, timeout=timeout)
 
     def start(self) -> None:
         self._thread = threading.Thread(target=self._loop, daemon=True)
@@ -2288,6 +2390,17 @@ class ServingEngine:
             except queue.Empty:
                 break
             self._end_stream(req, req._abort or Status.CANCELLED)
+        # unserved lifecycle commands die with the engine — but a migrate
+        # TICKET has a caller blocked on its event (vtpu/serving/migrate):
+        # fail it explicitly so migrate()/drain() observe the stop instead
+        # of waiting out their timeout
+        while True:
+            try:
+                kind, item = self._lifecycle_q.get_nowait()
+            except queue.Empty:
+                break
+            if kind in ("migrate_out", "migrate_in"):
+                item.fail(RuntimeError("engine stopped mid-migration"))
 
     # ----------------------------------------------------------------- loop
 
@@ -2641,6 +2754,17 @@ class ServingEngine:
                 kind, req = self._lifecycle_q.get_nowait()
             except queue.Empty:
                 break
+            if kind in ("migrate_out", "migrate_in"):
+                # cross-engine migration tickets (vtpu/serving/migrate):
+                # served HERE, on the loop thread — the owner of the
+                # parked set, the allocator-assisted reclaim, and the
+                # donated device state the staging ops consume. ``req``
+                # is the ticket; the handler answers it (never raises —
+                # a failed migration must not take the loop down).
+                from vtpu.serving.migrate import handle_migrate_command
+
+                handle_migrate_command(self, kind, req)
+                continue
             if kind == "park":
                 if req in self._parked and req in self._want_resume:
                     # park overtook a still-queued (possibly
@@ -3340,8 +3464,22 @@ class ServingEngine:
         dt = time.perf_counter() - t0
         self._prof.note("fetch", dt, ticks=ticks)
         wd = self.serving.fetch_watchdog_ms
-        if wd and dt * 1e3 > wd:
-            self._trip_watchdog(dt)
+        if wd:
+            if dt * 1e3 > wd:
+                self._trip_watchdog(dt)
+            elif (self._applied_rungs
+                    and self.serving.fetch_watchdog_recover_ms):
+                # healthy fetch on a degraded engine: extend (or start)
+                # the recovery streak; a full grace window of them
+                # un-degrades one rung, and the clock restarts so every
+                # further rung needs its own window
+                now = time.perf_counter()
+                if self._healthy_since is None:
+                    self._healthy_since = now
+                elif ((now - self._healthy_since) * 1e3
+                        >= self.serving.fetch_watchdog_recover_ms):
+                    self._recover_watchdog()
+                    self._healthy_since = now
         return out
 
     def _note_host_ms(self, seconds: float) -> None:
@@ -3728,6 +3866,10 @@ class ServingEngine:
         # degrade counters riding the _stats copy above
         s["faults_injected"] = (
             self._faults.injected_total if self._faults is not None else 0)
+        # live migration / drain: whether admission is closed for an
+        # evacuation — the gauge a fleet router reads to stop targeting
+        # this engine (the flow counters ride the _stats copy above)
+        s["draining"] = self._draining
         s["kv_swap"] = self.serving.kv_swap if self._swap_enabled else None
         s["parked_sessions"] = len(self._parked)
         s["swap_host_blocks"] = (
@@ -4098,8 +4240,32 @@ class ServingEngine:
         if excess <= 0:
             return
         try:
-            victims = list(self._shed_policy.select(
-                list(self._waiting), excess))[:excess]
+            waiters = list(self._waiting)
+            if self._shed_signals:
+                # the pressure snapshot the policy decides against — pool
+                # state included, so overload victims can be chosen by
+                # MEMORY pressure, not queue depth alone (the first wire
+                # of the monitor->scheduler feedback loop into an
+                # engine-side actuator)
+                signals = EngineSignals(
+                    queue_depth=len(waiters),
+                    active_slots=sum(
+                        r is not None for r in self._slot_req),
+                    pool_free=(self._alloc.free_blocks
+                               if self._paged else None),
+                    pool_used_hwm=(self._alloc.used_hwm
+                                   if self._paged else None),
+                    parked_sessions=len(self._parked),
+                    prefill_backlog=(self._disagg.backlog()
+                                     if self._disagg is not None
+                                     else len(self._admitting)),
+                    now_ns=time.monotonic_ns(),
+                )
+                victims = list(self._shed_policy.select(
+                    waiters, excess, signals))[:excess]
+            else:
+                victims = list(self._shed_policy.select(
+                    waiters, excess))[:excess]
         except Exception:
             # a user-loaded policy program raising must not take the
             # serving loop down with it (the same containment bar as a

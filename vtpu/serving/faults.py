@@ -36,6 +36,16 @@ Seams (where the engine consults the plan):
 - ``delayed_fetch``   the device fetch stalls for ``arg`` seconds -> the
                       fetch watchdog trips and degrades the engine
                       gracefully instead of hanging the host
+- ``migrate_src_death``  the SOURCE engine of a live session migration
+                      dies after the metadata handshake but before the
+                      payload ships (its pool is gone) -> the destination
+                      rebuilds the session from its token history via the
+                      recompute-on-fault prefill path
+- ``migrate_payload_loss``  a migration's KV payload is lost in transit
+                      (consulted at the DESTINATION install seam) -> the
+                      destination falls back to recompute, or delivers a
+                      typed FAULTED terminal when the session cannot be
+                      rebuilt
 
 Thread-safe: workers and the serving loop hit seams concurrently; each
 ``fire`` takes the plan's lock (off the hot path — a seam consult is one
@@ -58,6 +68,8 @@ SEAMS = (
     "worker_death",
     "dispatch_exc",
     "delayed_fetch",
+    "migrate_src_death",
+    "migrate_payload_loss",
 )
 
 

@@ -1,0 +1,630 @@
+"""Live session migration between serving engines: streams outlive engines.
+
+PR 6 taught the engine to serialize a session to ordered host bytes (park:
+token history + pending token + budget + its pool blocks, spillable
+through the pinned host tier's async D2H); PR 8 taught it to install a
+park-shaped entry with ONE fused table-row+length write (the disagg
+handoff). This module points those two shipped parts ACROSS engines — the
+FlexNPU dynamic-re-partitioning move lifted from one host's prefill/decode
+split to engine pairs, and Zorua's decoupling of the programming model
+from resource placement extended to WHICH ENGINE a session lives on:
+
+    migrate(request, src, dst)
+
+1. PARK on the source — lossless at the flush boundary: the in-flight
+   token lands, then the settled session leaves its slot (the PR-6
+   machinery, unchanged).
+2. EXTRACT on the source loop thread: the park-shaped entry's metadata
+   (token history, pending token, budget, priority, page count) plus its
+   block payload, snapshotted through the compile-once swap staging
+   gather — the ONE D2H the session would pay to spill anyway. Blocks
+   already spilled to the source host tier are read straight from host
+   memory (their D2H already happened); a dropped entry ships metadata
+   only.
+3. INSTALL on the destination loop thread: allocate pages (with the same
+   eviction-assisted reclaim an admission gets), upload the payload
+   through the swap staging scatter — the ONE H2D a swap-in would pay —
+   and land the entry in the parked set. ``resume`` then remaps the table
+   row with the PR-8 fused write and the stream continues at exactly the
+   next token. Zero device copies beyond that D2H/H2D pair
+   (``stats()["migration_copies"] == 0``, the handoff_copies contract
+   applied across engines).
+
+Crash recovery: the handshake ships metadata BEFORE payload, so a source
+that dies mid-transfer (the ``migrate_src_death`` fault seam) or a payload
+lost in transit (``migrate_payload_loss``, consulted at the destination)
+leaves the destination holding exactly what recompute-on-fault needs — it
+installs the entry dropped and the PR-6 prefill rebuild regenerates the KV
+from token history. Only a session that can neither transfer nor rebuild
+(inexact history, or a sequence the destination cannot prefill) ends with
+a typed FAULTED terminal; every other path is lossless.
+
+``drain_engine(src, dst)`` (surfaced as ``ServingEngine.drain``) composes
+the primitive into the fleet operation: close admission, evacuate every
+live, parked, waiting and worker-owned session, and leave the source
+empty — pool free == capacity, no slots, nothing parked or queued — so an
+engine can be redeployed without dropping a stream. Sessions the caller
+explicitly abandoned (cancel()) retire with their typed terminal; drain
+itself never ends one.
+
+Threading: engines meet ONLY through lifecycle tickets. migrate() runs on
+any caller thread; the extract and install handlers run on each engine's
+own serving-loop thread (the owner of its parked set, allocator and
+donated device state), enqueued on the same lifecycle queue park/resume
+commands ride and answered through a per-ticket event.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Optional
+
+import jax
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+
+class MigrationError(RuntimeError):
+    """A migration (or drain) could not run or complete: incompatible
+    engines, a park that never settled, an engine stopping mid-transfer,
+    or a drain timeout. The session is never silently lost — it is either
+    still on the source, installed on the destination, or carries a typed
+    terminal."""
+
+
+class _Ticket:
+    """One lifecycle-queue command and its answer: the caller blocks on
+    ``done``; the owning loop thread fills ``result`` (ok) or ``error``
+    (fail). ``meta``/``payload`` carry the install half's inputs.
+
+    ``mu``/``abandoned`` close the timed-out-caller race: a caller that
+    gives up marks the ticket abandoned UNDER THE LOCK the handler
+    serves it under, so exactly one of two things happens — the handler
+    had not started (it observes the flag: an abandoned EXTRACT leaves
+    the session parked on the source, exactly what the caller's error
+    message promised; an abandoned INSTALL still lands the entry and
+    self-resumes, because by then the session exists nowhere else) — or
+    the handler was already mid-serve, in which case ``abandon()``
+    blocks until it finishes and returns False so the caller uses the
+    completed result after all. A stale ticket can never silently
+    destroy a session."""
+
+    __slots__ = ("req", "meta", "payload", "result", "error", "done",
+                 "mu", "abandoned")
+
+    def __init__(self, req, meta=None, payload=None):
+        self.req = req
+        self.meta = meta
+        self.payload = payload
+        self.result = None
+        self.error: Optional[BaseException] = None
+        self.done = threading.Event()
+        self.mu = threading.Lock()
+        self.abandoned = False
+
+    def ok(self, result: dict) -> None:
+        self.result = result
+        self.done.set()
+
+    def fail(self, exc: BaseException) -> None:
+        self.error = exc
+        self.done.set()
+
+    def abandon(self) -> bool:
+        """Mark the ticket dead-to-its-caller. Returns True when the
+        handler had not served it (and now never will act on the
+        caller's behalf); False when the answer actually arrived —
+        possibly after blocking out a mid-serve handler — and the
+        caller should use it."""
+        with self.mu:
+            if self.done.is_set():
+                return False
+            self.abandoned = True
+            return True
+
+
+def _compat_check(src, dst) -> None:
+    """Fail fast, on the caller's thread, for engine pairs that can never
+    exchange a session: the block geometry (page size, KV planes, per-
+    block shapes) must match exactly — the payload is raw pool pages."""
+    if src is dst:
+        raise MigrationError("cannot migrate a session onto its own engine")
+    for eng, name in ((src, "source"), (dst, "destination")):
+        if not getattr(eng, "_swap_enabled", False):
+            raise MigrationError(
+                f"migration requires ServingConfig.kv_swap on the {name} "
+                "engine (the park/serialize machinery lives there)")
+        if eng._thread is None:
+            raise MigrationError(f"{name} engine is not started")
+        if eng._stop.is_set():
+            raise MigrationError(f"{name} engine is stopped")
+    if dst._draining:
+        raise MigrationError("destination engine is itself draining")
+    if src._page != dst._page:
+        raise MigrationError(
+            f"kv_page mismatch: source {src._page} vs destination "
+            f"{dst._page} — pool pages cannot transfer across geometries")
+    if src._swap_planes != dst._swap_planes:
+        raise MigrationError(
+            f"KV plane mismatch: source {src._swap_planes} vs destination "
+            f"{dst._swap_planes} (quantization layouts differ)")
+    for key in src._swap_planes:
+        s_shape = (src.state[key].shape[0],) + tuple(src.state[key].shape[2:])
+        d_shape = (dst.state[key].shape[0],) + tuple(dst.state[key].shape[2:])
+        if s_shape != d_shape:
+            raise MigrationError(
+                f"block geometry mismatch on plane {key!r}: per-block "
+                f"{s_shape} vs {d_shape} — the engines serve different "
+                "models")
+
+
+def _ask(eng, kind: str, ticket: _Ticket, timeout: float) -> dict:
+    """Enqueue one lifecycle ticket on *eng* and wait for its answer.
+    On timeout the ticket is ABANDONED (see _Ticket.abandon) so a loop
+    thread that recovers later can never act on a caller that is gone —
+    unless the answer landed while we were giving up, in which case it
+    is used normally."""
+    eng._lifecycle_q.put((kind, ticket))
+    eng._wake.set()
+    if not ticket.done.wait(timeout) and ticket.abandon():
+        raise MigrationError(
+            f"{kind} did not complete within {timeout:.1f}s on engine "
+            f"{eng!r} (is its serving loop healthy?)")
+    if ticket.error is not None:
+        raise MigrationError(f"{kind} failed: {ticket.error!r}")
+    return ticket.result
+
+
+def migrate(request, src, dst, timeout: float = 60.0) -> dict:
+    """Move one live session from *src* to *dst*, resuming its stream at
+    exactly the next token. The request may be streaming, parked, or
+    still waiting on the source; the client keeps iterating the same
+    ``Request.stream()`` throughout — tokens simply start arriving from
+    the destination. Returns a report dict::
+
+        {"path": "resident" | "host" | "recompute" | "requeue"
+                 | "completed" | "cancelled" | "gone" | "faulted",
+         "bytes": payload bytes moved, "src_died": bool, "ms": wall}
+
+    ``resident`` is the common case (payload uploaded straight into the
+    destination pool, resume is a fused-row remap); ``host`` landed the
+    payload in the destination's swap tier under pool pressure;
+    ``recompute`` shipped metadata only (payload lost or never resident)
+    and the destination rebuilds through the prefill path; ``requeue``
+    migrated a not-yet-admitted request into the destination's admission
+    queue. ``completed``/``cancelled``/``gone`` mean the session settled
+    before it could move — nothing was transferred and nothing is owed.
+    ``faulted`` means the destination could neither land nor rebuild the
+    session and delivered its typed FAULTED terminal.
+
+    Raises MigrationError when the pair is incompatible or the transfer
+    cannot run; the session then still lives on the source (parked, if
+    the park settled — resume() it to carry on in place).
+    """
+    _compat_check(src, dst)
+    t0 = time.perf_counter()
+
+    def report(path, nbytes=0, src_died=False):
+        return {"path": path, "bytes": nbytes, "src_died": src_died,
+                "ms": (time.perf_counter() - t0) * 1e3}
+
+    if request.status is not None:
+        return report("completed")
+    if request.prefix is not None and request in src._waiting:
+        # a WAITING prefix-backed request has no pages to ship and its
+        # prefix id is meaningless on the destination — fail fast, with
+        # no park/resume churn (once admitted it migrates fine: the
+        # prefix content rides the payload, whole-sequence private)
+        raise MigrationError(
+            "a waiting prefix-backed request cannot migrate (its prefix "
+            "registration lives on the source engine); migrate it after "
+            "it admits")
+    we_parked = request not in src._parked
+    if we_parked:
+        src.park(request)
+        deadline = t0 + timeout
+        while request not in src._parked:
+            if request.status is not None:
+                return report("completed")
+            if time.perf_counter() > deadline:
+                raise MigrationError(
+                    "park never settled on the source (request unknown to "
+                    "the engine, or its loop is stalled)")
+            time.sleep(0.001)
+    entry = src._parked.get(request)
+    if (entry is not None and entry.get("unstarted")
+            and request.prefix is not None):
+        # a WAITING prefix-backed request has no pages to ship and its
+        # prefix id is meaningless on the destination; a started one
+        # migrates fine (its prefix content rides the payload, whole-
+        # sequence private on arrival)
+        if we_parked:
+            src.resume(request)  # undo our park: back to the waiting line
+        raise MigrationError(
+            "a waiting prefix-backed request cannot migrate (its prefix "
+            "registration lives on the source engine)")
+    out = _ask(src, "migrate_out", _Ticket(request), timeout)
+    if out["status"] != "ok":
+        return report(out["status"])
+    tin = _Ticket(request, meta=out["meta"], payload=out["payload"])
+    res = _ask(dst, "migrate_in", tin, timeout)
+    path = res["path"]
+    if path in ("resident", "host", "recompute", "requeue"):
+        dst.resume(request)
+    nbytes = (out["meta"]["n_pages"] * src._block_bytes
+              if out["payload"] is not None else 0)
+    return report(path, nbytes=nbytes, src_died=out.get("src_died", False))
+
+
+def _snaplist(d, tries: int = 8) -> list:
+    """list(keys) of a dict another thread mutates: retry the rare
+    mid-iteration resize instead of locking the serving loop."""
+    for _ in range(tries):
+        try:
+            return list(d)
+        except RuntimeError:
+            continue
+    return list(d)
+
+
+def _live_sessions(src) -> list:
+    """Every session the source still owes a stream: live slots, mid-
+    chunked admissions, parked entries, the waiting line. Worker-owned
+    (disagg) and still-pending submits surface in these sets within a
+    tick or two — drain's outer loop re-snapshots until the engine reads
+    empty."""
+    seen, out = set(), []
+
+    def add(r):
+        if r is not None and id(r) not in seen and r.status is None:
+            seen.add(id(r))
+            out.append(r)
+
+    for r in list(src._slot_req):
+        add(r)
+    for slot in range(src.serving.slots):
+        adm = src._admitting.get(slot)
+        if adm is not None:
+            add(adm["req"])
+    for r in _snaplist(src._parked):
+        add(r)
+    for r in src._waiting:
+        add(r)
+    return out
+
+
+def drain_engine(src, dst, timeout: float = 120.0) -> dict:
+    """Evacuate *src* onto *dst* (see ServingEngine.drain): close
+    admission, migrate every session the source still owes a stream, and
+    return once the source holds nothing — no slots, nothing parked,
+    queued, admitting, or worker-owned. Cancelled sessions retire on the
+    source with their typed terminal (the caller abandoned them; drain
+    never ends a stream itself); sessions that complete naturally during
+    the evacuation are counted, not moved."""
+    _compat_check(src, dst)
+    src._draining = True
+    t0 = time.perf_counter()
+    migrated = completed = faulted = 0
+    while True:
+        live = [r for r in _live_sessions(src) if not r.cancelled]
+        if not live:
+            s = src.stats()
+            if (s["active_slots"] == 0 and s["parked_sessions"] == 0
+                    and s["queued"] == 0 and s["admitting_slots"] == 0):
+                break
+        for req in live:
+            remaining = timeout - (time.perf_counter() - t0)
+            if remaining <= 0:
+                break
+            if req.prefix is not None and req in src._waiting:
+                # CANNOT migrate while waiting (its prefix registration
+                # lives here) and migrate() would fail-fast every pass:
+                # leave it — admission stays open to already-queued
+                # requests, slots free up as others leave, and once it
+                # admits it migrates fine (content snapshot). Retrying
+                # it here would livelock the drain instead.
+                continue
+            try:
+                rep = migrate(req, src, dst, timeout=max(remaining, 1.0))
+            except MigrationError:
+                # settled/cancelled in the window, or transiently
+                # unparkable (mid-chunk, worker-owned): the next pass
+                # retries — the timeout below bounds the whole drain
+                continue
+            if rep["path"] == "completed":
+                completed += 1
+            elif rep["path"] == "faulted":
+                # the session is off the source but its stream DIED
+                # (typed terminal): report it as a loss, never as an
+                # evacuation
+                faulted += 1
+            elif rep["path"] not in ("cancelled", "gone"):
+                migrated += 1
+        if time.perf_counter() - t0 > timeout:
+            raise MigrationError(
+                f"drain timed out after {timeout:.1f}s with sessions still "
+                "on the source")
+        time.sleep(0.002)
+    return {"migrated": migrated, "completed": completed,
+            "faulted": faulted, "ms": (time.perf_counter() - t0) * 1e3}
+
+
+# ---------------------------------------------------------------- handlers
+# Everything below runs ON AN ENGINE'S SERVING-LOOP THREAD, dispatched
+# from _process_lifecycle — the single writer of the parked set, the
+# allocator-assisted reclaim, and the donated device state.
+
+
+def handle_migrate_command(eng, kind: str, ticket: _Ticket) -> None:
+    """Serve one migrate ticket; never lets an exception reach the loop.
+    A failed EXTRACT leaves the entry parked on the source (the snapshot
+    mutates nothing until it has succeeded), so the session survives; a
+    failed INSTALL faults the request typed — its source blocks are
+    already released, there is no engine left that could resume it."""
+    from vtpu.serving.engine import Status
+
+    with ticket.mu:
+        if ticket.abandoned and kind == "migrate_out":
+            # the caller timed out and was told the session still lives
+            # here, parked — honor that: extract nothing, release
+            # nothing. (An abandoned INSTALL is the opposite case: by
+            # now the session exists nowhere else, so it proceeds below
+            # and self-resumes.)
+            return
+        try:
+            if kind == "migrate_out":
+                _do_migrate_out(eng, ticket)
+            else:
+                _do_migrate_in(eng, ticket)
+        except Exception as exc:
+            log.exception("%s failed for request %s; containing",
+                          kind, getattr(ticket.req, "rid", None))
+            if kind == "migrate_in":
+                eng._stats["migrate_failures"] += 1
+                eng._stats["faulted_requests"] += 1
+                eng.trace.record("fault", ticket.req.rid, -1)
+                eng._end_stream(ticket.req, Status.FAULTED)
+            ticket.fail(exc)
+
+
+def _do_migrate_out(eng, ticket: _Ticket) -> None:
+    """Source half: snapshot the parked entry's metadata + payload, then
+    release everything it held on this engine. Ordered snapshot-then-
+    release so any failure leaves the session intact and parked."""
+    from vtpu.serving.engine import Status
+
+    req = ticket.req
+    if req.status is not None:
+        ticket.ok({"status": "completed"})
+        return
+    e = eng._parked.get(req)
+    if e is None:
+        # finished, cancelled-and-swept, or never parked here: nothing to
+        # extract and nothing held — the caller re-resolves
+        ticket.ok({"status": "gone"})
+        return
+    if req.cancelled:
+        eng._release_parked(eng._parked.pop(req))
+        eng._end_stream(req, req._abort or Status.CANCELLED)
+        ticket.ok({"status": "cancelled"})
+        return
+    meta = {
+        "unstarted": bool(e.get("unstarted")),
+        "tokens": list(e["tokens"]),
+        "pending": e["pending"],
+        "budget": e["budget"],
+        "seq_len": e["seq_len"],
+        "n_pages": e["n_pages"],
+        "hist_exact": bool(e.get("hist_exact", True)),
+        "priority": e["priority"],
+    }
+    payload = None
+    src_died = False
+    if not meta["unstarted"]:
+        if eng._fire_fault("migrate_src_death"):
+            # injected source death AFTER the metadata handshake: the
+            # payload dies with this engine's pool — the destination
+            # rebuilds from token history via recompute-on-fault
+            src_died = True
+        elif not e["dropped"]:
+            payload = _snapshot_payload(eng, e)
+    eng._release_parked(eng._parked.pop(req))
+    eng._stats["migrations_out"] += 1
+    if payload is not None:
+        eng._stats["migrate_out_bytes"] += meta["n_pages"] * eng._block_bytes
+    eng.trace.record("migrate_out", req.rid, -1, meta["n_pages"])
+    ticket.ok({"status": "ok", "meta": meta, "payload": payload,
+               "src_died": src_died})
+
+
+def _snapshot_payload(eng, e: dict) -> dict:
+    """The entry's block contents in table-row order (shared prefix
+    blocks first, then private), as one host buffer per KV plane:
+    resident blocks go through the compile-once swap staging gather (the
+    one D2H of the transfer — `np.asarray` on the snapshot is the host
+    copy a spill would start asynchronously); blocks already spilled to
+    this engine's host tier are read straight from host memory, their
+    D2H already paid. No other device traffic — migration_copies stays 0
+    by construction."""
+    if e["pend"] is not None:
+        eng._finalize_swap_out(e)  # land an in-flight spill first
+    n = e["n_pages"]
+    bufs = {
+        key: np.empty(
+            (eng.state[key].shape[0], n) + tuple(eng.state[key].shape[2:]),
+            eng.state[key].dtype)
+        for key in eng._swap_planes
+    }
+    resident = list(e["shared"]) + (list(e["priv"])
+                                    if e["host"] is None else [])
+    w = eng._swap_stage
+    pos = 0
+    for i in range(0, len(resident), w):
+        grp = resident[i:i + w]
+        ids = np.zeros((w,), np.int32)
+        ids[:len(grp)] = grp
+        snap = eng._swap_gather(eng.state, ids)
+        for key in eng._swap_planes:
+            bufs[key][:, pos:pos + len(grp)] = (
+                np.asarray(snap[key])[:, :len(grp)])
+        pos += len(grp)
+    if e["host"] is not None:
+        hbs = e["host"]
+        for key in eng._swap_planes:
+            bufs[key][:, pos:pos + len(hbs)] = eng._host_pool[key][:, hbs]
+        pos += len(hbs)
+    assert pos == n, f"payload covered {pos} of {n} pages"
+    return bufs
+
+
+def _fault_install(eng, req, reason: str) -> dict:
+    from vtpu.serving.engine import Status
+
+    eng._stats["migrate_failures"] += 1
+    eng._stats["faulted_requests"] += 1
+    eng.trace.record("fault", req.rid, -1)
+    eng._end_stream(req, Status.FAULTED)
+    log.warning("migration install faulted request %s: %s", req.rid, reason)
+    return {"path": "faulted", "error": reason}
+
+
+def _do_migrate_in(eng, ticket: _Ticket) -> None:
+    """Destination half: land the entry in the parked set — payload into
+    freshly reclaimed pool pages (one staged H2D), into the host swap
+    tier under pool pressure, or metadata-only as a dropped entry headed
+    for recompute. resume() then continues the stream through the
+    ordinary restore paths (fused-row remap / swap-in / prefill
+    rebuild)."""
+    from vtpu.serving.engine import Status
+
+    req, meta, payload = ticket.req, ticket.meta, ticket.payload
+    if req.status is not None:
+        ticket.ok({"path": "completed"})
+        return
+    if req.cancelled:
+        eng._end_stream(req, req._abort or Status.CANCELLED)
+        ticket.ok({"path": "cancelled"})
+        return
+    # fresh identity on this engine: its trace is per-engine, and a
+    # source rid colliding with a live destination rid would corrupt the
+    # destination's derived spans
+    req.rid = next(eng._req_ctr)
+    if meta["unstarted"]:
+        try:
+            eng._bucket(int(req.tokens.shape[0]))
+        except ValueError as exc:
+            ticket.ok(_fault_install(eng, req, str(exc)))
+            return
+        entry = {
+            "req": req, "unstarted": True, "tokens": [], "pending": None,
+            "budget": 0, "seq_len": 0, "n_pages": 0, "shared": [],
+            "priv": [], "host": None, "pend": None, "dropped": False,
+            "recompute_ok": True, "hist_exact": True,
+            "priority": meta["priority"], "seq": eng._park_seq,
+        }
+        eng._park_seq += 1
+        eng._parked[req] = entry
+        eng._stats["migrations_in"] += 1
+        eng.trace.record("migrate_in", req.rid, -1, 0)
+        ticket.ok({"path": "requeue"})
+        if ticket.abandoned:
+            eng.resume(req)  # no caller left to do it — see _Ticket
+        return
+    if payload is not None and eng._fire_fault("migrate_payload_loss"):
+        # injected transit loss: the metadata survived, the bytes didn't —
+        # the recompute fallback below is the recovery under test
+        payload = None
+    recompute_ok = meta["hist_exact"] and eng._can_recompute(meta["seq_len"])
+    if meta["n_pages"] > eng._max_pages:
+        ticket.ok(_fault_install(
+            eng, req,
+            f"session needs {meta['n_pages']} pages but this engine's "
+            f"table rows hold {eng._max_pages}"))
+        return
+    entry = {
+        "req": req, "tokens": list(meta["tokens"]),
+        "pending": meta["pending"], "budget": meta["budget"],
+        "seq_len": meta["seq_len"], "n_pages": meta["n_pages"],
+        "shared": [], "priv": [], "host": None, "pend": None,
+        "dropped": False, "recompute_ok": recompute_ok,
+        "hist_exact": meta["hist_exact"], "priority": meta["priority"],
+        "seq": eng._park_seq,
+    }
+    if payload is None:
+        if not recompute_ok:
+            ticket.ok(_fault_install(
+                eng, req, "payload lost and the session cannot be rebuilt "
+                "(inexact history or sequence past every prefill route)"))
+            return
+        entry["dropped"] = True
+        eng._stats["migrate_recomputes"] += 1
+        path = "recompute"
+    else:
+        n = meta["n_pages"]
+        priv = eng._alloc_reclaim(n)
+        if priv is not None:
+            try:
+                _upload_payload(eng, priv, payload, n)
+            except Exception:
+                # the blocks are attached to NOTHING yet — an upload
+                # failure (wedged runtime, device OOM) must hand them
+                # back or every such fault shrinks the pool forever
+                eng._alloc.release(priv)
+                raise
+            entry["priv"] = priv
+            path = "resident"
+        elif eng._swap_host_blocks and len(eng._host_free) >= n:
+            # pool can't cover it even after reclaim: land in the swap
+            # tier — resume swaps it in like any evicted session
+            hbs = [eng._host_free.pop() for _ in range(n)]
+            try:
+                for key in eng._swap_planes:
+                    eng._host_pool[key][:, hbs] = payload[key]
+            except Exception:
+                eng._host_free.extend(hbs)
+                raise
+            entry["host"] = hbs
+            path = "host"
+        elif recompute_ok:
+            entry["dropped"] = True
+            eng._stats["migrate_recomputes"] += 1
+            path = "recompute"
+        else:
+            ticket.ok(_fault_install(
+                eng, req, "no pool pages, no host-tier room, and the "
+                "session cannot be rebuilt"))
+            return
+        if path in ("resident", "host"):
+            eng._stats["migrate_in_bytes"] += n * eng._block_bytes
+    eng._park_seq += 1
+    eng._parked[req] = entry
+    eng._stats["migrations_in"] += 1
+    eng.trace.record("migrate_in", req.rid, -1, meta["n_pages"])
+    ticket.ok({"path": path})
+    if ticket.abandoned:
+        eng.resume(req)  # no caller left to do it — see _Ticket
+
+
+def _upload_payload(eng, priv: list, payload: dict, n: int) -> None:
+    """Scatter the payload into freshly allocated pool pages through the
+    compile-once swap staging shape — the one H2D of the transfer, landed
+    PRE-SHARDED on the head axis under a tp mesh exactly like a swap-in
+    (each chip uploads only its shard)."""
+    w = eng._swap_stage
+    for i in range(0, n, w):
+        grp = priv[i:i + w]
+        ids = np.zeros((w,), np.int32)
+        ids[:len(grp)] = grp
+        pages = {}
+        for key in eng._swap_planes:
+            plane = eng.state[key]
+            buf = np.zeros((plane.shape[0], w) + tuple(plane.shape[2:]),
+                           plane.dtype)
+            buf[:, :len(grp)] = payload[key][:, i:i + len(grp)]
+            sh = eng._stage_shardings.get(key)
+            pages[key] = (jax.device_put(buf, sh) if sh is not None
+                          else buf)
+        eng.state = eng._swap_scatter(eng.state, ids, pages)

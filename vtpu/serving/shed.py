@@ -10,18 +10,40 @@ PAPERS.md) a policy PROGRAM rather than a hardcoded heuristic: deployments
 load their own policy without forking the engine, exactly like the QoS
 knobs the PR-6 eviction order exposed.
 
-The contract is deliberately small: ``select(waiters, need)`` sees a
-snapshot of the live waiting line and returns the requests to shed, most
-shed-worthy first. The engine sheds at tick heads (so the decision always
-runs on the loop thread against a coherent snapshot) and tolerates a
-policy returning fewer or stale entries — a request that was claimed or
-cancelled in the window simply isn't shed.
+The contract is deliberately small: ``select(waiters, need, signals)``
+sees a snapshot of the live waiting line plus a small ``EngineSignals``
+snapshot of the engine's pressure state (queue depth, pool free/high-water,
+parked sessions, prefill backlog — the first wire of the ROADMAP
+monitor->scheduler feedback loop into an engine-side actuator) and returns
+the requests to shed, most shed-worthy first. The engine sheds at tick
+heads (so the decision always runs on the loop thread against a coherent
+snapshot) and tolerates a policy returning fewer or stale entries — a
+request that was claimed or cancelled in the window simply isn't shed.
+Legacy two-argument policies keep working: the engine detects the
+signature at load time and omits the signals for them.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import importlib
-from typing import Iterable, List
+import inspect
+from typing import Iterable, List, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineSignals:
+    """The pressure snapshot a ShedPolicy decides against — deliberately
+    small and plain-data so user policy programs can be tested without an
+    engine. Pool fields are None on dense (non-paged) engines."""
+
+    queue_depth: int = 0           # live waiting-line length (pre-shed)
+    active_slots: int = 0          # slots with a live request
+    pool_free: Optional[int] = None      # BlockAllocator free blocks
+    pool_used_hwm: Optional[int] = None  # lifetime allocated-blocks HWM
+    parked_sessions: int = 0       # overcommit parked set size
+    prefill_backlog: int = 0       # disagg backlog / mid-chunk admissions
+    now_ns: int = 0                # monotonic_ns the snapshot was taken
 
 
 class ShedPolicy:
@@ -30,10 +52,13 @@ class ShedPolicy:
     engine owns the actual shed — atomic ``WaitQueue.take`` per victim,
     typed terminal delivery, counters, trace events."""
 
-    def select(self, waiters: List, need: int) -> Iterable:
+    def select(self, waiters: List, need: int,
+               signals: Optional[EngineSignals] = None) -> Iterable:
         """Return up to ``need`` requests to shed, most shed-worthy
         first. ``waiters`` is a FIFO snapshot of live waiting Requests
-        (fields: priority, deadline_ns, t_submit_ns, tokens...)."""
+        (fields: priority, deadline_ns, t_submit_ns, tokens...);
+        ``signals`` is the engine's EngineSignals pressure snapshot (None
+        only when a legacy caller drives the policy directly)."""
         raise NotImplementedError
 
 
@@ -43,9 +68,12 @@ class PriorityDeadlineShedPolicy(ShedPolicy):
     whose deadline is nearest (it is the likeliest to miss anyway — a
     deadline-less waiter has infinite slack and sheds last); among
     deadline-less equals, shed the youngest (oldest-first service keeps
-    the FIFO promise to whoever has waited longest)."""
+    the FIFO promise to whoever has waited longest). Receives the
+    EngineSignals snapshot like every policy but deliberately ignores it —
+    the default behavior is pinned signal-free by tests."""
 
-    def select(self, waiters: List, need: int) -> Iterable:
+    def select(self, waiters: List, need: int,
+               signals: Optional[EngineSignals] = None) -> Iterable:
         order = sorted(
             waiters,
             key=lambda r: (
@@ -55,6 +83,26 @@ class PriorityDeadlineShedPolicy(ShedPolicy):
             ),
         )
         return order[:need]
+
+
+def accepts_signals(policy) -> bool:
+    """Does this policy's ``select`` take the EngineSignals third argument?
+    Resolved ONCE at engine construction (never per shed): a policy with a
+    third positional parameter, a ``signals`` keyword, or ``*args`` gets
+    the snapshot; a legacy two-argument policy is called without it."""
+    try:
+        sig = inspect.signature(policy.select)
+    except (TypeError, ValueError):  # builtins / C callables: be safe
+        return False
+    params = list(sig.parameters.values())
+    if any(p.kind == p.VAR_POSITIONAL for p in params):
+        return True
+    if "signals" in sig.parameters:
+        return True
+    positional = [p for p in params
+                  if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)]
+    # bound method: (waiters, need, signals) -> 3 positionals
+    return len(positional) >= 3
 
 
 def load_shed_policy(spec) -> ShedPolicy:
