@@ -5,9 +5,12 @@ containment, swap-loss recompute, worker restart, watchdog degradation —
 is exercised here IN COMBINATION, over the traffic mixes that stress the
 seams: paged + int8 + overcommit park/evict/resume pressure (co-scheduled),
 disaggregated prefill/decode with a dying worker, the multi-tick
-device loop under a stalling fetch, and (ISSUE 13) live cross-engine
+device loop under a stalling fetch, (ISSUE 13) live cross-engine
 migration whose source dies mid-transfer — the destination rebuilds the
-session from token history via recompute-on-fault. The schedule is deterministic (a
+session from token history via recompute-on-fault — and (ISSUE 14) a
+FLEET engine killed without saying goodbye (engine_death: the loop thread
+vanishes with no cleanup), every stream it held rebuilt on survivors from
+the session ledger. The schedule is deterministic (a
 seeded FaultPlan / explicit FaultSpecs — see vtpu/serving/faults), so the
 gates are exact, not statistical:
 
@@ -432,17 +435,23 @@ def main() -> None:
     # -------------------------------------------------------------- migrate
     log("=== scenario: migrate (source dies mid-transfer) ===")
     n_mig = 2 if a.quick else 3
+    # a budget comfortably past what the park round trip can outrun: the
+    # client takes 2 tokens then parks, and the engine keeps producing in
+    # the meantime — on a loaded smoke rig a 10-token budget can DRAIN
+    # before the park lands, turning the parked-first determinism into
+    # "completed" paths. 24 tokens cannot (prompt 8 + 24 < max_seq 64).
+    mig_new = max(a.max_new, 24)
 
     def migrate_serving(faults=None):
         return ServingConfig(
-            slots=n_mig, prefill_buckets=(16,), max_new_tokens=a.max_new,
+            slots=n_mig, prefill_buckets=(16,), max_new_tokens=mig_new,
             prefill_chunk=16, kv_page=a.page, kv_swap=8, faults=faults)
 
     ref_eng = ServingEngine(params16, cfg_bf16, migrate_serving())
     ref_eng.start()
     try:
         ref_reqs = [ref_eng.submit(prompt(700 + j),
-                                   max_new_tokens=a.max_new)
+                                   max_new_tokens=mig_new)
                     for j in range(n_mig)]
         ref_streams = [drain(r) for r in ref_reqs]
     finally:
@@ -458,7 +467,7 @@ def main() -> None:
     try:
         reqs, streams, paths = [], [], []
         for j in range(n_mig):
-            req = src.submit(prompt(700 + j), max_new_tokens=a.max_new)
+            req = src.submit(prompt(700 + j), max_new_tokens=mig_new)
             reqs.append(req)
             streams.append(take(req, 2))
         # park everyone FIRST: a parked session cannot finish, so the
@@ -523,12 +532,102 @@ def main() -> None:
     })
     log(f"migrate: pass={mig_pass} gates={gates}")
 
+    # ------------------------------------------------------------ fleet
+    log("=== scenario: fleet (kill one engine of three, ledger failover) ===")
+    from vtpu.serving import EngineFleet, FleetConfig, RoutePolicy
+
+    class PinA(RoutePolicy):
+        def score(self, name, signals):
+            if signals.draining:
+                return None
+            return 1.0 if name == "a" else 0.0
+
+    n_fleet = 2 if a.quick else 3
+    ref_eng = ServingEngine(params16, cfg_bf16,
+                            migrate_serving())  # same geometry family
+    ref_eng.start()
+    try:
+        # mig_new, not a.max_new: the kill must land while streams are
+        # still live (same early-completion hazard as the park above)
+        ref_reqs = [ref_eng.submit(prompt(800 + j),
+                                   max_new_tokens=mig_new)
+                    for j in range(n_fleet)]
+        ref_streams = [drain(r) for r in ref_reqs]
+    finally:
+        ref_eng.stop()
+    plan_f = FaultPlan()
+    engines = {"a": ServingEngine(params16, cfg_bf16,
+                                  migrate_serving(faults=plan_f)),
+               "b": ServingEngine(params16, cfg_bf16, migrate_serving()),
+               "c": ServingEngine(params16, cfg_bf16, migrate_serving())}
+    # wide miss window: the smoke tier runs benches concurrently on
+    # starved runners, and a live-but-stalled loop must never be
+    # declared dead here (see fleet_bench's FC note)
+    fleet = EngineFleet(engines, FleetConfig(
+        probe_interval_ms=20.0, miss_ms=2000.0, suspect_misses=2,
+        dead_misses=4, route_policy=PinA))
+    fleet.start()
+    try:
+        reqs = [fleet.submit(prompt(800 + j), max_new_tokens=mig_new)
+                for j in range(n_fleet)]
+        streams = [take(r, 2) for r in reqs]
+        plan_f.arm("engine_death")  # the next flush boundary kills 'a'
+        for j, req in enumerate(reqs):
+            streams[j] += drain(req)
+        fs = fleet.stats()
+        settled = [wait_drained(e) for e in
+                   (engines["b"], engines["c"])]
+        stats_a = engines["a"].stats()
+    finally:
+        fleet.stop()
+    gates = {
+        "all_terminal": all(r.status is not None for r in reqs),
+        "all_ok": all(r.status == Status.OK for r in reqs),
+        "token_equal": streams == ref_streams,
+        "failover_counted": fs["failovers"] == 1
+                             and fs["failover_sessions"] == n_fleet
+                             and fs["failover_faulted"] == 0,
+        "dead_declared": fs["engine_states"]["a"] == "DEAD",
+        "corpse_reaped": (
+            stats_a["kv_pool_free"] == stats_a["kv_pool_blocks"]
+            and stats_a["active_slots"] == 0
+            and stats_a["parked_sessions"] == 0),
+        "zero_leaks_survivors": all(
+            s["kv_pool_free"] == s["kv_pool_blocks"]
+            and s["active_slots"] == 0 and s["parked_sessions"] == 0
+            for s in settled),
+        # survivors only: the corpse died with a dispatched-but-never-
+        # fetched tick in flight (exactly what a crash loses), so its own
+        # ratio legitimately under-reads — no recovery path may add a
+        # sync on the engines still serving, though
+        "tick_contract": all(
+            fs["engines"][n]["device_gets_per_tick"] in (None, 1.0)
+            for n in ("b", "c")),
+        "seams_fired":
+            plan_f.snapshot()["injected"]["engine_death"] == 1,
+    }
+    fleet_pass = all(gates.values())
+    all_pass &= fleet_pass
+    artifact["scenarios"].append({
+        "name": "fleet", "pass": fleet_pass, "gates": gates,
+        "fault_plan": plan_f.snapshot(),
+        "stats": {
+            "faults_injected": stats_a["faults_injected"],
+            "failovers": fs["failovers"],
+            "failover_sessions": fs["failover_sessions"],
+            "probe_misses": fs["probe_misses"],
+            "survivor_migrations_in": sum(
+                fs["engines"][n]["migrations_in"] for n in ("b", "c")),
+        },
+    })
+    log(f"fleet: pass={fleet_pass} gates={gates}")
+
     # ------------------------------------------------------------ artifact
     artifact["pass"] = bool(all_pass)
     injected_total = sum(
         sc["stats"]["faults_injected"] for sc in artifact["scenarios"])
     artifact["faults_injected_total"] = injected_total
-    out_path = a.out or (None if a.quick else "FAULTS_r15.json")
+    out_path = a.out or (None if a.quick else "FAULTS_r16.json")
     if out_path:
         Path(out_path).write_text(json.dumps(artifact, indent=2) + "\n")
         log(f"artifact -> {out_path}")

@@ -80,6 +80,8 @@ QUICK_RUNS = {
               "--sessions", "2", "--max-new", "10"],
     "migrate": [str(ROOT / "benchmarks" / "migrate_bench.py"), "--quick",
                 "--sessions", "2", "--max-new", "8"],
+    "fleet": [str(ROOT / "benchmarks" / "fleet_bench.py"), "--quick",
+              "--max-new", "8"],
 }
 
 
@@ -91,7 +93,7 @@ QUICK_WAVES = (
     ("paged_kv_tp2", "overcommit", "decode"),
     ("disagg", "paged_kv", "obs"),
     ("paged_attn", "prefill", "decode_loop_k"),
-    ("chaos", "migrate"),
+    ("chaos", "migrate", "fleet"),
 )
 
 # runs that force a multi-virtual-device platform stay OFF the shared
@@ -124,6 +126,7 @@ TEST_TO_RUN = {
     "test_obs_bench_quick_small_iteration": "obs",
     "test_chaos_bench_quick_small_iteration": "chaos",
     "test_migrate_bench_quick_small_iteration": "migrate",
+    "test_fleet_bench_quick_small_iteration": "fleet",
 }
 
 
@@ -463,7 +466,8 @@ def test_chaos_bench_quick_small_iteration(quick):
     assert artifact["metric"] == "chaos_soak_deterministic_gates"
     assert artifact["pass"] is True
     scenarios = {s["name"]: s for s in artifact["scenarios"]}
-    assert set(scenarios) == {"core", "disagg", "device_loop", "migrate"}
+    assert set(scenarios) == {"core", "disagg", "device_loop", "migrate",
+                              "fleet"}
     for sc in scenarios.values():
         assert sc["pass"], sc
         assert all(sc["gates"].values()), sc["gates"]
@@ -477,7 +481,9 @@ def test_chaos_bench_quick_small_iteration(quick):
     assert scenarios["device_loop"]["stats"]["watchdog_degrades"] >= 1
     assert scenarios["migrate"]["stats"]["migration_copies"] == 0
     assert scenarios["migrate"]["stats"]["dst_migrate_recomputes"] >= 1
-    assert artifact["faults_injected_total"] >= 4
+    assert scenarios["fleet"]["stats"]["failovers"] == 1
+    assert scenarios["fleet"]["stats"]["failover_sessions"] >= 2
+    assert artifact["faults_injected_total"] >= 5
     assert summary["summary"] and summary["verdict"] == "pass"
 
 
@@ -526,3 +532,46 @@ def test_migrate_bench_quick_small_iteration(quick):
     assert bl["p99"] <= bl["bound"] and bl["pass"]
     assert summary["summary"] and summary["verdict"] == "pass"
     assert summary["unit"] == "blackout_p99_ms"
+
+
+def test_fleet_bench_help_parses():
+    r = _run([str(ROOT / "benchmarks" / "fleet_bench.py"), "--help"])
+    assert r.returncode == 0
+    assert "--quick" in r.stdout and "--blackout-ms" in r.stdout
+
+
+def test_fleet_bench_quick_small_iteration(quick):
+    """fleet_bench --quick at smoke scale (ISSUE 14 acceptance): every
+    deterministic gate holds — kill-one-of-three with every stream on
+    the dead engine (live slots AND a waiting request) finishing
+    token-equal on a survivor via ledger + recompute for exact AND int8,
+    failover_sessions equal to the dead engine's session count, zero
+    leaks on ALL engines (the reaped corpse included), every configured
+    seam fired (engine_death per kill, probe_loss on the hysteresis
+    scenario), a SUSPECT-but-alive engine never failed over, the
+    router-driven drain leaving its source empty with admission refused,
+    and the failover blackout p99 reported under its bound."""
+    r = quick["fleet"]
+    assert r.returncode == 0, r.stderr
+    lines = [ln for ln in r.stdout.splitlines() if ln.strip()]
+    artifact = json.loads(lines[0])
+    summary = json.loads(lines[-1])
+    assert artifact["metric"] == "fleet_deterministic_gates"
+    assert artifact["pass"] is True
+    scenarios = {s["name"]: s for s in artifact["scenarios"]}
+    assert set(scenarios) == {"kill_failover[exact]",
+                              "kill_failover[int8]", "drain", "suspect"}
+    for sc in scenarios.values():
+        assert sc["pass"], sc
+        assert all(sc["gates"].values()), sc["gates"]
+    for name in ("kill_failover[exact]", "kill_failover[int8]"):
+        assert scenarios[name]["gates"]["token_equal"]
+        assert scenarios[name]["gates"]["zero_leaks_all_engines"]
+        assert scenarios[name]["failover_sessions"] == artifact["sessions"]
+    assert scenarios["suspect"]["gates"]["never_failed_over"]
+    assert scenarios["drain"]["gates"]["admission_refused"]
+    bl = artifact["blackout_ms"]
+    assert bl["samples"] >= 2 and bl["p99"] is not None
+    assert bl["p99"] <= bl["bound"] and bl["pass"]
+    assert summary["summary"] and summary["verdict"] == "pass"
+    assert summary["unit"] == "failover_blackout_p99_ms"

@@ -695,6 +695,54 @@ def test_shed_policy_receives_engine_signals(params):
     assert sig.now_ns > 0
 
 
+def test_duty_supplier_populates_engine_signals(params):
+    """ISSUE 14 satellite: the attested-duty field the ROADMAP called
+    'still not plumbed in'. A ServingConfig.duty_supplier (stubbed here;
+    fed from the libvtpu calibration region mirror in production)
+    populates EngineSignals.duty, the shed policy receives it at the
+    overload seam, a raising supplier degrades to duty=None instead of
+    killing anything, and a non-callable is rejected at construction."""
+    seen = []
+
+    class DutyAwarePolicy(ShedPolicy):
+        def select(self, waiters, need, signals=None):
+            seen.append(signals)
+            return sorted(waiters, key=lambda r: r.priority)[:need]
+
+    eng = ServingEngine(params, CFG, _serving(
+        slots=1, kv_page=8, kv_swap=4, prefill_buckets=(16,),
+        shed_queue_depth=1, shed_policy=DutyAwarePolicy,
+        duty_supplier=lambda: 0.75))
+    try:
+        sig = eng.signals()
+        assert sig.duty == 0.75
+        assert sig.draining is False
+        assert sig.pool_blocks == eng._n_blocks - 1
+        # and the shed seam delivers the same snapshot to the policy
+        live = eng.submit(_prompt(96, 5), max_new_tokens=8)
+        eng._tick_head()  # live takes the only slot
+        assert eng._slot_req[0] is live
+        eng.submit(_prompt(97, 5), max_new_tokens=2, priority=5)
+        drop = eng.submit(_prompt(98, 5), max_new_tokens=2, priority=0)
+        eng._tick_head()  # line overflows depth 1: the policy picks
+        assert eng._stats["shed_overload"] == 1
+        assert drop.status == Status.SHED_OVERLOAD
+        assert seen and seen[0] is not None and seen[0].duty == 0.75
+    finally:
+        eng.stop()
+
+    def boom():
+        raise RuntimeError("supplier unavailable")
+
+    eng2 = ServingEngine(params, CFG, _serving(duty_supplier=boom))
+    try:
+        assert eng2.signals().duty is None  # degrades, never raises
+    finally:
+        eng2.stop()
+    with pytest.raises(ValueError, match="duty_supplier"):
+        ServingEngine(params, CFG, _serving(duty_supplier=0.5))
+
+
 def test_legacy_two_arg_shed_policy_still_works(params):
     """Back-compat pin: a policy program written against the PR-11
     two-argument select signature keeps working — the engine detects the

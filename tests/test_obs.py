@@ -395,6 +395,63 @@ def test_exporter_covers_every_stats_key(params):
         f"GAUGES/HIST_COUNTERS) or allowlist them explicitly")
 
 
+def test_exporter_covers_every_fleet_stats_key(params):
+    """The fleet half of the coverage check: every top-level key
+    EngineFleet.stats() returns maps to a vtpu_serving_fleet_* family or
+    is explicitly special/allowlisted — fleet counters cannot drift out
+    of the exporter any more than engine counters can."""
+    from vtpu.obs.export import (
+        FLEET_ALLOWLIST, FLEET_COUNTERS, FLEET_GAUGES, FLEET_SPECIAL)
+    from vtpu.serving import EngineFleet, FleetConfig
+
+    mk = lambda: ServingEngine(params, CFG, ServingConfig(  # noqa: E731
+        slots=2, prefill_buckets=(16,), max_new_tokens=4,
+        kv_page=8, kv_swap=2))
+    fleet = EngineFleet({"a": mk(), "b": mk()}, FleetConfig())
+    mapped = set(FLEET_COUNTERS) | set(FLEET_GAUGES) | FLEET_SPECIAL \
+        | FLEET_ALLOWLIST
+    missing = sorted(k for k in fleet.stats() if k not in mapped)
+    assert not missing, (
+        f"EngineFleet.stats() keys with no vtpu_serving_fleet_* family "
+        f"and no allowlist entry: {missing} — map them in "
+        f"vtpu/obs/export.py (FLEET_COUNTERS/FLEET_GAUGES) or allowlist "
+        f"them explicitly")
+
+
+def test_fleet_families_shape(params):
+    """A registered fleet exports twice: member engines join the ordinary
+    vtpu_serving_* families under 'fleet/engine' labels, and the fleet
+    counters/health states export as vtpu_serving_fleet_* families."""
+    from vtpu.serving import EngineFleet, FleetConfig
+
+    mk = lambda: ServingEngine(params, CFG, ServingConfig(  # noqa: E731
+        slots=2, prefill_buckets=(8,), max_new_tokens=4,
+        kv_page=8, kv_swap=2))
+    fleet = EngineFleet({"a": mk(), "b": mk()}, FleetConfig())
+    fleet.start()
+    try:
+        r = fleet.submit(_prompt(1, 5), max_new_tokens=4)
+        assert len(list(r.stream())) == 4
+        col = ServingCollector()
+        col.register_fleet("f0", fleet)
+        fams = list(col.collect())
+    finally:
+        fleet.stop()
+    names = [f.name for f in fams]
+    assert len(names) == len(set(names)), "duplicate family names"
+    by_name = {f.name: f for f in fams}
+    tokens = by_name["vtpu_serving_tokens_generated"]
+    engines = {s.labels["engine"] for s in tokens.samples}
+    assert engines == {"f0/a", "f0/b"}
+    assert sum(s.value for s in tokens.samples) == 4.0
+    probes = by_name["vtpu_serving_fleet_probes"]
+    assert probes.samples[0].labels["fleet"] == "f0"
+    health = by_name["vtpu_serving_fleet_engine_health"]
+    assert {(s.labels["fleet"], s.labels["engine"], s.value)
+            for s in health.samples} == {("f0", "a", 1.0), ("f0", "b", 1.0)}
+    assert by_name["vtpu_serving_fleet_failovers"].samples[0].value == 0.0
+
+
 def test_serving_families_shape(params):
     eng = ServingEngine(params, CFG, ServingConfig(
         slots=2, prefill_buckets=(8,), max_new_tokens=4))

@@ -243,15 +243,104 @@ SPECIAL = {
 # not exported go here, with a reason. Empty today — every key maps.
 ALLOWLIST: set = set()
 
+# ------------------------------------------------------------------- fleet
+# EngineFleet.stats() keys -> vtpu_serving_fleet_* families, labelled by
+# fleet name. Same exhaustive-and-checkable discipline as the engine
+# tables: tests/test_obs.py walks a live fleet's stats() keys and fails on
+# any key that is neither mapped nor in FLEET_SPECIAL/FLEET_ALLOWLIST.
+FLEET_COUNTERS = {
+    "failovers": ("fleet_failovers",
+                  "DEAD engines failed over to survivors"),
+    "failover_sessions": ("fleet_failover_sessions",
+                          "Sessions rebuilt on survivors after an engine "
+                          "death"),
+    "failover_faulted": ("fleet_failover_faulted",
+                         "Sessions no survivor could rebuild (typed "
+                         "FAULTED terminals)"),
+    "reroutes": ("fleet_reroutes",
+                 "Submits retargeted off a draining/stopping engine"),
+    "rebalance_migrations": ("fleet_rebalance_migrations",
+                             "Background pool-pressure rebalancing "
+                             "migrations"),
+    "probe_misses": ("fleet_probe_misses",
+                     "Health probes counted as missed (ladder fuel)"),
+    "probes": ("fleet_probes", "Monitor probe rounds completed"),
+    "suspects": ("fleet_suspects",
+                 "HEALTHY->SUSPECT ladder transitions"),
+}
+FLEET_GAUGES = {
+    "fleet_engines": ("fleet_engines", "Engines registered in the fleet"),
+    "healthy_engines": ("fleet_healthy_engines",
+                        "Engines currently HEALTHY"),
+    "suspect_engines": ("fleet_suspect_engines",
+                        "Engines currently SUSPECT (deprioritized, never "
+                        "failed over)"),
+    "dead_engines": ("fleet_dead_engines",
+                     "Engines declared DEAD (fenced, failed over, "
+                     "reaped)"),
+    "draining_engines": ("fleet_draining_engines",
+                         "Engines with admission closed for a drain"),
+    "ledger_sessions": ("fleet_ledger_sessions",
+                        "Started sessions currently recorded in the "
+                        "recovery ledger"),
+}
+# handled specially (engine_states -> the per-engine health gauge below;
+# engines -> each engine's snapshot joins the ordinary vtpu_serving_*
+# families under a "fleet/engine" label)
+FLEET_SPECIAL = {"engine_states", "engines"}
+FLEET_ALLOWLIST: set = set()
 
-def _hist_family(name: str, help_: str, label: str, engine: str,
-                 data) -> CounterMetricFamily:
+# engine_states values -> numeric health gauge (vtpu_serving_fleet_
+# engine_health{fleet, engine}): 1 healthy, 0.5 suspect, 0 dead — a
+# dashboard's sum() over engines reads as effective capacity.
+_HEALTH_VALUE = {"HEALTHY": 1.0, "SUSPECT": 0.5, "DEAD": 0.0}
+
+
+def fleet_families(fleets: dict[str, object]) -> Iterable:
+    """Yield the vtpu_serving_fleet_* families for *fleets*
+    ({fleet_name: EngineFleet-like}). Each family carries one sample per
+    fleet; per-engine health rides a (fleet, engine)-labelled gauge.
+    Member engines' OWN families come from the collect() sources path —
+    the flat-counters-only snapshot here avoids computing every member's
+    stats() twice per scrape."""
+    snaps = {name: f.stats(include_engines=False)
+             for name, f in fleets.items()}
+    for key, (suffix, help_) in FLEET_COUNTERS.items():
+        fam = CounterMetricFamily(PREFIX + suffix, help_, labels=("fleet",))
+        for name, s in snaps.items():
+            v = s.get(key)
+            if v is not None:
+                fam.add_metric((name,), float(v))
+        yield fam
+    for key, (suffix, help_) in FLEET_GAUGES.items():
+        fam = GaugeMetricFamily(PREFIX + suffix, help_, labels=("fleet",))
+        for name, s in snaps.items():
+            v = s.get(key)
+            if v is not None:
+                fam.add_metric((name,), float(v))
+        yield fam
+    fam = GaugeMetricFamily(
+        PREFIX + "fleet_engine_health",
+        "Per-engine supervision state (1 healthy, 0.5 suspect, 0 dead)",
+        labels=("fleet", "engine"))
+    for name, s in snaps.items():
+        for ename, state in sorted((s.get("engine_states") or {}).items()):
+            fam.add_metric((name, ename), _HEALTH_VALUE.get(state, 0.0))
+    yield fam
+
+
+def _hist_family(name: str, help_: str, label: str,
+                 per_engine: dict) -> CounterMetricFamily:
+    """ONE family carrying every engine's samples — a family per engine
+    would duplicate the family name the moment a second engine registers
+    (invalid exposition; the multi-engine/fleet registration bug)."""
     fam = CounterMetricFamily(PREFIX + name, help_, labels=("engine", label))
-    items = (enumerate(data) if isinstance(data, list)
-             else sorted(data.items()))
-    for key, count in items:
-        if count:
-            fam.add_metric((engine, str(key)), float(count))
+    for engine, data in per_engine.items():
+        items = (enumerate(data) if isinstance(data, list)
+                 else sorted(data.items()))
+        for key, count in items:
+            if count:
+                fam.add_metric((engine, str(key)), float(count))
     return fam
 
 
@@ -276,10 +365,10 @@ def serving_families(sources: dict[str, object]) -> Iterable:
                 fam.add_metric((name,), float(v) * scale)
         yield fam
     for key, (suffix, help_, label) in HIST_COUNTERS.items():
-        for name, s in snaps.items():
-            data = s.get(key)
-            if data is not None:
-                yield _hist_family(suffix, help_, label, name, data)
+        yield _hist_family(
+            suffix, help_, label,
+            {name: s[key] for name, s in snaps.items()
+             if s.get(key) is not None})
     for key in ("kv_hbm_bytes", "kv_hbm_bytes_per_chip"):
         fam = GaugeMetricFamily(
             PREFIX + key,
@@ -323,13 +412,20 @@ def serving_families(sources: dict[str, object]) -> Iterable:
 
 
 class ServingCollector(Collector):
-    """A prometheus Collector over a registry of live engines. Register it
-    directly, or hand it to ``MonitorCollector(serving=...)`` so the
-    monitor's one scrape endpoint serves libvtpu AND engine telemetry."""
+    """A prometheus Collector over a registry of live engines AND fleets.
+    Register it directly, or hand it to ``MonitorCollector(serving=...)``
+    so the monitor's one scrape endpoint serves libvtpu AND engine
+    telemetry. A registered fleet contributes twice: every member engine
+    joins the ordinary ``vtpu_serving_*`` families under an
+    ``engine="<fleet>/<name>"`` label, and the fleet-level counters/
+    gauges (failovers, reroutes, probe misses, health states) export as
+    ``vtpu_serving_fleet_*`` families under a ``fleet`` label."""
 
-    def __init__(self, engines: dict[str, object] | None = None):
+    def __init__(self, engines: dict[str, object] | None = None,
+                 fleets: dict[str, object] | None = None):
         self._lock = threading.Lock()
         self._engines: dict[str, object] = dict(engines or {})
+        self._fleets: dict[str, object] = dict(fleets or {})
 
     def register_engine(self, name: str, engine) -> None:
         with self._lock:
@@ -339,7 +435,21 @@ class ServingCollector(Collector):
         with self._lock:
             self._engines.pop(name, None)
 
+    def register_fleet(self, name: str, fleet) -> None:
+        with self._lock:
+            self._fleets[name] = fleet
+
+    def unregister_fleet(self, name: str) -> None:
+        with self._lock:
+            self._fleets.pop(name, None)
+
     def collect(self):
         with self._lock:
             sources = dict(self._engines)
+            fleets = dict(self._fleets)
+        for fname, fleet in fleets.items():
+            for ename, eng in fleet.engines.items():
+                sources[f"{fname}/{ename}"] = eng
         yield from serving_families(sources)
+        if fleets:
+            yield from fleet_families(fleets)

@@ -13,7 +13,14 @@ from vtpu.serving.engine import (
     prefill_into_slot,
     prefill_into_slots,
 )
-from vtpu.serving.faults import FaultPlan, FaultSpec
+from vtpu.serving.faults import EngineDeath, FaultPlan, FaultSpec
+from vtpu.serving.fleet import (
+    EngineFleet,
+    FleetConfig,
+    LeastPressureRoutePolicy,
+    RoutePolicy,
+    load_route_policy,
+)
 from vtpu.serving.migrate import MigrationError, drain_engine, migrate
 from vtpu.serving.shed import (
     EngineSignals,
@@ -24,12 +31,17 @@ from vtpu.serving.shed import (
 __all__ = [
     "BlockAllocator",
     "DisaggConfig",
+    "EngineDeath",
+    "EngineFleet",
     "EngineSignals",
     "FaultPlan",
     "FaultSpec",
+    "FleetConfig",
+    "LeastPressureRoutePolicy",
     "MigrationError",
     "PriorityDeadlineShedPolicy",
     "Request",
+    "RoutePolicy",
     "ServingConfig",
     "ServingEngine",
     "ShedPolicy",
@@ -38,6 +50,7 @@ __all__ = [
     "WaitQueue",
     "batched_decode_step",
     "drain_engine",
+    "load_route_policy",
     "migrate",
     "prefill_into_slot",
     "prefill_into_slots",

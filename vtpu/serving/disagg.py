@@ -768,6 +768,7 @@ class PrefillWorker(threading.Thread):
             eng.trace.note_ttft((now_ns - req.t_submit_ns) / 1e9)
         if req.t_depart_ns:
             eng.trace.note_prefill_exec((now_ns - req.t_depart_ns) / 1e9)
+        req.delivered += 1
         req.out.put(tok)
         if self.current is not None:
             # past this point a dead worker's request cannot be re-queued

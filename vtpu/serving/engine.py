@@ -35,7 +35,7 @@ import numpy as np
 from vtpu.obs.tickprof import TickProfiler
 from vtpu.obs.trace import RequestTrace, TERMINAL_CODES, pct
 from vtpu.ops.decode_attn import paged_attn_route
-from vtpu.serving.faults import FaultInjected, FaultPlan
+from vtpu.serving.faults import EngineDeath, FaultInjected, FaultPlan
 from vtpu.serving.shed import EngineSignals, accepts_signals, load_shed_policy
 
 from vtpu.models.transformer import (
@@ -295,6 +295,17 @@ class ServingConfig:
     # A plan makes the recovery paths above reproducible — the chaos
     # soak and tests/test_faults.py drive every seam through it.
     faults: Optional[Any] = None
+    # Attested-duty supplier (the ROADMAP feedback-loop field): a zero-arg
+    # callable returning the device's attested busy fraction in [0, 1]
+    # (or None when no reading is available). Wired from the libvtpu
+    # calibration region mirror when one is present — e.g.
+    # ``lambda: reader.read().devices[i].core_util_percent / 100`` over a
+    # vtpu.monitor.region.RegionReader — and None otherwise. The engine
+    # calls it when it builds an EngineSignals snapshot, so shed policies
+    # (overload victims by device-truth busyness) and fleet route
+    # policies (route away from hot chips) both consume it; a raising or
+    # absent supplier degrades to duty=None, never to a dead loop.
+    duty_supplier: Optional[Any] = None
 
 
 def choose_kv_int8(slots: int, max_window: int) -> bool:
@@ -571,6 +582,13 @@ class Request:
     # index i pairs with the i-th DECODED token, the prefill first token has
     # no entry)
     logprobs: list = dataclasses.field(default_factory=list)
+    # generated tokens actually delivered to the client's out-queue,
+    # engine-agnostic (it survives migration and engine death where
+    # per-engine counters don't): incremented at every delivery path,
+    # read by fleet failover to tell a started-but-unrecorded session
+    # (must FAULT typed — an unstarted rebuild would replay tokens the
+    # client already has) from a genuinely unstarted one (safe re-queue)
+    delivered: int = 0
     # the REQUESTED terminal (cancel()/shed set it; the engine applies it
     # at the next safe boundary) — what the `cancelled` property reads
     _abort: Optional[str] = dataclasses.field(default=None, repr=False)
@@ -1832,6 +1850,30 @@ class ServingEngine:
         # sessions to a peer (ServingEngine.drain) — submit() then raises
         # instead of queueing a stream the engine will never serve
         self._draining = False
+        # --- fleet supervision hooks (vtpu/serving/fleet) ----------------
+        if (serving.duty_supplier is not None
+                and not callable(serving.duty_supplier)):
+            raise ValueError(
+                "ServingConfig.duty_supplier must be a zero-arg callable "
+                f"returning a duty fraction (or None), got "
+                f"{type(serving.duty_supplier).__name__}")
+        # tick-liveness heartbeat: monotonic_ns stamped at EVERY flush
+        # boundary (_tick_head — idle passes included, so a healthy idle
+        # engine beats continuously). 0 until the loop's first pass: a
+        # fleet monitor treats "no beat yet" as warming up (executable
+        # compiles can take seconds), never as a miss.
+        self._beat_ns = 0
+        # session-ledger hook: when a fleet owns this engine it installs a
+        # callable here; the loop invokes it at every flush boundary ON
+        # THE LOOP THREAD (the single writer of slots/parked/history), so
+        # the fleet's recovery-metadata ledger is a coherent snapshot.
+        # None (the default) costs one attribute check per flush.
+        self._ledger_hook: Optional[Callable] = None
+        # the engine_death seam fired: the loop thread exited WITHOUT its
+        # shutdown sweep (no terminals, no releases — a SIGKILL stand-in).
+        # Read by the fleet's fencing/failover path and by _loop's finally
+        # (which must skip cleanup to preserve the crash semantics).
+        self._died = False
 
     # ------------------------------------------------------------------ API
 
@@ -3571,6 +3613,8 @@ class ServingEngine:
         if fetched is None:
             fetched = self._fetch(tuple(f["tokens"] for f in firsts),
                                   kind="admission")
+        if self._died:
+            return  # fleet fencing, post-fetch (see _deliver)
         for f, arr in zip(firsts, fetched):
             for slot, req, idx in f["rows"]:
                 if req is not self._slot_req[slot]:
@@ -3597,6 +3641,7 @@ class ServingEngine:
             self._history[slot].append(tok)
         self._itl_last[slot] = time.perf_counter()
         self._note_first_token(req, slot)
+        req.delivered += 1
         req.out.put(tok)
         self._stats["generated_tokens"] += 1
         if self._slot_budget[slot] <= 0 or tok == self.serving.eos_token:
@@ -3630,6 +3675,14 @@ class ServingEngine:
         else:
             toks, *first_arrs = self._fetch((tick["tokens"],) + extra)
             lps = None
+        if self._died:
+            # the fleet fencing flag, checked AFTER the fetch (the block
+            # site a wedged loop thread resumes from): a DEAD-declared
+            # engine's sessions may already be rebuilt on survivors —
+            # emitting here would deliver the same tokens from two
+            # engines. Drop the whole delivery; the loop exits at its
+            # next while-check without cleanup (crash semantics).
+            return
         t0 = time.perf_counter()
         if firsts:
             self._deliver_firsts(firsts, fetched=first_arrs)
@@ -3668,6 +3721,7 @@ class ServingEngine:
         # entry to exist
         if lp is not None:
             req.logprobs.append(lp)
+        req.delivered += 1
         req.out.put(tok)
         self._stats["generated_tokens"] += 1
         self._slot_budget[slot] -= 1
@@ -3697,6 +3751,7 @@ class ServingEngine:
         self._itl_last[slot] = time.perf_counter()
         self._note_admit(req, slot, n)
         self._note_first_token(req, slot)
+        req.delivered += 1
         req.out.put(first)
         if self._slot_budget[slot] <= 0 or first == self.serving.eos_token:
             self._retire(slot)
@@ -3719,6 +3774,37 @@ class ServingEngine:
                 self._spec_ema = self._spec_probe_ema()
             return False
         return True
+
+    def signals(self) -> EngineSignals:
+        """The engine's pressure snapshot as an ``EngineSignals`` — the
+        SAME shape the shed policy receives at the overload seam, exposed
+        so a fleet router (vtpu/serving/fleet.RoutePolicy) scores engines
+        on it. Thread-safe for cross-thread readers: every field is a
+        single read of a counter, gauge or locked property. ``duty`` is
+        the attested device busy fraction from
+        ``ServingConfig.duty_supplier`` (None without one — a raising
+        supplier degrades to None, never to a dead caller)."""
+        duty = None
+        sup = self.serving.duty_supplier
+        if sup is not None:
+            try:
+                duty = sup()
+            except Exception:
+                log.exception("duty_supplier raised; reporting duty=None")
+        return EngineSignals(
+            queue_depth=self._pending.qsize() + len(self._waiting),
+            active_slots=sum(r is not None for r in self._slot_req),
+            pool_free=self._alloc.free_blocks if self._paged else None,
+            pool_used_hwm=self._alloc.used_hwm if self._paged else None,
+            parked_sessions=len(self._parked),
+            prefill_backlog=(self._disagg.backlog()
+                             if self._disagg is not None
+                             else len(self._admitting)),
+            now_ns=time.monotonic_ns(),
+            pool_blocks=(self._n_blocks - 1) if self._paged else None,
+            draining=self._draining,
+            duty=duty,
+        )
 
     def stats(self) -> dict:
         """Serving counters snapshot (thread-safe reads of monotonic
@@ -4087,7 +4173,17 @@ class ServingEngine:
                 self._loop_pipelined()
             else:
                 self._loop_sync()
+        except EngineDeath:
+            # the engine_death seam: the loop thread vanishes WITHOUT its
+            # shutdown sweep — no terminals, no releases, clients left
+            # hanging (the SIGKILL stand-in). The finally below observes
+            # _died and skips cleanup; recovering the sessions is the
+            # fleet supervisor's job (ledger + failover), reclaiming the
+            # host bookkeeping is its reap's.
+            return
         finally:
+            if self._died:
+                return
             if self._disagg is not None:
                 # workers first: the drain below owns everything they
                 # might still be releasing (their stop paths return blocks
@@ -4114,6 +4210,24 @@ class ServingEngine:
         first: finishing an admission frees its head-of-line latency and
         its budget claim. Returns whether any admission happened."""
         t0 = time.perf_counter()
+        # fleet supervision, in ledger-then-heartbeat-then-death order:
+        # (1) the session ledger records recovery metadata as of the LAST
+        # delivery (everything delivered so far is reflected; the
+        # in-flight dispatch is not — it dies with a crash and is
+        # regenerated by the rebuild, never duplicated); (2) the
+        # tick-liveness heartbeat stamps; (3) the engine_death seam fires
+        # AFTER both, so at the deterministic death point the ledger is
+        # exactly as fresh as the stream the client saw.
+        hook = self._ledger_hook
+        if hook is not None:
+            try:
+                hook(self)
+            except Exception:  # a fleet bug must not take the loop down
+                log.exception("session-ledger hook raised; continuing")
+        self._beat_ns = time.monotonic_ns()
+        if self._fire_fault("engine_death"):
+            self._died = True
+            raise EngineDeath("injected engine_death at the flush boundary")
         swap_s = 0.0
         if self._paged:
             self._drain_prefix_work()
@@ -4243,24 +4357,14 @@ class ServingEngine:
             waiters = list(self._waiting)
             if self._shed_signals:
                 # the pressure snapshot the policy decides against — pool
-                # state included, so overload victims can be chosen by
-                # MEMORY pressure, not queue depth alone (the first wire
-                # of the monitor->scheduler feedback loop into an
-                # engine-side actuator)
-                signals = EngineSignals(
-                    queue_depth=len(waiters),
-                    active_slots=sum(
-                        r is not None for r in self._slot_req),
-                    pool_free=(self._alloc.free_blocks
-                               if self._paged else None),
-                    pool_used_hwm=(self._alloc.used_hwm
-                                   if self._paged else None),
-                    parked_sessions=len(self._parked),
-                    prefill_backlog=(self._disagg.backlog()
-                                     if self._disagg is not None
-                                     else len(self._admitting)),
-                    now_ns=time.monotonic_ns(),
-                )
+                # state (and attested duty, when a supplier is wired)
+                # included, so overload victims can be chosen by MEMORY or
+                # DEVICE pressure, not queue depth alone (the
+                # monitor->scheduler feedback loop's engine-side
+                # actuator). queue_depth pins to THIS shed decision's
+                # waiter snapshot, not the racing pending-queue size.
+                signals = dataclasses.replace(
+                    self.signals(), queue_depth=len(waiters))
                 victims = list(self._shed_policy.select(
                     waiters, excess, signals))[:excess]
             else:
@@ -4461,11 +4565,14 @@ class ServingEngine:
             self._inflight_slots = (
                 {i for i in range(b) if inflight["reqs"][i] is not None}
                 if inflight is not None else set())
-        if inflight is not None:
+        if inflight is not None and not self._died:
             # stop() landed between dispatch and delivery: the tick's
             # tokens are already computed — deliver them so a mid-stream
             # client loses nothing the sync loop would have given it (and
-            # the device_gets == decode_ticks contract survives shutdown)
+            # the device_gets == decode_ticks contract survives shutdown).
+            # A _died engine must NOT deliver (the fleet fencing flag: by
+            # now the sessions may be rebuilt on survivors, and a late
+            # delivery here would duplicate their tokens).
             self._deliver(inflight)
 
     def _loop_device(self) -> None:
@@ -4659,10 +4766,11 @@ class ServingEngine:
             self._inflight_slots = (
                 {i for i in range(b) if inflight["reqs"][i] is not None}
                 if inflight is not None else set())
-        if inflight is not None:
+        if inflight is not None and not self._died:
             # stop() landed between dispatch and delivery: the flush's
             # tokens are already computed — deliver them (same contract as
-            # the one-tick pipelined loop's shutdown delivery)
+            # the one-tick pipelined loop's shutdown delivery; _died gates
+            # it exactly as there — a fenced engine never delivers late)
             self._deliver_flush(inflight)
 
     def _deliver_flush(self, flush: dict, extra_host_s: float = 0.0,
@@ -4695,6 +4803,10 @@ class ServingEngine:
             toks, counts, *first_arrs = self._fetch(
                 (flush["tokens"], flush["counts"]) + extra, ticks=k)
             lps = None
+        if self._died:
+            # fleet fencing, post-fetch (see _deliver): a DEAD-declared
+            # engine must not emit — its sessions may live on survivors
+            return
         t0 = time.perf_counter()
         if firsts:
             self._deliver_firsts(firsts, fetched=first_arrs)
@@ -4732,6 +4844,7 @@ class ServingEngine:
                     # logprob BEFORE the queue put (see _emit)
                     if lps is not None:
                         req.logprobs.append(float(lps[slot, j]))
+                    req.delivered += 1
                     req.out.put(tok)
                 self._stats["generated_tokens"] += cnt
                 if self._track_history:
@@ -4850,6 +4963,8 @@ class ServingEngine:
                 disp_s = time.perf_counter() - t_disp
                 self._prof.note("dispatch", disp_s)
                 pred, count = self._fetch((pred, count))
+                if self._died:
+                    return  # fleet fencing, post-fetch (see _deliver)
                 t0 = time.perf_counter()
                 emitted_total = 0
                 for slot in active_slots:
@@ -4867,6 +4982,7 @@ class ServingEngine:
                         req = self._slot_req[slot]
                         for tok in emitted:
                             self.trace.record("token", req.rid, slot)
+                            req.delivered += 1
                             req.out.put(tok)
                         # acceptance accounting uses DELIVERED tokens
                         # (post-eos truncation): the device's raw count
@@ -4952,6 +5068,8 @@ class ServingEngine:
             disp_s = time.perf_counter() - t_disp
             self._prof.note("dispatch", disp_s)
             logits = self._fetch(logits)
+            if self._died:
+                return  # fleet fencing, post-fetch (see _deliver)
             t0 = time.perf_counter()
             for slot in active_slots:
                 try:

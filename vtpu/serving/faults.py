@@ -46,10 +46,29 @@ Seams (where the engine consults the plan):
                       destination falls back to recompute, or delivers a
                       typed FAULTED terminal when the session cannot be
                       rebuilt
+- ``engine_death``    the serving loop thread dies AT A FLUSH BOUNDARY
+                      without running any of its cleanup (no terminals, no
+                      block releases — the in-process stand-in for a
+                      SIGKILLed engine process): heartbeats stop, clients
+                      hang, and the fleet supervisor
+                      (vtpu/serving/fleet.EngineFleet) must detect the
+                      silence, declare the engine DEAD and rebuild every
+                      session it held on survivors from the session ledger
+- ``probe_loss``      a fleet health probe is LOST (consulted by the fleet
+                      monitor, once per engine per probe round in
+                      sorted-name order): the probe counts as a miss even
+                      though the engine is healthy — the deterministic
+                      driver of the SUSPECT-but-alive hysteresis path
 
 Thread-safe: workers and the serving loop hit seams concurrently; each
 ``fire`` takes the plan's lock (off the hot path — a seam consult is one
 dict lookup when no plan is configured, and the plan itself is opt-in).
+
+Timing-coupled seams (``engine_death`` especially: its arrival index is
+the engine's flush-boundary count, which idle passes inflate under load)
+can be armed mid-run with ``FaultPlan.arm(seam)`` — "fire at the NEXT
+arrival" — so a test or bench can stream a known number of tokens first
+and then kill the engine at the very next flush, deterministically.
 """
 
 from __future__ import annotations
@@ -70,6 +89,8 @@ SEAMS = (
     "delayed_fetch",
     "migrate_src_death",
     "migrate_payload_loss",
+    "engine_death",
+    "probe_loss",
 )
 
 
@@ -86,6 +107,17 @@ class WorkerDeath(BaseException):
     the loop-thread supervisor must recover from. BaseException so the
     worker's ordinary ``except Exception`` containment (which releases the
     reservation — too graceful for a crash) cannot swallow it."""
+
+
+class EngineDeath(BaseException):
+    """Kills the SERVING LOOP thread without running its shutdown sweep —
+    the ``engine_death`` seam's payload, and the WorkerDeath discipline
+    applied to the whole engine: no typed terminals are delivered, no
+    blocks released, no lifecycle tickets failed. Every client of the
+    engine is left hanging exactly as a SIGKILLed process would leave
+    them, which is the state fleet failover (vtpu/serving/fleet) exists
+    to recover from. BaseException so no containment ``except Exception``
+    inside the loop can accidentally survive its own death."""
 
 
 @dataclasses.dataclass(frozen=True)
@@ -149,6 +181,26 @@ class FaultPlan:
                     specs.append(FaultSpec(seam, at=i, count=1,
                                            arg=args.get(seam, 0.0)))
         return cls(specs)
+
+    def arm(self, seam: str, count: int = 1, arg: float = 0.0) -> FaultSpec:
+        """Schedule *seam* to fire at its NEXT ``count`` arrivals — "kill
+        it at the next flush boundary", armed mid-run. This is the
+        deterministic handle for seams whose arrival index is timing-
+        coupled (``engine_death``: idle passes count as arrivals, so a
+        fixed ``at`` lands at a load-dependent moment): a test streams the
+        tokens it wants first, then arms the seam, and the very next pass
+        through the seam injects. Returns the spec it scheduled."""
+        with self._lock:
+            if seam not in SEAMS:
+                raise ValueError(f"unknown fault seam {seam!r}; "
+                                 f"known: {SEAMS}")
+            spec = FaultSpec(seam, at=self._arrivals[seam], count=count,
+                             arg=arg)
+            self.specs = self.specs + (spec,)
+            tbl = self._sched[seam]
+            for i in range(spec.at, spec.at + spec.count):
+                tbl.setdefault(i, spec)
+            return spec
 
     def fire(self, seam: str) -> Optional[FaultSpec]:
         """One arrival at ``seam``; returns the FaultSpec to inject or

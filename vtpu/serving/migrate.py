@@ -296,15 +296,31 @@ def _live_sessions(src) -> list:
     return out
 
 
-def drain_engine(src, dst, timeout: float = 120.0) -> dict:
-    """Evacuate *src* onto *dst* (see ServingEngine.drain): close
-    admission, migrate every session the source still owes a stream, and
-    return once the source holds nothing — no slots, nothing parked,
-    queued, admitting, or worker-owned. Cancelled sessions retire on the
-    source with their typed terminal (the caller abandoned them; drain
-    never ends a stream itself); sessions that complete naturally during
-    the evacuation are counted, not moved."""
-    _compat_check(src, dst)
+def drain_engine(src, dst=None, timeout: float = 120.0, choose_dst=None,
+                 on_migrated=None) -> dict:
+    """Evacuate *src* (see ServingEngine.drain): close admission,
+    migrate every session the source still owes a stream, and return
+    once the source holds nothing — no slots, nothing parked, queued,
+    admitting, or worker-owned. Cancelled sessions retire on the source
+    with their typed terminal (the caller abandoned them; drain never
+    ends a stream itself); sessions that complete naturally during the
+    evacuation are counted, not moved.
+
+    The destination is either FIXED (*dst* — the engine-pair form) or
+    chosen PER SESSION by ``choose_dst(req) -> engine`` (the fleet
+    router's rolling-evacuation form: each session lands on the
+    best-scored survivor at its moment; a selector with no candidate
+    raises MigrationError, aborting the drain). ``on_migrated(req,
+    target)`` observes each successful move (the fleet's assignment
+    record rides it)."""
+    if (dst is None) == (choose_dst is None):
+        raise ValueError("pass exactly one of dst / choose_dst")
+    if dst is not None:
+        _compat_check(src, dst)
+
+        def choose_dst(req, _dst=dst):
+            return _dst
+
     src._draining = True
     t0 = time.perf_counter()
     migrated = completed = faulted = 0
@@ -327,8 +343,9 @@ def drain_engine(src, dst, timeout: float = 120.0) -> dict:
                 # admits it migrates fine (content snapshot). Retrying
                 # it here would livelock the drain instead.
                 continue
+            target = choose_dst(req)
             try:
-                rep = migrate(req, src, dst, timeout=max(remaining, 1.0))
+                rep = migrate(req, src, target, timeout=max(remaining, 1.0))
             except MigrationError:
                 # settled/cancelled in the window, or transiently
                 # unparkable (mid-chunk, worker-owned): the next pass
@@ -343,6 +360,8 @@ def drain_engine(src, dst, timeout: float = 120.0) -> dict:
                 faulted += 1
             elif rep["path"] not in ("cancelled", "gone"):
                 migrated += 1
+                if on_migrated is not None:
+                    on_migrated(req, target)
         if time.perf_counter() - t0 > timeout:
             raise MigrationError(
                 f"drain timed out after {timeout:.1f}s with sessions still "

@@ -33,9 +33,10 @@ from typing import Iterable, List, Optional
 
 @dataclasses.dataclass(frozen=True)
 class EngineSignals:
-    """The pressure snapshot a ShedPolicy decides against — deliberately
-    small and plain-data so user policy programs can be tested without an
-    engine. Pool fields are None on dense (non-paged) engines."""
+    """The pressure snapshot a ShedPolicy (and, since the fleet router,
+    a RoutePolicy) decides against — deliberately small and plain-data so
+    user policy programs can be tested without an engine. Pool fields are
+    None on dense (non-paged) engines."""
 
     queue_depth: int = 0           # live waiting-line length (pre-shed)
     active_slots: int = 0          # slots with a live request
@@ -44,6 +45,21 @@ class EngineSignals:
     parked_sessions: int = 0       # overcommit parked set size
     prefill_backlog: int = 0       # disagg backlog / mid-chunk admissions
     now_ns: int = 0                # monotonic_ns the snapshot was taken
+    # usable pool capacity in blocks (None on dense engines): with
+    # pool_free it gives policies an occupancy FRACTION, the number the
+    # fleet router's imbalance threshold is denominated in
+    pool_blocks: Optional[int] = None
+    # admission is closed for a drain/redeploy — a router must not score
+    # this engine as a destination (the stats()["draining"] gauge, made
+    # policy-visible)
+    draining: bool = False
+    # attested device duty in [0, 1] (the ROADMAP feedback-loop field):
+    # populated from ServingConfig.duty_supplier — fed from the libvtpu
+    # calibration region mirror when one is present — and None when no
+    # supplier is configured or the supplier has no reading. Shed AND
+    # route policies consume it: overload victims and routing targets can
+    # be chosen by DEVICE-TRUTH busyness, not host-side queue depth alone.
+    duty: Optional[float] = None
 
 
 class ShedPolicy:
