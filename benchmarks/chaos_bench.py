@@ -91,7 +91,15 @@ def main() -> None:
     cfg_bf16 = ModelConfig(**mk)
     params = init_params(jax.random.key(0), cfg)
     prompt_len = 8
-    pages_per = -(-(prompt_len + a.max_new) // a.page)
+    # core wave-1 budget gets the SAME floor the migrate/fleet scenarios
+    # use for their kills: the park (and the eviction pressure the swap
+    # seams need) must land while wave 1 is still live — on a starved
+    # smoke runner a 10-token budget can fully drain between take(2) and
+    # park (the engine keeps decoding whether or not the client reads),
+    # leaving nothing to park, no eviction, and the gated at=0 spill seam
+    # never consulted (prompt 8 + 24 < max_seq 64)
+    core_new = max(a.max_new, 24)
+    pages_per = -(-(prompt_len + core_new) // a.page)
 
     def prompt(seed: int):
         return [int(t) for t in jax.random.randint(
@@ -162,7 +170,7 @@ def main() -> None:
                "burst_idx": []}
 
         def submit(seed, **kw):
-            req = eng.submit(prompt(seed), max_new_tokens=a.max_new, **kw)
+            req = eng.submit(prompt(seed), max_new_tokens=core_new, **kw)
             out["reqs"].append(req)
             out["streams"].append([])
             return len(out["reqs"]) - 1, req
@@ -228,7 +236,7 @@ def main() -> None:
 
     def core_serving(faults=None, shed=False):
         return ServingConfig(
-            slots=waves, prefill_buckets=(16,), max_new_tokens=a.max_new,
+            slots=waves, prefill_buckets=(16,), max_new_tokens=core_new,
             prefill_chunk=16, kv_page=a.page,
             kv_pool_blocks=waves * pages_per + 1,
             kv_swap=max(waves * pages_per // 2, 1),
@@ -580,7 +588,18 @@ def main() -> None:
         stats_a = engines["a"].stats()
     finally:
         fleet.stop()
+    # ISSUE 15: every DEAD engine yields a loadable black box, and the
+    # killed sessions' journeys stitch token-conserved across the hop
+    from vtpu.obs.fleettrace import validate_bundle
+
+    journeys = fleet.trace.journeys()
+    bundle_ok = validate_bundle(fleet.trace.bundles().get("a"))
     gates = {
+        "postmortem_bundle": bundle_ok,
+        "journeys_conserved": all(
+            journeys.get(r.jid, {}).get("conserved") is True
+            and journeys.get(r.jid, {}).get("n_hops") == 2
+            for r in reqs),
         "all_terminal": all(r.status is not None for r in reqs),
         "all_ok": all(r.status == Status.OK for r in reqs),
         "token_equal": streams == ref_streams,

@@ -185,6 +185,12 @@ def main() -> None:
             clean = all(pools_clean(e) for e in engines.values())
         finally:
             fleet.stop()
+        # ISSUE 15: the flight recorder's black box and the stitched
+        # journeys, audited after stop (the final journey-end pass ran)
+        from vtpu.obs.fleettrace import validate_bundle
+
+        journeys = fleet.trace.journeys()
+        bundle_ok = validate_bundle(fleet.trace.bundles().get("a"))
         gates = {
             "token_equal": streams == want,
             "all_ok": all(r.status == Status.OK for r in reqs),
@@ -198,6 +204,15 @@ def main() -> None:
             "survivors_rebuilt": sum(
                 fs["engines"][n]["migrations_in"]
                 for n in ("b", "c")) == sessions,
+            # every session ONE journey: route -> failover, per-hop
+            # tokens summing to exactly the delivered stream
+            "journeys_conserved": all(
+                journeys.get(r.jid, {}).get("n_hops") == 2
+                and [h["kind"] for h in journeys[r.jid]["hops"]]
+                == ["route", "failover"]
+                and journeys[r.jid]["conserved"] is True
+                for r in reqs),
+            "postmortem_bundle": bundle_ok,
         }
         ok = all(gates.values())
         all_pass &= ok
@@ -205,6 +220,8 @@ def main() -> None:
             "name": f"kill_failover[{name}]", "pass": ok, "gates": gates,
             "failover_sessions": fs["failover_sessions"],
             "probe_misses": fs["probe_misses"],
+            "stitched_blackout_p50_ms":
+                fleet.stats()["failover_blackout_p50_ms"],
         })
         log(f"kill_failover[{name}]: pass={ok} gates={gates}")
 

@@ -421,6 +421,126 @@ def test_rebalance_migrates_off_pressured_engine(params, refs):
         fleet.stop()
 
 
+def test_journey_migrate_once_stitched(params, refs):
+    """ISSUE 15 tentpole, cooperative half: a session that migrates once
+    (fleet.migrate_session) yields ONE stitched journey span — two hops
+    under the jid (route -> migrate), per-hop token counts summing to
+    exactly the delivered stream (token conservation), and a migration
+    blackout window between the source's last and the destination's
+    first delivered token."""
+    fleet, engines = _fleet(params, names=("a", "b"),
+                            fc={"route_policy": PinPolicy("a")})
+    fleet.start()
+    try:
+        req = fleet.submit(P1, max_new_tokens=STEPS)
+        it = req.stream()
+        head = [next(it), next(it)]
+        rep = fleet.migrate_session(req, "b")
+        assert rep["path"] in ("resident", "host", "recompute")
+        assert head + list(it) == refs[0]
+        assert req.status == Status.OK
+    finally:
+        fleet.stop()
+    # stop() runs the final journey-end pass: the stitch is settled
+    j = fleet.trace.journeys()[req.jid]
+    assert j["ended"] and j["terminal"] == "OK"
+    assert j["n_hops"] == 2
+    assert [h["kind"] for h in j["hops"]] == ["route", "migrate"]
+    assert [h["engine"] for h in j["hops"]] == ["a", "b"]
+    assert all(h["tokens"] > 0 for h in j["hops"])
+    # the correctness contract: per-hop tokens sum to the delivered
+    # stream — nothing double-counted across the handoff, nothing lost
+    assert j["tokens"] == j["delivered"] == STEPS
+    assert j["conserved"] is True and j["truncated"] is False
+    (b,) = j["blackouts"]
+    assert b["kind"] == "migration"
+    assert b["ms"] is not None and b["ms"] >= 0
+    assert b["src_last_tok_ns"] <= b["dst_first_tok_ns"]
+    # per-hop latency attribution is well-formed
+    assert all(h["ttft_ms"] is None or h["ttft_ms"] >= 0
+               for h in j["hops"])
+    s = fleet.stats()
+    assert s["journeys_ended"] >= 1 and s["journeys_conserved"] >= 1
+    assert s["migration_blackout_p50_ms"] is not None
+
+
+def test_journey_failover_stitched_with_bundle(params, refs):
+    """ISSUE 15 tentpole, crash half: a session rebuilt by failover
+    yields ONE journey span (route -> failover) with token conservation
+    and a failover blackout window bracketing the kill — and the DEAD
+    engine leaves a post-mortem bundle (flight recorder) that is
+    JSON-parseable, carries the corpse's ring/stats/signals/ledger
+    census, and dumps as valid JSONL. The corpse still audits clean
+    (leak_check re-checks at teardown): the black box is a SNAPSHOT, the
+    reap still ran."""
+    import io
+    import json
+
+    plan = FaultPlan()
+    fleet, engines = _fleet(params, names=("a", "b"),
+                            faults_for={"a": plan},
+                            fc={"route_policy": PinPolicy("a")})
+    fleet.start()
+    try:
+        req = fleet.submit(P1, max_new_tokens=STEPS)
+        it = req.stream()
+        head = [next(it), next(it)]
+        t_arm = time.monotonic_ns()
+        plan.arm("engine_death")  # die at the very next flush boundary
+        assert head + list(it) == refs[0]
+        assert req.status == Status.OK
+    finally:
+        fleet.stop()
+    j = fleet.trace.journeys()[req.jid]
+    assert j["n_hops"] == 2
+    assert [h["kind"] for h in j["hops"]] == ["route", "failover"]
+    assert j["tokens"] == j["delivered"] == STEPS
+    assert j["conserved"] is True and j["truncated"] is False
+    (b,) = j["blackouts"]
+    assert b["kind"] == "failover" and b["ms"] > 0
+    # the window brackets the kill: the corpse's last delivered token
+    # precedes the death (armed at t_arm, fired at the next flush), and
+    # the survivor's first token follows it
+    assert b["dst_first_tok_ns"] > t_arm
+    assert b["src_last_tok_ns"] <= b["dst_first_tok_ns"]
+
+    # flight recorder: the corpse's black box, snapshotted at fencing
+    bundle = fleet.trace.bundles()["a"]
+    assert bundle == json.loads(json.dumps(bundle)), "bundle must be JSON"
+    assert bundle["engine"] == "a" and bundle["reason"] == "dead"
+    assert bundle["stats"]["generated_tokens"] >= 2
+    assert bundle["signals"] is not None
+    census = bundle["ledger"]
+    assert any(c["jid"] == req.jid and c["delivered"] >= 2
+               and not c["unstarted"] for c in census)
+    evs = bundle["events"]
+    assert any(e["event"] == "first_token" for e in evs)
+    assert isinstance(bundle["chrome"]["traceEvents"], list)
+    sio = io.StringIO()
+    n_lines = fleet.trace.dump_bundle("a", sio)
+    lines = sio.getvalue().splitlines()
+    assert n_lines == len(lines) > 2
+    parsed = [json.loads(ln) for ln in lines]
+    assert parsed[0]["kind"] == "postmortem"
+    assert parsed[-1]["kind"] == "chrome"
+
+    # merged chrome dump: one pid per engine + the fleet-control track,
+    # with the supervision/failover control events as instants
+    doc = fleet.trace.chrome_trace()
+    pids = {e["pid"] for e in doc["traceEvents"]}
+    assert pids >= {1, 2, 3}  # control + two engines
+    instants = {e["name"] for e in doc["traceEvents"]
+                if e["ph"] == "i" and e["pid"] == 1}
+    assert {"route", "probe_miss", "dead", "fence",
+            "failover_rebuild"} <= instants
+    assert any(e["ph"] == "X" and "blackout" in e["name"]
+               for e in doc["traceEvents"] if e["pid"] == 1)
+    s = fleet.stats()
+    assert s["postmortem_bundles"] == 1
+    assert s["failover_blackout_p50_ms"] is not None
+    assert s["rebuild_p50_ms"] is not None
+
+
 def test_fleet_stats_and_ledger_shape(params):
     """The ledger records started sessions at flush boundaries (the
     exact migrate-handshake metadata), and stats() carries the fleet

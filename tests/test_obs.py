@@ -125,6 +125,117 @@ def test_chrome_trace_deferred_park_resume_slice_is_queued():
     assert names == ["queued", "parked", "queued", "streaming"]
 
 
+def test_chrome_trace_pid_name_override():
+    """ISSUE 15 satellite: chrome_trace() accepts pid/name/t0_ns so
+    multi-engine dumps merge without rid collisions — and the DEFAULT
+    output is byte-identical to the pre-override format (pid 1,
+    'vtpu-serving', own-earliest-event origin)."""
+    tr = RequestTrace(capacity=64)
+    for ev in ("submit", "admit", "first_token", "token", "retire"):
+        tr.record(ev, 3)
+    default = tr.chrome_trace()
+    explicit = tr.chrome_trace(pid=1, name="vtpu-serving")
+    assert json.dumps(default) == json.dumps(explicit)
+    assert all(e["pid"] == 1 for e in default["traceEvents"])
+    meta = default["traceEvents"][0]
+    assert meta["name"] == "process_name"
+    assert meta["args"]["name"] == "vtpu-serving"
+    # override: every event re-pids, the process renames, and a shifted
+    # origin moves every timestamp by the same offset
+    t0 = min(e[1] for e in tr.snapshot())
+    shifted = tr.chrome_trace(pid=7, name="engine:b", t0_ns=t0 - 1_000_000)
+    assert all(e["pid"] == 7 for e in shifted["traceEvents"])
+    assert shifted["traceEvents"][0]["args"]["name"] == "engine:b"
+    base = {(e["ph"], e["name"]): e["ts"]
+            for e in default["traceEvents"] if "ts" in e}
+    for e in shifted["traceEvents"]:
+        if "ts" in e:
+            assert e["ts"] == pytest.approx(
+                base[(e["ph"], e["name"])] + 1000.0)
+
+
+def test_span_first_last_token_stamps():
+    """spans() exposes first/last DELIVERED token stamps (first_token OR
+    token — a migrated-in hop never records first_token): the endpoints
+    journey stitching measures blackout windows between."""
+    tr = RequestTrace(capacity=64)
+    tr.record("migrate_in", 4)
+    tr.record("resume", 4)
+    for _ in range(3):
+        tr.record("token", 4)
+        time.sleep(0.001)
+    tr.record("retire", 4)
+    s = tr.spans()[4]
+    assert s["first_token_ns"] is None  # no first_token event on this hop
+    assert s["first_tok_ns"] is not None
+    assert s["last_tok_ns"] > s["first_tok_ns"]
+    assert s["tokens"] == 3
+
+
+def test_fleettrace_unit_ring_journeys_bundle_shapes():
+    """FleetTrace unit semantics: the control ring is bounded with drop
+    accounting; a two-hop journey stitches per-engine spans into one
+    span with per-hop tokens, a blackout window, and the conservation
+    verdict; the SLO histograms note exactly once at journey end."""
+    from vtpu.obs.fleettrace import FleetTrace
+
+    ft = FleetTrace(capacity=4)
+    for i in range(10):
+        ft.control("probe_miss", engine="a", val=i)
+    assert ft.events_recorded == 10
+    assert ft.events_dropped == 6
+    assert [e["val"] for e in ft.events()] == list(range(6, 10))
+
+    # synthetic two-engine journey: 2 tokens on 'a', 3 on 'b'
+    ta, tb = RequestTrace(capacity=64), RequestTrace(capacity=64)
+    ft.attach("a", ta)
+    ft.attach("b", tb)
+    ta.record("submit", 0)
+    ta.record("first_token", 0)
+    ta.record("token", 0)
+    jid = ft.begin_journey("a", 0)
+    assert jid >= 0
+    time.sleep(0.002)
+    ft.hop(jid, "b", 5, "failover")
+    for _ in range(3):
+        tb.record("token", 5)
+    tb.record("retire", 5)
+    ft.end_journey(jid, delivered=5, terminal="OK")
+    ft.end_journey(jid, delivered=99, terminal="FAULTED")  # idempotent
+    j = ft.journeys()[jid]
+    assert j["n_hops"] == 2 and j["ended"]
+    assert [h["kind"] for h in j["hops"]] == ["route", "failover"]
+    assert [h["tokens"] for h in j["hops"]] == [2, 3]
+    assert j["tokens"] == 5 and j["delivered"] == 5
+    assert j["conserved"] is True and j["truncated"] is False
+    assert j["terminal"] == "OK"
+    (b,) = j["blackouts"]
+    assert b["kind"] == "failover" and b["ms"] > 0
+    assert ft.failover_blackout_hist.count == 1
+    assert ft.migration_blackout_hist.count == 0
+    assert ft.hops_hist == {2: 1}
+    s = ft.stats()
+    assert s["journeys_ended"] == 1 and s["journeys_conserved"] == 1
+    assert s["failover_blackout_p50_ms"] == pytest.approx(b["ms"], rel=1e-3)
+
+    # a hop whose events the ring never saw voids conservation honestly
+    jid2 = ft.begin_journey("a", 777)
+    ft.end_journey(jid2, delivered=4, terminal="OK")
+    # single-hop journeys skip span derivation; a MISSING multi-hop rid
+    # marks the stitch truncated instead of failing conservation
+    jid3 = ft.begin_journey("a", 888)
+    ft.hop(jid3, "b", 999, "rescue")
+    ft.end_journey(jid3, delivered=4, terminal="OK")
+    j3 = ft.journeys()[jid3]
+    assert j3["truncated"] is True and j3["conserved"] is False
+
+    # disabled plane: every recorder is a no-op
+    off = FleetTrace(capacity=0)
+    off.control("route", engine="a")
+    assert off.begin_journey("a", 0) == -1
+    assert off.events_recorded == 0 and off.journeys() == {}
+
+
 def test_bounded_histogram_prom_buckets():
     h = BoundedHistogram(edges_ms=(1.0, 10.0, 100.0))
     for ms in (0.5, 5.0, 50.0, 500.0, 0.2):
@@ -432,6 +543,12 @@ def test_fleet_families_shape(params):
     try:
         r = fleet.submit(_prompt(1, 5), max_new_tokens=4)
         assert len(list(r.stream())) == 4
+        # the monitor closes journeys on its prune cadence; wait for the
+        # finished stream's journey to end before scraping the hop family
+        t0 = time.perf_counter()
+        while fleet.stats()["journeys_ended"] < 1:
+            assert time.perf_counter() - t0 < 30, "journey never ended"
+            time.sleep(0.002)
         col = ServingCollector()
         col.register_fleet("f0", fleet)
         fams = list(col.collect())
@@ -450,6 +567,18 @@ def test_fleet_families_shape(params):
     assert {(s.labels["fleet"], s.labels["engine"], s.value)
             for s in health.samples} == {("f0", "a", 1.0), ("f0", "b", 1.0)}
     assert by_name["vtpu_serving_fleet_failovers"].samples[0].value == 0.0
+    # the journey plane's families ride the same registration: journey
+    # accounting, the hop-count counter, and the stitched-SLO histograms
+    assert by_name["vtpu_serving_fleet_journeys_ended"].samples
+    hops = by_name["vtpu_serving_fleet_journey_hops"]
+    assert {(s.labels["hops"], s.value) for s in hops.samples} == {("1", 1.0)}
+    for fam in ("fleet_failover_blackout_seconds",
+                "fleet_migration_blackout_seconds", "fleet_rebuild_seconds"):
+        h = by_name["vtpu_serving_" + fam]
+        assert any(s.name.endswith("_bucket") for s in h.samples)
+    # the engine-side ring-health gauges joined the scrape too
+    cap = by_name["vtpu_serving_trace_ring_capacity"]
+    assert all(s.value == 16384.0 for s in cap.samples)
 
 
 def test_serving_families_shape(params):

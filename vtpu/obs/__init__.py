@@ -9,6 +9,10 @@ host syncs and stays within 2% tokens/sec of tracing-off):
                (submit .. retire) stamped ``time.monotonic_ns`` off the
                tick hot path, with derived per-request spans, JSONL export
                and a Chrome ``trace_event`` dump that loads in Perfetto.
+- fleettrace.py: the fleet half of the plane — stitched cross-engine
+               request journeys (token-conservation contract), the fleet
+               control-event ring, the DEAD-engine flight recorder, and
+               the merged multi-pid Chrome dump.
 - tickprof.py: per-tick decode-loop phase attribution (admission head,
                dispatch, fetch, deliver, swap drain) into bounded
                histograms — where ``host_ms_per_tick`` actually goes.
@@ -21,6 +25,7 @@ host syncs and stays within 2% tokens/sec of tracing-off):
                convention).
 """
 
+from vtpu.obs.fleettrace import FleetTrace
 from vtpu.obs.summary import print_summary, summary_line
 from vtpu.obs.tickprof import BoundedHistogram, TickProfiler
 from vtpu.obs.trace import RequestTrace, pct
@@ -34,6 +39,7 @@ except ImportError:  # pragma: no cover
 
 __all__ = [
     "BoundedHistogram",
+    "FleetTrace",
     "RequestTrace",
     "ServingCollector",
     "TickProfiler",

@@ -196,6 +196,12 @@ GAUGES = {
                              1),
     "trace_enabled": ("trace_enabled",
                       "1 while the lifecycle event ring records", 1),
+    "trace_ring_capacity": ("trace_ring_capacity",
+                            "Bounded event-ring capacity (0 = disabled)", 1),
+    "trace_ring_utilization": ("trace_ring_utilization",
+                               "Live events / ring capacity — at 1.0 the "
+                               "ring wraps and stitched journeys/spans may "
+                               "silently truncate", 1),
     "kv_page": ("kv_page_tokens", "Tokens per KV block (None = dense)", 1),
     "tp": ("tp_degree", "Tensor-parallel degree", 1),
     "kv_pool_blocks": ("kv_pool_blocks", "Usable pool blocks", 1),
@@ -267,22 +273,63 @@ FLEET_COUNTERS = {
     "probes": ("fleet_probes", "Monitor probe rounds completed"),
     "suspects": ("fleet_suspects",
                  "HEALTHY->SUSPECT ladder transitions"),
+    "journeys_ended": ("fleet_journeys_ended",
+                       "Stitched request journeys closed at a terminal"),
+    "journeys_conserved": ("fleet_journeys_conserved",
+                           "Ended journeys whose per-hop token counts sum "
+                           "to exactly the delivered tokens (the stitch "
+                           "correctness contract; single-hop journeys "
+                           "count by construction — no seam to lose "
+                           "tokens at)"),
+    "journeys_truncated": ("fleet_journeys_truncated",
+                           "Ended multi-hop journeys whose stitch was "
+                           "voided by a wrapped engine trace ring"),
+    "fleet_trace_events_recorded": ("fleet_trace_events_recorded",
+                                    "Fleet control events recorded into "
+                                    "the bounded ring"),
+    "fleet_trace_events_dropped": ("fleet_trace_events_dropped",
+                                   "Fleet control events the bounded ring "
+                                   "overwrote"),
 }
+# key -> (family suffix, help, scale) — same convention as engine GAUGES
 FLEET_GAUGES = {
-    "fleet_engines": ("fleet_engines", "Engines registered in the fleet"),
+    "fleet_engines": ("fleet_engines", "Engines registered in the fleet",
+                      1),
     "healthy_engines": ("fleet_healthy_engines",
-                        "Engines currently HEALTHY"),
+                        "Engines currently HEALTHY", 1),
     "suspect_engines": ("fleet_suspect_engines",
                         "Engines currently SUSPECT (deprioritized, never "
-                        "failed over)"),
+                        "failed over)", 1),
     "dead_engines": ("fleet_dead_engines",
                      "Engines declared DEAD (fenced, failed over, "
-                     "reaped)"),
+                     "reaped)", 1),
     "draining_engines": ("fleet_draining_engines",
-                         "Engines with admission closed for a drain"),
+                         "Engines with admission closed for a drain", 1),
     "ledger_sessions": ("fleet_ledger_sessions",
                         "Started sessions currently recorded in the "
-                        "recovery ledger"),
+                        "recovery ledger", 1),
+    "journeys_open": ("fleet_journeys_open",
+                      "Stitched request journeys still in flight", 1),
+    "postmortem_bundles": ("fleet_postmortem_bundles",
+                           "Flight-recorder post-mortem bundles held "
+                           "(bounded set)", 1),
+    "failover_blackout_p50_ms": ("fleet_failover_blackout_p50_seconds",
+                                 "Failover blackout p50: last delivered "
+                                 "token on the corpse -> first on the "
+                                 "survivor", 1e-3),
+    "failover_blackout_p99_ms": ("fleet_failover_blackout_p99_seconds",
+                                 "Failover blackout p99", 1e-3),
+    "migration_blackout_p50_ms": ("fleet_migration_blackout_p50_seconds",
+                                  "Migration blackout p50: last token on "
+                                  "the source hop -> first on the "
+                                  "destination", 1e-3),
+    "migration_blackout_p99_ms": ("fleet_migration_blackout_p99_seconds",
+                                  "Migration blackout p99", 1e-3),
+    "rebuild_p50_ms": ("fleet_rebuild_p50_seconds",
+                       "Failover rebuild latency p50 (claim -> resumed "
+                       "on the survivor)", 1e-3),
+    "rebuild_p99_ms": ("fleet_rebuild_p99_seconds",
+                       "Failover rebuild latency p99", 1e-3),
 }
 # handled specially (engine_states -> the per-engine health gauge below;
 # engines -> each engine's snapshot joins the ordinary vtpu_serving_*
@@ -312,12 +359,12 @@ def fleet_families(fleets: dict[str, object]) -> Iterable:
             if v is not None:
                 fam.add_metric((name,), float(v))
         yield fam
-    for key, (suffix, help_) in FLEET_GAUGES.items():
+    for key, (suffix, help_, scale) in FLEET_GAUGES.items():
         fam = GaugeMetricFamily(PREFIX + suffix, help_, labels=("fleet",))
         for name, s in snaps.items():
             v = s.get(key)
             if v is not None:
-                fam.add_metric((name,), float(v))
+                fam.add_metric((name,), float(v) * scale)
         yield fam
     fam = GaugeMetricFamily(
         PREFIX + "fleet_engine_health",
@@ -326,6 +373,41 @@ def fleet_families(fleets: dict[str, object]) -> Iterable:
     for name, s in snaps.items():
         for ename, state in sorted((s.get("engine_states") or {}).items()):
             fam.add_metric((name, ename), _HEALTH_VALUE.get(state, 0.0))
+    yield fam
+    # stitched-SLO histogram families off each fleet's FleetTrace
+    # substrate (monotonic bucket counters, the trace.py span-hist
+    # convention): blackout windows by kind, rebuild latency, and the
+    # hops-per-request labelled counter
+    slo_hists = (
+        ("fleet_failover_blackout_seconds",
+         "Failover blackout: last delivered token on the corpse -> first "
+         "on the survivor", "failover_blackout_hist"),
+        ("fleet_migration_blackout_seconds",
+         "Migration blackout: last token on the source hop -> first on "
+         "the destination", "migration_blackout_hist"),
+        ("fleet_rebuild_seconds",
+         "Failover rebuild latency (claim -> resumed on the survivor)",
+         "rebuild_hist"),
+    )
+    for suffix, help_, attr in slo_hists:
+        fam = HistogramMetricFamily(PREFIX + suffix, help_,
+                                    labels=("fleet",))
+        for name, f in fleets.items():
+            hist = getattr(getattr(f, "trace", None), attr, None)
+            if hist is not None:
+                buckets, total = hist.prom_buckets()
+                fam.add_metric((name,), buckets, total)
+        yield fam
+    fam = CounterMetricFamily(
+        PREFIX + "fleet_journey_hops",
+        "Ended journeys by hop count (1 = the stream never moved)",
+        labels=("fleet", "hops"))
+    for name, f in fleets.items():
+        trace = getattr(f, "trace", None)
+        hops = trace.hops_snapshot() if trace is not None else {}
+        for n, count in sorted(hops.items()):
+            if count:
+                fam.add_metric((name, str(n)), float(count))
     yield fam
 
 

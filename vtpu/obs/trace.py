@@ -35,7 +35,7 @@ import itertools
 import json
 import threading
 import time
-from typing import IO, Union
+from typing import IO, Optional, Union
 
 from vtpu.obs.tickprof import LATENCY_BUCKETS_MS, BoundedHistogram
 
@@ -290,6 +290,12 @@ class RequestTrace:
                     "sheds": 0, "faults": 0, "worker_restarts": 0,
                     "migrations": 0,
                     "terminal": None,
+                    # first/last DELIVERED token stamps (first_token OR
+                    # token — a migrated-in hop has no first_token event,
+                    # so first_token_ns alone cannot anchor it): the
+                    # endpoints fleet journey stitching measures blackout
+                    # windows between
+                    "first_tok_ns": None, "last_tok_ns": None,
                     "_last_tok_ns": None, "_park_ns": None,
                     "_resume_ns": None,
                 }
@@ -311,6 +317,9 @@ class RequestTrace:
             elif event in ("first_token", "token"):
                 if event == "first_token":
                     s["first_token_ns"] = ts
+                if s["first_tok_ns"] is None:
+                    s["first_tok_ns"] = ts
+                s["last_tok_ns"] = ts
                 s["tokens"] += 1
                 last = s["_last_tok_ns"]
                 if s["_resume_ns"] is not None:
@@ -398,31 +407,39 @@ class RequestTrace:
                     fh.write(json.dumps(e) + "\n")
         return len(events)
 
-    def chrome_trace(self) -> dict:
+    def chrome_trace(self, pid: int = 1, name: str = "vtpu-serving",
+                     t0_ns: Optional[int] = None) -> dict:
         """The snapshot as a Chrome ``trace_event`` JSON object (the
         "JSON Array Format" wrapped in ``{"traceEvents": [...]}``) that
         loads in Perfetto: one track (tid) per request carrying complete
         ("X") slices for the queued / streaming / parked phases, plus
         instant ("i") markers for every raw lifecycle event. Timestamps
-        are microseconds relative to the earliest event."""
+        are microseconds relative to the earliest event.
+
+        ``pid``/``name`` tag every event with this trace's process id and
+        display name, and ``t0_ns`` overrides the timestamp origin — the
+        multi-engine merge hooks: each engine's ring dumps under its OWN
+        pid (rids only name tracks within a pid, so equal rids on two
+        engines stop colliding) against one shared fleet origin. The
+        defaults reproduce the single-engine output byte-identically."""
         evs = self.snapshot()
         out: list[dict] = [{
-            "ph": "M", "pid": 1, "tid": 0, "name": "process_name",
-            "args": {"name": "vtpu-serving"},
+            "ph": "M", "pid": pid, "tid": 0, "name": "process_name",
+            "args": {"name": name},
         }]
         if not evs:
             return {"traceEvents": out, "displayTimeUnit": "ms"}
-        t0 = min(e[1] for e in evs)
+        t0 = t0_ns if t0_ns is not None else min(e[1] for e in evs)
         us = lambda ns: (ns - t0) / 1e3  # noqa: E731
         seen: set[int] = set()
         spans = self.spans()
         for seq, ts, event, rid, slot, val in evs:
             if rid not in seen:
                 seen.add(rid)
-                out.append({"ph": "M", "pid": 1, "tid": rid,
+                out.append({"ph": "M", "pid": pid, "tid": rid,
                             "name": "thread_name",
                             "args": {"name": f"request {rid}"}})
-            out.append({"ph": "i", "pid": 1, "tid": rid, "s": "t",
+            out.append({"ph": "i", "pid": pid, "tid": rid, "s": "t",
                         "ts": us(ts), "name": event,
                         "args": {"slot": slot, "val": val, "seq": seq}})
         # phase slices per request, rebuilt from the raw events so a
@@ -440,7 +457,7 @@ class RequestTrace:
                     open_ns, open_name = ts, "queued"
                 elif event in ("admit", "resume"):
                     if open_ns is not None:
-                        out.append({"ph": "X", "pid": 1, "tid": rid,
+                        out.append({"ph": "X", "pid": pid, "tid": rid,
                                     "ts": us(open_ns),
                                     "dur": max((ts - open_ns) / 1e3, 0.001),
                                     "name": open_name})
@@ -453,21 +470,21 @@ class RequestTrace:
                     open_name = "streaming" if streaming else "queued"
                 elif event in ("park", "retire"):
                     if open_ns is not None:
-                        out.append({"ph": "X", "pid": 1, "tid": rid,
+                        out.append({"ph": "X", "pid": pid, "tid": rid,
                                     "ts": us(open_ns),
                                     "dur": max((ts - open_ns) / 1e3, 0.001),
                                     "name": open_name})
                     open_ns = ts if event == "park" else None
                     open_name = "parked" if event == "park" else None
             if open_ns is not None and end_ns > open_ns:
-                out.append({"ph": "X", "pid": 1, "tid": rid,
+                out.append({"ph": "X", "pid": pid, "tid": rid,
                             "ts": us(open_ns),
                             "dur": (end_ns - open_ns) / 1e3,
                             "name": open_name or "streaming"})
             span = spans.get(rid)
             if span and span["ttft_ms"] is not None:
                 # counter track: TTFT per request, visible as a value
-                out.append({"ph": "C", "pid": 1, "ts": us(res[0][1]),
+                out.append({"ph": "C", "pid": pid, "ts": us(res[0][1]),
                             "name": "ttft_ms",
                             "args": {"ms": round(span["ttft_ms"], 3)}})
         # the prefill-worker lanes (disaggregated serving): one track PER
@@ -492,7 +509,7 @@ class RequestTrace:
                     # requests that never produce a handoff
                     tid = PREFILL_LANE_TID + wid
                     lane_tids.add(tid)
-                    lane.append({"ph": "X", "pid": 1,
+                    lane.append({"ph": "X", "pid": pid,
                                  "tid": tid,
                                  "ts": us(start_ns),
                                  "dur": max((ts - start_ns) / 1e3, 0.001),
@@ -501,7 +518,7 @@ class RequestTrace:
                     start_ns = None
         if lane:
             for tid in sorted(lane_tids):
-                out.append({"ph": "M", "pid": 1, "tid": tid,
+                out.append({"ph": "M", "pid": pid, "tid": tid,
                             "name": "thread_name",
                             "args": {"name":
                                      f"prefill worker "

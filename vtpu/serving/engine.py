@@ -559,8 +559,15 @@ class Request:
     # interactive one does
     priority: int = 0
     # trace identity: assigned by submit() (engine-unique, monotonic) and
-    # stamped on every lifecycle event this request emits; -1 until then
+    # stamped on every lifecycle event this request emits; -1 until then.
+    # rid is ENGINE-LOCAL — a migrated/rebuilt session gets a fresh rid on
+    # its destination, so one stream's lifecycle spans several rids.
     rid: int = -1
+    # fleet journey identity: assigned by EngineFleet.submit() and STABLE
+    # across engines — the key the fleet's journey stitcher joins the
+    # per-engine (engine, rid) hops under. -1 for requests submitted
+    # straight to an engine (no fleet, no journey).
+    jid: int = -1
     # submit() timestamp (time.monotonic_ns) — the origin every derived
     # span (queue wait, TTFT) measures from
     t_submit_ns: int = 0
@@ -3889,6 +3896,15 @@ class ServingEngine:
         s["trace_enabled"] = self.trace.enabled
         s["trace_events_recorded"] = self.trace.events_recorded
         s["trace_events_dropped"] = self.trace.events_dropped
+        # ring-health gauges: a wrapping ring silently truncates derived
+        # spans AND the fleet's stitched journeys (token conservation
+        # reads the ring) — utilization at 1.0 means events are falling
+        # off and the scrape should say so before a post-mortem finds out
+        s["trace_ring_capacity"] = self.trace.capacity if self.trace.enabled else 0
+        s["trace_ring_utilization"] = (
+            round(min(self.trace.events_recorded, self.trace.capacity)
+                  / self.trace.capacity, 4)
+            if self.trace.enabled else None)
         # tick-phase attribution: where host_ms_per_tick actually goes
         # (admission head / dispatch / fetch / deliver / swap drain)
         s["tick_phase_ms"] = self._prof.snapshot()

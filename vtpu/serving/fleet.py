@@ -73,6 +73,7 @@ import threading
 import time
 from typing import Any, Dict, Iterable, List, Optional
 
+from vtpu.obs.fleettrace import FleetTrace
 from vtpu.serving.engine import Request, ServingEngine, Status
 from vtpu.serving.faults import FaultPlan
 from vtpu.serving.migrate import (
@@ -194,6 +195,14 @@ class FleetConfig:
     # engine-side seams (engine_death, ...) live on each engine's
     # ServingConfig.faults as ever.
     faults: Optional[Any] = None
+    # the fleet observability plane (vtpu/obs/fleettrace.FleetTrace):
+    # control-event ring capacity. 0 disables the WHOLE plane — no
+    # control events, no journey stitching, no flight-recorder bundles —
+    # the knob the obs_bench fleet overhead A/B flips.
+    trace_events: int = 4096
+    # bounded journey registry / post-mortem bundle set sizes
+    trace_journeys: int = 4096
+    trace_bundles: int = 8
 
 
 def _ledger_entries(eng: ServingEngine) -> Dict[Request, dict]:
@@ -347,6 +356,14 @@ class EngineFleet:
         }
         self._stop_ev = threading.Event()
         self._mon: Optional[threading.Thread] = None
+        # the fleet observability plane: per-engine rings attached under
+        # their fleet names (sorted, so merged-dump pids are stable for
+        # equal fleets), journeys keyed by the jid submit() stamps
+        self.trace = FleetTrace(capacity=fleet.trace_events,
+                                max_journeys=fleet.trace_journeys,
+                                max_bundles=fleet.trace_bundles)
+        for name in sorted(self._engines):
+            self.trace.attach(name, self._engines[name].trace)
 
     # ------------------------------------------------------------- lifecycle
 
@@ -373,6 +390,10 @@ class EngineFleet:
             self._mon.join(timeout=10)
         for eng in self._engines.values():
             eng.stop()
+        # every stream now carries a terminal (the engines' shutdown
+        # sweeps deliver CANCELLED to stragglers): close their journeys
+        # so a post-shutdown journeys() read sees only ended spans
+        self._prune_assigned()
 
     def _make_hook(self, name: str):
         def hook(eng, _name=name):
@@ -401,11 +422,14 @@ class EngineFleet:
             out.append(name)
         return out
 
-    def _route_order(self, exclude: Iterable[str] = ()) -> List[str]:
-        """Candidate engines best-first: HEALTHY before SUSPECT (a
-        suspect engine still serves, but new work prefers proven-alive
-        peers), policy score descending within a tier, name ascending on
-        ties — fully deterministic for equal fleets."""
+    def _route_ranked(self, exclude: Iterable[str] = ()) \
+            -> List[tuple]:
+        """Candidate engines best-first as (name, score) pairs: HEALTHY
+        before SUSPECT (a suspect engine still serves, but new work
+        prefers proven-alive peers), policy score descending within a
+        tier, name ascending on ties — fully deterministic for equal
+        fleets. The score rides along so routing decisions can be
+        recorded next to the inputs that made them (FleetTrace)."""
         with self._mu:
             states = dict(self._health)
         ranked = []
@@ -416,7 +440,10 @@ class EngineFleet:
                 continue
             ranked.append((states.get(name) == SUSPECT, -float(score), name))
         ranked.sort()
-        return [name for _, _, name in ranked]
+        return [(name, -neg) for _, neg, name in ranked]
+
+    def _route_order(self, exclude: Iterable[str] = ()) -> List[str]:
+        return [name for name, _ in self._route_ranked(exclude)]
 
     def submit(self, tokens, max_new_tokens: int = 0, priority: int = 0,
                deadline_ms: Optional[float] = None) -> Request:
@@ -428,7 +455,7 @@ class EngineFleet:
         Prefix-backed submits are rejected — prefix registrations are
         engine-local; register on a specific engine and submit there."""
         last: Optional[BaseException] = None
-        for name in self._route_order():
+        for name, score in self._route_ranked():
             eng = self._engines[name]
             try:
                 req = eng.submit(tokens, max_new_tokens=max_new_tokens,
@@ -440,7 +467,17 @@ class EngineFleet:
                 last = exc
                 with self._mu:
                     self._fstats["reroutes"] += 1
+                self.trace.control("reroute", engine=name)
                 continue
+            # journey opens BEFORE the assignment publishes: the moment
+            # _assigned carries the request, the monitor's prune pass (or
+            # a failover sweep) may act on it — both need the jid already
+            # stamped, or a fast-finishing request would leak an
+            # unclosable journey. The winning score sits in the route
+            # event so the policy verdict is auditable.
+            req.jid = self.trace.begin_journey(name, req.rid)
+            self.trace.control("route", engine=name, jid=req.jid,
+                               score=score)
             with self._mu:
                 self._assigned[req] = name
                 swept = self._health.get(name) == DEAD
@@ -487,6 +524,8 @@ class EngineFleet:
             if rep["path"] in ("resident", "host", "recompute", "requeue"):
                 with self._mu:
                     self._assigned[req] = dst_name
+                self.trace.hop(req.jid, dst_name, req.rid, "rescue")
+                self.trace.control("reroute", engine=dst_name, jid=req.jid)
             return
 
     # ----------------------------------------------------------------- drain
@@ -526,9 +565,42 @@ class EngineFleet:
         def placed(req, target):
             with self._mu:
                 self._assigned[req] = names[target]
+            self.trace.hop(req.jid, names[target], req.rid, "drain")
 
-        return drain_engine(src, timeout=timeout, choose_dst=choose,
-                            on_migrated=placed)
+        self.trace.control("drain_start", engine=name)
+        try:
+            rep = drain_engine(src, timeout=timeout, choose_dst=choose,
+                               on_migrated=placed)
+        except MigrationError:
+            self.trace.control("drain_end", engine=name, val=-1)
+            raise
+        self.trace.control("drain_end", engine=name, val=rep["migrated"])
+        return rep
+
+    def migrate_session(self, request: Request, dst,
+                        timeout: float = 60.0) -> dict:
+        """Explicitly move one fleet-tracked session onto *dst* through
+        the PR-12 primitive, keeping the assignment record and journey
+        trace consistent — the operator's by-hand form of the move the
+        rebalancer and drain perform themselves. Returns migrate()'s
+        report dict."""
+        dst_name = self._resolve(dst)
+        with self._mu:
+            src_name = self._assigned.get(request)
+        if src_name is None:
+            raise MigrationError(
+                "request is not tracked by this fleet (submit it through "
+                "fleet.submit, or it already finished)")
+        if src_name == dst_name:
+            raise MigrationError(
+                f"request already lives on engine {dst_name!r}")
+        rep = migrate(request, self._engines[src_name],
+                      self._engines[dst_name], timeout=timeout)
+        if rep["path"] in ("resident", "host", "recompute", "requeue"):
+            with self._mu:
+                self._assigned[request] = dst_name
+            self.trace.hop(request.jid, dst_name, request.rid, "migrate")
+        return rep
 
     # ----------------------------------------------------------- supervision
 
@@ -567,6 +639,14 @@ class EngineFleet:
                     if self._health[name] == SUSPECT:
                         self._health[name] = HEALTHY
                 continue
+            # the decision inputs ride the control event: a miss is rare
+            # (never on the healthy steady state), so snapshotting the
+            # engine's signals here costs nothing the hot path pays
+            try:
+                sig = eng.signals()
+            except Exception:  # pragma: no cover - a corpse may refuse
+                sig = None
+            went_suspect = went_dead = False
             with self._mu:
                 self._fstats["probe_misses"] += 1
                 self._miss[name] += 1
@@ -576,10 +656,18 @@ class EngineFleet:
                     # fencing/failover/reap run after the lock drops
                     self._health[name] = DEAD
                     dead_now.append(name)
+                    went_dead = True
                 elif (n >= self.fleet.suspect_misses
                       and self._health[name] == HEALTHY):
                     self._health[name] = SUSPECT
                     self._fstats["suspects"] += 1
+                    went_suspect = True
+            self.trace.control("probe_miss", engine=name, val=n,
+                               signals=sig)
+            if went_suspect:
+                self.trace.control("suspect", engine=name, val=n)
+            if went_dead:
+                self.trace.control("dead", engine=name, val=n)
         for name in dead_now:
             try:
                 self._failover(name)
@@ -592,9 +680,15 @@ class EngineFleet:
 
     def _prune_assigned(self) -> None:
         with self._mu:
-            for req in [r for r, _ in self._assigned.items()
-                        if r.status is not None]:
+            done = [r for r, _ in self._assigned.items()
+                    if r.status is not None]
+            for req in done:
                 del self._assigned[req]
+        for req in done:
+            # close the journey at the terminal: delivered is the
+            # engine-agnostic count the client actually received — the
+            # denominator of the stitch's token-conservation contract
+            self.trace.end_journey(req.jid, req.delivered, req.status)
 
     # -------------------------------------------------------------- failover
 
@@ -623,6 +717,17 @@ class EngineFleet:
                 log.warning("fleet: engine %r did not fence within %.1fs; "
                             "late deliveries gated", name,
                             self.fleet.fence_timeout)
+        self.trace.control("fence", engine=name)
+        # FLIGHT RECORDER: snapshot the corpse's ring, stats, signals and
+        # ledger census into the post-mortem bundle NOW — after the fence
+        # (the state is quiescent) and before the rebuild/reap mutate the
+        # very bookkeeping a post-mortem needs to read
+        with self._mu:
+            ledger_census = dict(self._ledger.get(name, {}))
+        try:
+            self.trace.flight_record(name, eng, ledger_census)
+        except Exception:  # pragma: no cover - recorder must not block
+            log.exception("flight recorder failed for engine %r", name)
         with self._mu:
             ledger = dict(self._ledger.pop(name, {}))
             assigned = [r for r, n in self._assigned.items() if n == name]
@@ -689,6 +794,7 @@ class EngineFleet:
             if req in self._rebuilding:
                 return True
             self._rebuilding.add(req)
+        t0 = time.perf_counter()
         try:
             for dst_name in self._route_order(exclude={exclude}):
                 dst = self._engines[dst_name]
@@ -708,9 +814,18 @@ class EngineFleet:
                     with self._mu:
                         self._assigned[req] = dst_name
                         self._fstats["failover_sessions"] += 1
+                    # journey hop under the session's FRESH destination
+                    # rid (migrate_in reassigned it); rebuild latency =
+                    # claim -> resumed on the survivor
+                    self.trace.note_rebuild(time.perf_counter() - t0)
+                    self.trace.hop(req.jid, dst_name, req.rid, "failover")
+                    self.trace.control("failover_rebuild", engine=dst_name,
+                                       jid=req.jid, val=1)
                 elif res["path"] == "faulted":
                     with self._mu:
                         self._fstats["failover_faulted"] += 1
+                    self.trace.control("failover_rebuild", engine=dst_name,
+                                       jid=req.jid, val=0)
                 return True
             return False
         finally:
@@ -842,6 +957,9 @@ class EngineFleet:
             with self._mu:
                 self._fstats["rebalance_migrations"] += 1
                 self._assigned[victim] = lo_name
+            self.trace.hop(victim.jid, lo_name, victim.rid, "rebalance")
+            self.trace.control("rebalance", engine=lo_name, jid=victim.jid,
+                               score=hi_f - lo_f)
 
     # ----------------------------------------------------------------- stats
 
@@ -860,6 +978,10 @@ class EngineFleet:
             out["ledger_sessions"] = sum(
                 len(v) for v in self._ledger.values())
         out["fleet_engines"] = len(self._engines)
+        # the observability plane's flat keys (journey accounting, control
+        # ring health, bundle census, stitched-SLO percentiles) — all
+        # exporter-mapped, like every other fleet counter
+        out.update(self.trace.stats())
         states = out["engine_states"]
         out["healthy_engines"] = sum(
             1 for v in states.values() if v == HEALTHY)
