@@ -47,6 +47,11 @@ def main() -> None:
     ap = argparse.ArgumentParser("fleet-bench")
     ap.add_argument("--quick", action="store_true",
                     help="CI mode: smaller traffic, same gates")
+    ap.add_argument("--remote", action="store_true",
+                    help="cross-host arm (ISSUE 18): the fleet's members "
+                         "live in three spawned engine-host processes "
+                         "behind TCP; SIGKILL one and gate the same "
+                         "failover claims across the fabric")
     ap.add_argument("--sessions", type=int, default=None,
                     help="sessions on the doomed engine (default 3: two "
                          "live at slots=2 plus one waiting; quick 3)")
@@ -117,7 +122,8 @@ def main() -> None:
               suspect_misses=2, dead_misses=4)
 
     artifact: dict = {
-        "metric": "fleet_deterministic_gates",
+        "metric": ("crosshost_deterministic_gates" if a.remote
+                   else "fleet_deterministic_gates"),
         "quick": bool(a.quick),
         "sessions": sessions,
         "max_new": a.max_new,
@@ -142,6 +148,176 @@ def main() -> None:
     # budget can fully drain first, leaving the death nothing to catch.
     # 24 tokens cannot (prompt 8 + 24 < max_seq 64).
     kill_new = max(a.max_new, 24)
+
+    def pct(vals, q):
+        return (vals[min(len(vals) - 1, int(len(vals) * q))]
+                if vals else None)
+
+    def finish(out_default: str) -> None:
+        """The shared artifact tail: blackout percentiles off the
+        client-side samples, artifact JSON + one-line summary, exit."""
+        nonlocal all_pass
+        blackouts_ms.sort()
+        p50, p99 = pct(blackouts_ms, 0.5), pct(blackouts_ms, 0.99)
+        blackout_ok = p99 is not None and p99 <= a.blackout_ms
+        all_pass &= blackout_ok
+        artifact["blackout_ms"] = {
+            "samples": len(blackouts_ms),
+            "p50": round(p50, 3) if p50 is not None else None,
+            "p99": round(p99, 3) if p99 is not None else None,
+            "bound": a.blackout_ms,
+            "pass": blackout_ok,
+        }
+        log(f"blackout: p50={p50} p99={p99} bound={a.blackout_ms} "
+            f"pass={blackout_ok}")
+        artifact["pass"] = bool(all_pass)
+        out_path = a.out or (None if a.quick else out_default)
+        if out_path:
+            Path(out_path).write_text(json.dumps(artifact, indent=2) + "\n")
+            log(f"artifact -> {out_path}")
+        print(json.dumps(artifact))
+
+        from vtpu.obs.summary import print_summary
+
+        print_summary(
+            artifact["metric"],
+            round(p99, 3) if p99 is not None else -1,
+            "pass" if all_pass else "FAIL",
+            unit="failover_blackout_p99_ms",
+            scenarios={sc["name"]: sc["pass"]
+                       for sc in artifact["scenarios"]},
+        )
+        sys.exit(0 if all_pass else 1)
+
+    # ---------------------------------------------- cross-host (--remote)
+    # ISSUE 18: the same kill-and-failover claim with the fleet's members
+    # behind REAL process + TCP boundaries — three spawned engine-host
+    # children (one engine each, identical params by shared seed),
+    # everything pinned on r0@h0, SIGKILL that child mid-stream. The
+    # in-proc gates apply unchanged, plus the fabric's own: journeys
+    # conserved with HOST-tagged hops, survivors leak-clean read over
+    # the wire, the rebuilds landing on REMOTE destinations.
+    if a.remote:
+        import os
+        import signal
+
+        from vtpu.serving.fabric import (
+            connect_host, spawn_host, tcp_connect)
+
+        log("=== scenario: crosshost kill_failover (SIGKILL a host) ===")
+        buckets = (16, 64)
+        params = init_params(jax.random.key(0), cfg)
+        prompts = [prompt(300 + j, cfg.vocab) for j in range(sessions)]
+        ref = ServingEngine(params, cfg, base_serving(
+            slots=sessions, prefill_buckets=buckets))
+        ref.start()
+        try:
+            want = [list(ref.submit(p, max_new_tokens=kill_new).stream())
+                    for p in prompts]
+        finally:
+            ref.stop()
+        sv = dict(slots=2, prefill_buckets=list(buckets),
+                  max_new_tokens=kill_new, prefill_chunk=16,
+                  kv_page=a.page, kv_swap=16)
+        # throttle the doomed engine's decode (~10ms/token): the tiny
+        # model would otherwise finish the whole stream into the socket
+        # buffer before the SIGKILL lands — the kill must be MID-stream
+        # for the failover to have work to do
+        doomed = dict(sv, faults=[dict(seam="delayed_fetch", at=0,
+                                       count=100000, arg=0.01)])
+        specs = {"r0": doomed, "r1": dict(sv), "r2": dict(sv)}
+        mk_json = {**mk, "dtype": "float32"}
+        procs, clients, members = {}, {}, {}
+        fleet = None
+        try:
+            spawned = {n: spawn_host({"model": mk_json, "seed": 0,
+                                      "engines": {n: s}})
+                       for n, s in specs.items()}
+            for i, (n, (proc, port)) in enumerate(spawned.items()):
+                procs[n] = proc
+                chan = tcp_connect("127.0.0.1", port)
+                client, engines = connect_host(chan, host=f"h{i}",
+                                               proc=proc)
+                clients[n] = client
+                members[n] = engines[n]
+            fleet = EngineFleet(dict(members), FleetConfig(
+                **FC, route_policy=PinPolicy("r0")))
+            fleet.start()
+            deadline = time.perf_counter() + 300
+            while any(m._beat_ns == 0 for m in members.values()):
+                if time.perf_counter() > deadline:
+                    raise SystemExit("child engines never warmed up")
+                time.sleep(0.05)
+            reqs = [fleet.submit(p, max_new_tokens=kill_new)
+                    for p in prompts]
+            its = [r.stream() for r in reqs]
+            heads = [[next(its[j]), next(its[j])] for j in range(2)]
+            heads += [[] for _ in range(sessions - 2)]
+            t_kill = time.perf_counter()
+            os.kill(procs["r0"].pid, signal.SIGKILL)
+            post = [next(its[j]) for j in range(sessions)]
+            blackouts_ms.append((time.perf_counter() - t_kill) * 1e3)
+            streams = [heads[j] + [post[j]] + list(its[j])
+                       for j in range(sessions)]
+            # journeys close on the monitor's prune pass and survivor
+            # slots retire over the wire — wait for both to settle
+            deadline = time.perf_counter() + 30
+            while time.perf_counter() < deadline:
+                fs = fleet.stats(include_engines=False)
+                if (fs["journeys_ended"] >= sessions
+                        and all(pools_clean(members[n])
+                                for n in ("r1", "r2"))):
+                    break
+                time.sleep(0.05)
+            fs = fleet.stats(include_engines=False)
+            journeys = fleet.trace.journeys()
+            clean = all(pools_clean(members[n]) for n in ("r1", "r2"))
+        finally:
+            if fleet is not None:
+                fleet.stop()
+            for client in clients.values():
+                client.close()
+            for proc in procs.values():
+                if proc.poll() is None:
+                    proc.kill()
+                proc.wait()
+        gates = {
+            "token_equal": streams == want,
+            "all_ok": all(r.status == Status.OK for r in reqs),
+            "failover_sessions": fs["failover_sessions"] == sessions
+                                  and fs["failovers"] == 1
+                                  and fs["failover_faulted"] == 0,
+            "dead_declared": fs["engine_states"]["r0"] == "DEAD",
+            "zero_leaks_survivors": clean,
+            # every session ONE journey, route@h0 -> failover on a
+            # SURVIVOR host, per-hop tokens conserving the delivery
+            "journeys_host_tagged": all(
+                journeys.get(r.jid, {}).get("n_hops") == 2
+                and [h["kind"] for h in journeys[r.jid]["hops"]]
+                == ["route", "failover"]
+                and journeys[r.jid]["conserved"] is True
+                and journeys[r.jid]["hops"][0]["host"] == "h0"
+                and journeys[r.jid]["hops"][1]["host"] in ("h1", "h2")
+                for r in reqs),
+            "fabric_counters": fs["remote_engines"] == 3
+                                and fs["fabric_msgs_sent"] > 0
+                                and fs["fabric_msgs_recv"] > 0,
+        }
+        ok = all(gates.values())
+        all_pass &= ok
+        artifact["scenarios"].append({
+            "name": "crosshost_kill_failover", "pass": ok, "gates": gates,
+            "failover_sessions": fs["failover_sessions"],
+            "stitched_blackout_p99_ms": fs["failover_blackout_p99_ms"],
+            "fabric": {k: fs[k] for k in (
+                "fabric_msgs_sent", "fabric_msgs_recv",
+                "fabric_bytes_sent", "fabric_bytes_recv",
+                "fabric_payload_bytes", "fabric_retries",
+                "fabric_timeouts", "fabric_resends",
+                "fabric_checksum_faults", "fabric_reconnects")},
+        })
+        log(f"crosshost_kill_failover: pass={ok} gates={gates}")
+        finish("CROSSHOST_r18.json")
 
     def run_kill(name, layout_cfg):
         nonlocal all_pass
@@ -326,44 +502,8 @@ def main() -> None:
     })
     log(f"suspect: pass={sus_pass} gates={gates}")
 
-    # ---------------------------------------------------------- blackout
-    blackouts_ms.sort()
-
-    def pct(vals, q):
-        return (vals[min(len(vals) - 1, int(len(vals) * q))]
-                if vals else None)
-
-    p50, p99 = pct(blackouts_ms, 0.5), pct(blackouts_ms, 0.99)
-    blackout_ok = p99 is not None and p99 <= a.blackout_ms
-    all_pass &= blackout_ok
-    artifact["blackout_ms"] = {
-        "samples": len(blackouts_ms),
-        "p50": round(p50, 3) if p50 is not None else None,
-        "p99": round(p99, 3) if p99 is not None else None,
-        "bound": a.blackout_ms,
-        "pass": blackout_ok,
-    }
-    log(f"blackout: p50={p50} p99={p99} bound={a.blackout_ms} "
-        f"pass={blackout_ok}")
-
-    # ---------------------------------------------------------- artifact
-    artifact["pass"] = bool(all_pass)
-    out_path = a.out or (None if a.quick else "FLEET_r16.json")
-    if out_path:
-        Path(out_path).write_text(json.dumps(artifact, indent=2) + "\n")
-        log(f"artifact -> {out_path}")
-    print(json.dumps(artifact))
-
-    from vtpu.obs.summary import print_summary
-
-    print_summary(
-        "fleet_deterministic_gates",
-        round(p99, 3) if p99 is not None else -1,
-        "pass" if all_pass else "FAIL",
-        unit="failover_blackout_p99_ms",
-        scenarios={sc["name"]: sc["pass"] for sc in artifact["scenarios"]},
-    )
-    sys.exit(0 if all_pass else 1)
+    # ------------------------------------------------ blackout + artifact
+    finish("FLEET_r16.json")
 
 
 if __name__ == "__main__":

@@ -136,8 +136,15 @@ def main() -> None:
                     for p in prompts]
         finally:
             ref.stop()
+        # decode-throttled source (~10ms/token): the migrate must catch
+        # every session MID-stream — an unthrottled engine on a loaded
+        # box can finish the whole --max-new stream between the two
+        # head reads and the migrate() call (all_migrated would fail)
         src = ServingEngine(params, layout_cfg,
-                            base_serving(slots=sessions), mesh=mesh)
+                            base_serving(slots=sessions, faults=FaultPlan(
+                                [FaultSpec("delayed_fetch", at=0,
+                                           count=100000, arg=0.01)])),
+                            mesh=mesh)
         dst = ServingEngine(params, layout_cfg,
                             base_serving(slots=sessions), mesh=mesh)
         src.start()
@@ -256,7 +263,13 @@ def main() -> None:
 
     # ------------------------------------------------------ crash recovery
     log("=== scenario: crash_recovery (migrate_* fault seams) ===")
-    plan_src = FaultPlan([FaultSpec("migrate_src_death", at=0)])
+    # every crash-recovery SOURCE is decode-throttled (~10ms/token):
+    # the rebuild path needs the sequence still inside the destination's
+    # prefill bucket when migrate() runs, and an unthrottled engine on a
+    # loaded 1-core box free-runs past it between the head reads and
+    # the call (scenario (c) inverts this — it must NOT complete early)
+    throttle = FaultSpec("delayed_fetch", at=0, count=100000, arg=0.01)
+    plan_src = FaultPlan([FaultSpec("migrate_src_death", at=0), throttle])
     plan_dst = FaultPlan([FaultSpec("migrate_payload_loss", at=0)])
     p1, p2, p3 = (prompt(300, cfg.vocab), prompt(301, cfg.vocab),
                   prompt(302, cfg.vocab))
@@ -285,7 +298,8 @@ def main() -> None:
         src.stop()
         dst.stop()
     # (b) payload lost in transit -> destination rebuilds
-    src = ServingEngine(params, cfg, base_serving())
+    src = ServingEngine(params, cfg, base_serving(
+        faults=FaultPlan([throttle])))
     dst = ServingEngine(params, cfg, base_serving(faults=plan_dst))
     src.start()
     dst.start()
@@ -302,7 +316,8 @@ def main() -> None:
     # destination for a grown sequence) -> the ONE configured typed
     # FAULTED terminal of the whole bench
     plan_dst2 = FaultPlan([FaultSpec("migrate_payload_loss", at=0)])
-    src = ServingEngine(params, cfg, base_serving())
+    src = ServingEngine(params, cfg, base_serving(
+        faults=FaultPlan([throttle])))
     dst = ServingEngine(params, cfg, ServingConfig(
         slots=2, prefill_buckets=(16,), max_new_tokens=a.max_new,
         kv_page=a.page, kv_swap=0, faults=plan_dst2))

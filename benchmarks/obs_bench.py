@@ -478,6 +478,14 @@ def fleet_arm(a, n_requests: int) -> None:
                     for j in range(2)]
         finally:
             ref.stop()
+        # throttle every engine's decode (~10ms/token) BEFORE the
+        # scenario submits: the engine streams whether or not the client
+        # reads, and on a fast rig the whole 24-token stream can drain
+        # in the submit→park window — the kill would then land on an
+        # idle engine (1-hop journey, no bundle). The A/B waves above
+        # are fully drained, so the perf estimate never sees the seam.
+        for p in plans.values():
+            p.arm("delayed_fetch", count=100000, arg=0.01)
         reqs = [fleet_on.submit(prompt(900 + j), max_new_tokens=kill_new)
                 for j in range(2)]
         its = [r.stream() for r in reqs]

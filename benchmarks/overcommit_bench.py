@@ -150,7 +150,12 @@ def main() -> None:
                 parked += len(wave)
                 t0 = time.perf_counter()
                 while eng.stats()["parked_sessions"] < parked:
-                    assert time.perf_counter() - t0 < 60, "park stalled"
+                    # deadlock guard, not a latency gate: the first park
+                    # compiles the swap executables, and under the smoke
+                    # tier this bench shares a wave with the UNCACHED
+                    # tp2 compile — 60s has been seen exceeded by
+                    # scheduler starvation alone on a loaded 2-core box
+                    assert time.perf_counter() - t0 < 180, "park stalled"
                     time.sleep(0.002)
             # production stopped: collect whatever was delivered pre-park
             for s in sessions:

@@ -234,6 +234,12 @@ def test_kill_one_of_three_failover_token_equal(params, refs):
     corpse's pools audit clean (the reap; leak_check re-checks at
     teardown)."""
     plan = FaultPlan()
+    # throttle the doomed engine's decode (~10ms/token): recompute
+    # needs the history to still FIT a prefill bucket (prompt 5 +
+    # bucket 8 leaves ~3 tokens of headroom), and an unthrottled engine
+    # free-runs past it between the head reads and the arm() on a
+    # loaded box — the death must land while the rebuild is possible
+    plan.arm("delayed_fetch", count=100000, arg=0.01)
     fleet, engines = _fleet(params, faults_for={"a": plan},
                             fc={"route_policy": PinPolicy("a")})
     fleet.start()
@@ -274,6 +280,11 @@ def test_ledger_staleness_die_between_flushes(params, refs):
     delivered) token and regenerates the rest deterministically: no
     duplicates, no gaps, whole stream token-equal."""
     plan = FaultPlan()
+    # throttle the doomed engine (~30ms/token) so the client's reads
+    # stay caught up with production: prompt 5 + 3 delivered tokens is
+    # EXACTLY the (8,) prefill bucket — one extra free-run token and
+    # the rebuild is impossible (see _can_recompute)
+    plan.arm("delayed_fetch", count=100000, arg=0.03)
     fleet, engines = _fleet(params, names=("a", "b"),
                             faults_for={"a": plan},
                             fc={"route_policy": PinPolicy("a")})
@@ -300,6 +311,10 @@ def test_cancel_racing_failover(params):
     abandon (CANCELLED) instead of rebuilding a stream nobody wants, and
     the sibling stream still fails over token-equal."""
     plan = FaultPlan()
+    # throttled like the kill test: the death must land while both
+    # streams are still mid-flight and rebuildable (prompt + delivered
+    # within the (8,) prefill bucket)
+    plan.arm("delayed_fetch", count=100000, arg=0.01)
     fleet, engines = _fleet(params, names=("a", "b"),
                             faults_for={"a": plan},
                             fc={"route_policy": PinPolicy("a")})
@@ -477,6 +492,9 @@ def test_journey_failover_stitched_with_bundle(params, refs):
     import json
 
     plan = FaultPlan()
+    # throttled like the kill test: a 2-hop journey needs the death to
+    # land mid-stream with the rebuild still inside the prefill bucket
+    plan.arm("delayed_fetch", count=100000, arg=0.01)
     fleet, engines = _fleet(params, names=("a", "b"),
                             faults_for={"a": plan},
                             fc={"route_policy": PinPolicy("a")})

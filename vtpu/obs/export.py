@@ -290,6 +290,33 @@ FLEET_COUNTERS = {
     "fleet_trace_events_dropped": ("fleet_trace_events_dropped",
                                    "Fleet control events the bounded ring "
                                    "overwrote"),
+    # fabric transport counters (vtpu/serving/fabric): summed over the
+    # fleet's HostClient channels, all-zero for an all-local fleet
+    "fabric_msgs_sent": ("fleet_fabric_msgs_sent",
+                         "Fabric messages sent to engine hosts"),
+    "fabric_msgs_recv": ("fleet_fabric_msgs_recv",
+                         "Fabric messages received from engine hosts"),
+    "fabric_bytes_sent": ("fleet_fabric_bytes_sent",
+                          "Fabric bytes sent (framing included)"),
+    "fabric_bytes_recv": ("fleet_fabric_bytes_recv",
+                          "Fabric bytes received (framing included)"),
+    "fabric_payload_bytes": ("fleet_fabric_payload_bytes",
+                             "Migration payload bytes moved across the "
+                             "fabric (the honest cross-host copy count — "
+                             "in-proc moves stay zero-copy)"),
+    "fabric_retries": ("fleet_fabric_retries",
+                       "Fabric ask retries (idempotent ops only)"),
+    "fabric_timeouts": ("fleet_fabric_timeouts",
+                        "Fabric asks that timed out (typed failures, "
+                        "never hangs)"),
+    "fabric_resends": ("fleet_fabric_resends",
+                       "Token-stream resend requests after a detected "
+                       "sequence gap"),
+    "fabric_checksum_faults": ("fleet_fabric_checksum_faults",
+                               "Payload chunks that failed their CRC32 "
+                               "(converted to recompute-on-fault)"),
+    "fabric_reconnects": ("fleet_fabric_reconnects",
+                          "Fabric links re-established after a drop"),
 }
 # key -> (family suffix, help, scale) — same convention as engine GAUGES
 FLEET_GAUGES = {
@@ -330,6 +357,18 @@ FLEET_GAUGES = {
                        "on the survivor)", 1e-3),
     "rebuild_p99_ms": ("fleet_rebuild_p99_seconds",
                        "Failover rebuild latency p99", 1e-3),
+    "remote_engines": ("fleet_remote_engines",
+                       "Fleet members served across the fabric "
+                       "(RemoteEngine proxies)", 1),
+    "fabric_links_down": ("fleet_fabric_links_down",
+                          "HostClient links currently down (broken or "
+                          "closed channels)", 1),
+    "fabric_rtt_ms": ("fleet_fabric_rtt_seconds",
+                      "Mean fabric heartbeat round-trip EMA over "
+                      "connected hosts", 1e-3),
+    "fabric_gbps": ("fleet_fabric_gbps",
+                    "Mean measured fabric payload bandwidth (Gbit/s) "
+                    "over connected hosts", 1),
 }
 # handled specially (engine_states -> the per-engine health gauge below;
 # engines -> each engine's snapshot joins the ordinary vtpu_serving_*

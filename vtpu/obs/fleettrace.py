@@ -217,15 +217,18 @@ class FleetTrace:
 
     # -------------------------------------------------------------- journeys
 
-    def begin_journey(self, engine: str, rid: int) -> int:
+    def begin_journey(self, engine: str, rid: int,
+                      host: str = "local") -> int:
         """Open a journey at its first placement; returns the jid the
-        fleet stamps on the Request (stable across every later hop)."""
+        fleet stamps on the Request (stable across every later hop).
+        ``host`` is the placement's EngineHost label ('local' for an
+        in-proc member) — cross-host hops stitch into ONE journey."""
         if not self.enabled:
             return -1
         jid = next(self._jid_ctr)
         j = {"jid": jid,
              "hops": [{"engine": engine, "rid": rid, "kind": "route",
-                       "t_ns": time.monotonic_ns()}],
+                       "host": host, "t_ns": time.monotonic_ns()}],
              "ended": False, "delivered": None, "terminal": None}
         with self._mu:
             self._journeys[jid] = j
@@ -233,9 +236,11 @@ class FleetTrace:
                 self._journeys.popitem(last=False)
         return jid
 
-    def hop(self, jid: int, engine: str, rid: int, kind: str) -> None:
+    def hop(self, jid: int, engine: str, rid: int, kind: str,
+            host: str = "local") -> None:
         """Append one placement hop (the rid is the session's FRESH
-        identity on the destination engine — migrate_in reassigns it)."""
+        identity on the destination engine — migrate_in reassigns it;
+        ``host`` tags which EngineHost the destination lives on)."""
         if not self.enabled or jid < 0:
             return
         with self._mu:
@@ -243,6 +248,7 @@ class FleetTrace:
             if j is None or j["ended"]:
                 return
             j["hops"].append({"engine": engine, "rid": rid, "kind": kind,
+                              "host": host,
                               "t_ns": time.monotonic_ns()})
 
     def end_journey(self, jid: int, delivered: int,
@@ -355,6 +361,7 @@ class FleetTrace:
                 truncated = True
             hop = {"engine": h["engine"], "rid": h["rid"],
                    "kind": h["kind"], "t_ns": h["t_ns"],
+                   "host": h.get("host", "local"),
                    "tokens": span["tokens"] if span else 0,
                    "first_tok_ns": span["first_tok_ns"] if span else None,
                    "last_tok_ns": span["last_tok_ns"] if span else None,

@@ -13,6 +13,16 @@ from vtpu.serving.engine import (
     prefill_into_slot,
     prefill_into_slots,
 )
+from vtpu.serving.fabric import (
+    EngineHost,
+    HostClient,
+    RemoteEngine,
+    TransportError,
+    connect_host,
+    loopback_pair,
+    spawn_host,
+    tcp_connect,
+)
 from vtpu.serving.faults import EngineDeath, FaultPlan, FaultSpec
 from vtpu.serving.fleet import (
     EngineFleet,
@@ -33,13 +43,16 @@ __all__ = [
     "DisaggConfig",
     "EngineDeath",
     "EngineFleet",
+    "EngineHost",
     "EngineSignals",
     "FaultPlan",
     "FaultSpec",
     "FleetConfig",
+    "HostClient",
     "LeastPressureRoutePolicy",
     "MigrationError",
     "PriorityDeadlineShedPolicy",
+    "RemoteEngine",
     "Request",
     "RoutePolicy",
     "ServingConfig",
@@ -47,11 +60,16 @@ __all__ = [
     "ShedPolicy",
     "Status",
     "Terminal",
+    "TransportError",
     "WaitQueue",
     "batched_decode_step",
+    "connect_host",
     "drain_engine",
     "load_route_policy",
+    "loopback_pair",
     "migrate",
     "prefill_into_slot",
     "prefill_into_slots",
+    "spawn_host",
+    "tcp_connect",
 ]

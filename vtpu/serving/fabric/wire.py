@@ -1,0 +1,197 @@
+"""The fabric wire format: length-prefixed frames, msgpack-free.
+
+Everything the fleet exchanges is already host-plain by design — the
+failover ledger is metadata dicts, the migration payload is ordered host
+bytes, signals are a small frozen dataclass — so the wire format is
+deliberately stdlib-only: a 5-byte header (``>IB``: body length + frame
+type), JSON bodies for control messages, raw binary frames for payload
+chunks. The dcnprobe framing precedent (magic + struct header, chunked
+bursts) carries over; what the probe measures, this module ships.
+
+Versioning is explicit and fail-typed: every connection opens with a
+``hello`` frame carrying ``PROTO_VERSION``; a peer that cannot speak it
+answers a ``refuse`` frame (reason + its own version) and closes — a
+mismatch surfaces as a typed :class:`ProtocolError` on the dialing side,
+never as a hang on a half-understood stream.
+
+Payloads (the migrate D2H snapshot: one host buffer per KV plane) ship as
+a JSON descriptor — per-plane key/dtype/shape, per-chunk CRC32s — followed
+by that many binary chunk frames. The receiver verifies every chunk
+checksum before reassembly; a mismatch raises :class:`ChecksumError`,
+which the transport layer converts to ``payload=None`` so the migrate
+install falls back to recompute-on-fault — corrupted bytes can delay a
+stream, never fork it.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import zlib
+from typing import Optional, Tuple
+
+import numpy as np
+
+#: Explicit protocol version, carried in every hello frame. Bump on any
+#: incompatible wire change; the peer refuses (typed), never guesses.
+PROTO_VERSION = 1
+
+# frame header: 4-byte big-endian body length + 1-byte frame type
+_HDR = struct.Struct(">IB")
+FRAME_JSON = 1   # utf-8 JSON control message
+FRAME_BIN = 2    # raw payload chunk (descriptor rode the preceding JSON)
+
+#: payload chunk size: large enough to amortize framing, small enough
+#: that a single corrupted chunk localizes the checksum fault
+CHUNK_BYTES = 1 << 20
+
+#: sanity bound on a single frame body (a corrupted length prefix must
+#: fail typed, not attempt a multi-GB allocation)
+MAX_FRAME = 1 << 30
+
+
+class TransportError(RuntimeError):
+    """A fabric link failed: connect refused, peer gone mid-frame, send
+    or receive timed out past the retry budget. Typed so callers
+    (RemoteEngine asks, the fleet's probe ladder) can distinguish a dead
+    LINK from a dead ENGINE — the distinction the SUSPECT ladder's
+    reconnect-restores-HEALTHY behavior stands on."""
+
+
+class ProtocolError(TransportError):
+    """The peer speaks a different protocol (version mismatch, malformed
+    frame, refused hello). Never retried — reconnecting cannot fix it."""
+
+
+class ChecksumError(TransportError):
+    """A payload chunk failed its CRC32. The transport converts this to
+    ``payload=None`` + a counted fault so the migrate install recomputes
+    from token history instead of installing corrupted pages."""
+
+
+# ------------------------------------------------------------------ frames
+
+
+def encode_msg(msg: dict) -> bytes:
+    return json.dumps(msg, separators=(",", ":")).encode("utf-8")
+
+
+def decode_msg(data: bytes) -> dict:
+    try:
+        out = json.loads(data.decode("utf-8"))
+    except (ValueError, UnicodeDecodeError) as exc:
+        raise ProtocolError(f"malformed JSON frame: {exc}") from None
+    if not isinstance(out, dict):
+        raise ProtocolError(f"JSON frame is not an object: {type(out)}")
+    return out
+
+
+def send_frame(sock, ftype: int, data: bytes) -> int:
+    """One frame onto a connected socket. Returns bytes written (header
+    included). Raises TransportError on a broken pipe."""
+    try:
+        sock.sendall(_HDR.pack(len(data), ftype))
+        if data:
+            sock.sendall(data)
+    except OSError as exc:
+        raise TransportError(f"send failed: {exc}") from None
+    return _HDR.size + len(data)
+
+
+def recv_exact(sock, n: int) -> bytes:
+    """Read exactly *n* bytes. Raises TransportError on EOF (peer gone),
+    lets socket.timeout propagate (the caller's poll loop owns it)."""
+    buf = bytearray()
+    while len(buf) < n:
+        try:
+            part = sock.recv(n - len(buf))
+        except OSError as exc:
+            if isinstance(exc, TimeoutError):
+                raise
+            raise TransportError(f"recv failed: {exc}") from None
+        if not part:
+            raise TransportError("peer closed the connection")
+        buf.extend(part)
+    return bytes(buf)
+
+
+def recv_frame(sock) -> Tuple[int, bytes]:
+    hdr = recv_exact(sock, _HDR.size)
+    length, ftype = _HDR.unpack(hdr)
+    if length > MAX_FRAME:
+        raise ProtocolError(f"frame length {length} exceeds MAX_FRAME")
+    return ftype, (recv_exact(sock, length) if length else b"")
+
+
+# ----------------------------------------------------------------- payload
+
+
+def encode_payload(payload: Optional[dict]) -> Tuple[Optional[dict], list]:
+    """Serialize a migrate payload ({plane key: np host buffer}) to a
+    JSON-safe descriptor + binary chunks. Plane bytes concatenate in
+    sorted-key order; chunks carry individual CRC32s so corruption
+    localizes. Returns (None, []) for a payload-less transfer."""
+    if payload is None:
+        return None, []
+    planes = []
+    blobs = []
+    for key in sorted(payload):
+        arr = np.ascontiguousarray(payload[key])
+        planes.append({"key": key, "dtype": arr.dtype.str,
+                       "shape": list(arr.shape)})
+        blobs.append(arr.tobytes())
+    body = b"".join(blobs)
+    chunks = [body[i:i + CHUNK_BYTES]
+              for i in range(0, len(body), CHUNK_BYTES)] or [b""]
+    desc = {"planes": planes, "nbytes": len(body),
+            "crcs": [zlib.crc32(c) & 0xFFFFFFFF for c in chunks]}
+    return desc, chunks
+
+
+def decode_payload(desc: Optional[dict], chunks: list) -> Optional[dict]:
+    """Reassemble and verify a payload. Raises ChecksumError when any
+    chunk fails its CRC (the caller converts to the recompute path)."""
+    if desc is None:
+        return None
+    crcs = desc["crcs"]
+    if len(chunks) != len(crcs):
+        raise ChecksumError(
+            f"payload arrived with {len(chunks)} chunks, expected "
+            f"{len(crcs)}")
+    for i, (chunk, crc) in enumerate(zip(chunks, crcs)):
+        if (zlib.crc32(chunk) & 0xFFFFFFFF) != crc:
+            raise ChecksumError(f"payload chunk {i} failed its CRC32")
+    body = b"".join(chunks)
+    if len(body) != desc["nbytes"]:
+        raise ChecksumError(
+            f"payload reassembled to {len(body)} bytes, expected "
+            f"{desc['nbytes']}")
+    out = {}
+    pos = 0
+    for p in desc["planes"]:
+        dt = np.dtype(p["dtype"])
+        shape = tuple(p["shape"])
+        n = dt.itemsize * int(np.prod(shape, dtype=np.int64)) \
+            if shape else dt.itemsize
+        out[p["key"]] = np.frombuffer(
+            body[pos:pos + n], dtype=dt).reshape(shape).copy()
+        pos += n
+    return out
+
+
+def json_safe(obj):
+    """Best-effort conversion of a stats()/signals dict to JSON-safe
+    types (numpy scalars -> python, tuples -> lists, unknown -> repr)."""
+    if isinstance(obj, dict):
+        return {str(k): json_safe(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [json_safe(v) for v in obj]
+    if isinstance(obj, (np.integer,)):
+        return int(obj)
+    if isinstance(obj, (np.floating,)):
+        return float(obj)
+    if isinstance(obj, (np.bool_,)):
+        return bool(obj)
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    return repr(obj)

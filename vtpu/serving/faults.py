@@ -91,6 +91,14 @@ SEAMS = (
     "migrate_payload_loss",
     "engine_death",
     "probe_loss",
+    # the fabric transport's seams (vtpu/serving/fabric/transport.py):
+    # consulted by the loopback channel on every send — drop the message,
+    # defer its delivery, or flip a payload byte after the CRCs were
+    # computed (the receiver's checksum verify must convert it to the
+    # recompute path, never to wrong tokens)
+    "fabric_msg_loss",
+    "fabric_delay",
+    "fabric_payload_corrupt",
 )
 
 

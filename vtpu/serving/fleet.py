@@ -397,7 +397,12 @@ class EngineFleet:
 
     def _make_hook(self, name: str):
         def hook(eng, _name=name):
-            entries = _ledger_entries(eng)
+            # a fabric proxy derives its ledger from the client-side
+            # mirror (tokens actually delivered across the wire) — the
+            # host's own flush-boundary ledger is unreadable once the
+            # host is SIGKILLed, which is exactly when this matters
+            fn = getattr(eng, "ledger_entries", None)
+            entries = fn() if fn is not None else _ledger_entries(eng)
             with self._mu:
                 self._ledger[_name] = entries
         return hook
@@ -445,6 +450,11 @@ class EngineFleet:
     def _route_order(self, exclude: Iterable[str] = ()) -> List[str]:
         return [name for name, _ in self._route_ranked(exclude)]
 
+    def _host_of(self, name: str) -> str:
+        """The placement host a journey hop records: a fabric proxy
+        carries its EngineHost's label, an in-proc member is 'local'."""
+        return getattr(self._engines[name], "host", "local")
+
     def submit(self, tokens, max_new_tokens: int = 0, priority: int = 0,
                deadline_ms: Optional[float] = None) -> Request:
         """The fleet's front door: route to the best-scored engine and
@@ -475,7 +485,8 @@ class EngineFleet:
             # stamped, or a fast-finishing request would leak an
             # unclosable journey. The winning score sits in the route
             # event so the policy verdict is auditable.
-            req.jid = self.trace.begin_journey(name, req.rid)
+            req.jid = self.trace.begin_journey(name, req.rid,
+                                               host=self._host_of(name))
             self.trace.control("route", engine=name, jid=req.jid,
                                score=score)
             with self._mu:
@@ -524,7 +535,8 @@ class EngineFleet:
             if rep["path"] in ("resident", "host", "recompute", "requeue"):
                 with self._mu:
                     self._assigned[req] = dst_name
-                self.trace.hop(req.jid, dst_name, req.rid, "rescue")
+                self.trace.hop(req.jid, dst_name, req.rid, "rescue",
+                               host=self._host_of(dst_name))
                 self.trace.control("reroute", engine=dst_name, jid=req.jid)
             return
 
@@ -565,7 +577,8 @@ class EngineFleet:
         def placed(req, target):
             with self._mu:
                 self._assigned[req] = names[target]
-            self.trace.hop(req.jid, names[target], req.rid, "drain")
+            self.trace.hop(req.jid, names[target], req.rid, "drain",
+                           host=self._host_of(names[target]))
 
         self.trace.control("drain_start", engine=name)
         try:
@@ -599,7 +612,8 @@ class EngineFleet:
         if rep["path"] in ("resident", "host", "recompute", "requeue"):
             with self._mu:
                 self._assigned[request] = dst_name
-            self.trace.hop(request.jid, dst_name, request.rid, "migrate")
+            self.trace.hop(request.jid, dst_name, request.rid, "migrate",
+                           host=self._host_of(dst_name))
         return rep
 
     # ----------------------------------------------------------- supervision
@@ -818,7 +832,8 @@ class EngineFleet:
                     # rid (migrate_in reassigned it); rebuild latency =
                     # claim -> resumed on the survivor
                     self.trace.note_rebuild(time.perf_counter() - t0)
-                    self.trace.hop(req.jid, dst_name, req.rid, "failover")
+                    self.trace.hop(req.jid, dst_name, req.rid, "failover",
+                                   host=self._host_of(dst_name))
                     self.trace.control("failover_rebuild", engine=dst_name,
                                        jid=req.jid, val=1)
                 elif res["path"] == "faulted":
@@ -859,6 +874,13 @@ class EngineFleet:
                     return
             req.finish(req._abort or Status.FAULTED)
 
+        reaper = getattr(eng, "fleet_reap", None)
+        if reaper is not None:
+            # a fabric proxy owns only its client-side mirrors; the
+            # host's own resources died with the host (or its shutdown
+            # sweep reclaims them on a mere link death)
+            reaper(finish_unspared)
+            return
         for slot in range(eng.serving.slots):
             finish_unspared(eng._slot_req[slot])
             eng._free_slot_blocks(slot)
@@ -933,17 +955,27 @@ class EngineFleet:
         if hi_f - lo_f < thr:
             return
         hi, lo = self._engines[hi_name], self._engines[lo_name]
-        victim = next(
-            (r for r in list(hi._slot_req)
-             if r is not None and r.status is None and not r.cancelled),
-            None)
-        if victim is None:
-            for req in _snaplist(hi._parked):
-                e = hi._parked.get(req)
-                if (e is not None and req.status is None
-                        and not req.cancelled and not e.get("unstarted")):
-                    victim = req
-                    break
+        live = getattr(hi, "live_sessions", None)
+        if live is not None:
+            # a fabric proxy: pick from its mirror (streaming sessions
+            # first — a parked mirror entry carries "unstarted")
+            victim = next(
+                (r for r in live()
+                 if r.status is None and not r.cancelled
+                 and not hi._parked.get(r, {}).get("unstarted")),
+                None)
+        else:
+            victim = next(
+                (r for r in list(hi._slot_req)
+                 if r is not None and r.status is None and not r.cancelled),
+                None)
+            if victim is None:
+                for req in _snaplist(hi._parked):
+                    e = hi._parked.get(req)
+                    if (e is not None and req.status is None
+                            and not req.cancelled and not e.get("unstarted")):
+                        victim = req
+                        break
         if victim is None:
             return
         try:
@@ -957,7 +989,8 @@ class EngineFleet:
             with self._mu:
                 self._fstats["rebalance_migrations"] += 1
                 self._assigned[victim] = lo_name
-            self.trace.hop(victim.jid, lo_name, victim.rid, "rebalance")
+            self.trace.hop(victim.jid, lo_name, victim.rid, "rebalance",
+                           host=self._host_of(lo_name))
             self.trace.control("rebalance", engine=lo_name, jid=victim.jid,
                                score=hi_f - lo_f)
 
@@ -990,7 +1023,54 @@ class EngineFleet:
         out["dead_engines"] = sum(1 for v in states.values() if v == DEAD)
         out["draining_engines"] = sum(
             1 for e in self._engines.values() if e._draining)
+        out.update(self._fabric_stats())
         out["engines"] = ({name: eng.stats()
                            for name, eng in self._engines.items()}
                           if include_engines else {})
+        return out
+
+    def _fabric_stats(self) -> dict:
+        """The fabric's flat keys, ALWAYS emitted (zero for an all-local
+        fleet, so dashboards and the exporter see a stable schema).
+        Channel counters are per HostClient — two proxies sharing one
+        host share one channel — so aggregation dedups by client."""
+        out = {
+            "remote_engines": 0,
+            "fabric_msgs_sent": 0, "fabric_msgs_recv": 0,
+            "fabric_bytes_sent": 0, "fabric_bytes_recv": 0,
+            "fabric_payload_bytes": 0,
+            "fabric_retries": 0, "fabric_timeouts": 0,
+            "fabric_resends": 0, "fabric_checksum_faults": 0,
+            "fabric_reconnects": 0, "fabric_links_down": 0,
+            "fabric_rtt_ms": 0.0, "fabric_gbps": 0.0,
+        }
+        clients = {}
+        for eng in self._engines.values():
+            if getattr(eng, "is_remote", False):
+                out["remote_engines"] += 1
+                clients[id(eng._client)] = eng._client
+        rtts, gbps = [], []
+        for client in clients.values():
+            c = client.fabric_stats()
+            out["fabric_msgs_sent"] += c["msgs_sent"]
+            out["fabric_msgs_recv"] += c["msgs_recv"]
+            out["fabric_bytes_sent"] += c["bytes_sent"]
+            out["fabric_bytes_recv"] += c["bytes_recv"]
+            out["fabric_payload_bytes"] += (c["payload_bytes_sent"]
+                                            + c["payload_bytes_recv"])
+            out["fabric_retries"] += c["retries"]
+            out["fabric_timeouts"] += c["timeouts"]
+            out["fabric_resends"] += c["resends"]
+            out["fabric_checksum_faults"] += c["checksum_faults"]
+            out["fabric_reconnects"] += c["reconnects"]
+            if not c["link_ok"]:
+                out["fabric_links_down"] += 1
+            if c["rtt_ms"] is not None:
+                rtts.append(c["rtt_ms"])
+            if c["gbps"] is not None:
+                gbps.append(c["gbps"])
+        if rtts:
+            out["fabric_rtt_ms"] = sum(rtts) / len(rtts)
+        if gbps:
+            out["fabric_gbps"] = sum(gbps) / len(gbps)
         return out

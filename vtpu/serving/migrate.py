@@ -126,6 +126,17 @@ class _Ticket:
             return True
 
 
+def _block_shape(eng, key) -> tuple:
+    """One plane's per-block geometry, without assuming device state: a
+    fabric proxy advertises the host's shapes (``_plane_shapes``, from
+    the hello frame); a local engine reads its own pool tensor."""
+    shapes = getattr(eng, "_plane_shapes", None)
+    if shapes is not None:
+        return tuple(shapes[key])
+    s = eng.state[key].shape
+    return (int(s[0]),) + tuple(int(x) for x in s[2:])
+
+
 def _compat_check(src, dst) -> None:
     """Fail fast, on the caller's thread, for engine pairs that can never
     exchange a session: the block geometry (page size, KV planes, per-
@@ -152,8 +163,8 @@ def _compat_check(src, dst) -> None:
             f"KV plane mismatch: source {src._swap_planes} vs destination "
             f"{dst._swap_planes} (quantization layouts differ)")
     for key in src._swap_planes:
-        s_shape = (src.state[key].shape[0],) + tuple(src.state[key].shape[2:])
-        d_shape = (dst.state[key].shape[0],) + tuple(dst.state[key].shape[2:])
+        s_shape = _block_shape(src, key)
+        d_shape = _block_shape(dst, key)
         if s_shape != d_shape:
             raise MigrationError(
                 f"block geometry mismatch on plane {key!r}: per-block "
@@ -166,13 +177,43 @@ def _ask(eng, kind: str, ticket: _Ticket, timeout: float) -> dict:
     On timeout the ticket is ABANDONED (see _Ticket.abandon) so a loop
     thread that recovers later can never act on a caller that is gone —
     unless the answer landed while we were giving up, in which case it
-    is used normally."""
+    is used normally.
+
+    A fabric proxy serves the ticket over the wire (``eng.ask``): the
+    remote side owns its own retry/backoff discipline and fails typed
+    the moment the link is known dead.
+
+    For a local engine the wait is a WATCHED slice loop, not one long
+    block: a loop thread that dies (or is fenced with the ticket still
+    unserved) fails the ask typed IMMEDIATELY instead of stranding the
+    caller until the global timeout — the difference between a drain
+    that reroutes in milliseconds and one that hangs for a minute on a
+    corpse. (The fleet's failover reap also fails queued tickets when
+    it sweeps the corpse; this watchdog covers asks issued OUTSIDE a
+    fleet, and the window before the reap runs.)"""
+    if getattr(eng, "is_remote", False):
+        return eng.ask(kind, ticket, timeout)
     eng._lifecycle_q.put((kind, ticket))
     eng._wake.set()
-    if not ticket.done.wait(timeout) and ticket.abandon():
+    served = ticket.done.wait(0.0)
+    deadline = time.monotonic() + timeout
+    why = "is its serving loop healthy?"
+    while not served:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            break
+        if ticket.done.wait(min(remaining, 0.05)):
+            served = True
+            break
+        t = eng._thread
+        if eng._died or t is None or (eng._stop.is_set()
+                                      and not t.is_alive()):
+            why = "its serving loop is dead"
+            break
+    if not served and not ticket.done.wait(0.0) and ticket.abandon():
         raise MigrationError(
             f"{kind} did not complete within {timeout:.1f}s on engine "
-            f"{eng!r} (is its serving loop healthy?)")
+            f"{eng!r} ({why})")
     if ticket.error is not None:
         raise MigrationError(f"{kind} failed: {ticket.error!r}")
     return ticket.result
@@ -275,7 +316,12 @@ def _live_sessions(src) -> list:
     chunked admissions, parked entries, the waiting line. Worker-owned
     (disagg) and still-pending submits surface in these sets within a
     tick or two — drain's outer loop re-snapshots until the engine reads
-    empty."""
+    empty. A fabric proxy owns its own mirror of what it is owed
+    (``live_sessions``) — the slot/park/waiting structures live across
+    the wire."""
+    fn = getattr(src, "live_sessions", None)
+    if fn is not None:
+        return [r for r in fn() if r.status is None]
     seen, out = set(), []
 
     def add(r):

@@ -60,6 +60,27 @@ class EngineSignals:
     # route policies consume it: overload victims and routing targets can
     # be chosen by DEVICE-TRUTH busyness, not host-side queue depth alone.
     duty: Optional[float] = None
+    # fabric link quality to this engine (None for a local member): the
+    # heartbeat round-trip EMA and the measured payload-transfer
+    # bandwidth, so a route policy can prefer DCN-near destinations —
+    # the dcnprobe measurement surfaced at the routing seam.
+    fabric_rtt_ms: Optional[float] = None
+    fabric_gbps: Optional[float] = None
+
+    def to_dict(self) -> dict:
+        """JSON-safe form — the shape that crosses the fabric wire so a
+        RoutePolicy can score a REMOTE member on the same snapshot a
+        local one exposes."""
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "EngineSignals":
+        """Inverse of ``to_dict``, tolerant of schema drift: unknown
+        keys (a newer peer's fields) are DROPPED, missing ones take the
+        dataclass defaults — a signals snapshot must never be the thing
+        that breaks a mixed-version fleet."""
+        fields = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in fields})
 
 
 class ShedPolicy:
