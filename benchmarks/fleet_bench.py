@@ -314,7 +314,7 @@ def main() -> None:
                 "fabric_bytes_sent", "fabric_bytes_recv",
                 "fabric_payload_bytes", "fabric_retries",
                 "fabric_timeouts", "fabric_resends",
-                "fabric_checksum_faults", "fabric_reconnects")},
+                "fabric_checksum_faults")},
         })
         log(f"crosshost_kill_failover: pass={ok} gates={gates}")
         finish("CROSSHOST_r18.json")

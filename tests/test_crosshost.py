@@ -417,6 +417,80 @@ def test_engine_signals_round_trip():
     assert sparse.fabric_rtt_ms is None and sparse.duty is None
 
 
+def test_tcp_frame_straddling_poll_windows_never_desyncs():
+    """The receive buffer keeps partially-read bytes across poll
+    timeouts: a frame dripped onto the wire slower than the caller's
+    poll window (large migrate-meta JSON on a congested link) arrives
+    intact over several polls, and the NEXT frame still parses — the
+    stream can never desync into reading mid-frame bytes as headers."""
+    import socket
+
+    from vtpu.serving.fabric.transport import TcpChannel
+    from vtpu.serving.fabric.wire import FRAME_JSON, HDR, encode_msg
+
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    cli = socket.create_connection(srv.getsockname())
+    conn, _ = srv.accept()
+    srv.close()
+    chan = TcpChannel(conn)
+    try:
+        msg = {"kind": "meta", "blob": "x" * 4096}
+        body = encode_msg(msg)
+        frame = HDR.pack(len(body), FRAME_JSON) + body
+
+        def drip():
+            # ~25 pieces, each slower than the reader's 2ms poll window
+            for i in range(0, len(frame), 173):
+                cli.sendall(frame[i:i + 173])
+                time.sleep(0.004)
+            body2 = encode_msg({"kind": "after"})
+            cli.sendall(HDR.pack(len(body2), FRAME_JSON) + body2)
+
+        threading.Thread(target=drip, daemon=True).start()
+        got = None
+        for _ in range(2000):
+            got, _ = chan.recv(timeout=0.002)
+            if got is not None:
+                break
+        assert got == msg
+        got2 = None
+        for _ in range(2000):
+            got2, _ = chan.recv(timeout=0.002)
+            if got2 is not None:
+                break
+        assert got2 == {"kind": "after"}
+    finally:
+        chan.close()
+        cli.close()
+
+
+def test_cancel_swallowed_by_partition_retransmits_on_heal(params,
+                                                           remote_member):
+    """A cancel sent into a partition is silently lost (the send
+    'succeeds' onto a dead link). Cancels re-send until the terminal
+    arrives, so the heal replays it and the host stops decoding —
+    instead of running the whole stream for a caller that cancelled
+    long ago."""
+    plan = FaultPlan()
+    t = remote_member(eng_faults=plan)
+    _wait(lambda: t.rem._beat_ns != 0, 60, "remote warm-up beat")
+    # throttle the host's decode so the stream is still live through
+    # the partition + heal window
+    plan.arm("delayed_fetch", count=100000, arg=0.05)
+    req = t.rem.submit(P1, max_new_tokens=STEPS)
+    assert req.out.get() is not None
+    t.link.partition(True)
+    req.cancel()
+    time.sleep(0.4)  # several cancel re-sends land in the partition
+    t.link.partition(False)
+    _wait(lambda: req.status == Status.CANCELLED, 15,
+          "CANCELLED terminal after heal")
+    _wait(lambda: t.eng.stats()["active_slots"] == 0, 15,
+          "host-side slot reclaimed")
+
+
 # ------------------------------------------------------------ TCP + kill
 
 

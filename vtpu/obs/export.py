@@ -315,8 +315,6 @@ FLEET_COUNTERS = {
     "fabric_checksum_faults": ("fleet_fabric_checksum_faults",
                                "Payload chunks that failed their CRC32 "
                                "(converted to recompute-on-fault)"),
-    "fabric_reconnects": ("fleet_fabric_reconnects",
-                          "Fabric links re-established after a drop"),
 }
 # key -> (family suffix, help, scale) — same convention as engine GAUGES
 FLEET_GAUGES = {

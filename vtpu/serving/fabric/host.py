@@ -45,6 +45,13 @@ log = logging.getLogger(__name__)
 #: (the session migrated off this host and its stream continues elsewhere)
 _PUMP_STOP = object()
 
+#: a done session whose final ack never arrives (lost ack ping, client
+#: mirror dropped in a submit-timeout race) is reaped after this long —
+#: longer than any partition the fleet's ladder survives without
+#: failover, so a terminal is never reaped while a live client could
+#: still ask for its resend
+_ACK_IDLE_REAP_S = 30.0
+
 
 def _engine_geom(eng) -> dict:
     """The compat-check geometry a RemoteEngine advertises in the fleet:
@@ -166,12 +173,13 @@ class EngineHost:
                     send_seq(sess, {"kind": "end", "cid": cid,
                                     "status": status})
                     sess["done"] = True
+                    sess["done_at"] = time.monotonic()
                     return
                 send_seq(sess, {"kind": "tok", "cid": cid, "t": int(tok)})
 
         def start_session(cid, eng_name, req):
             sess = {"req": req, "eng": eng_name, "seq": 0, "outbox": [],
-                    "done": False}
+                    "done": False, "done_at": None}
             with mu:
                 sessions[cid] = sess
             t = threading.Thread(target=pump, args=(cid,), daemon=True)
@@ -363,8 +371,22 @@ class EngineHost:
                 if not self._answer_hello(chan, msg):
                     return
                 break
+            last_reap = time.monotonic()
             while not self._stop_ev.is_set():
                 msg, payload = chan.recv(timeout=0.1)
+                now = time.monotonic()
+                if now - last_reap > 1.0:
+                    # ack-idle reaper: acks normally trim done sessions,
+                    # but a lost final ack or a client that never
+                    # mirrored the cid would otherwise retain the
+                    # session dict + outbox for the channel's lifetime
+                    last_reap = now
+                    with mu:
+                        stale = [c for c, s in sessions.items()
+                                 if s["done_at"] is not None
+                                 and now - s["done_at"] > _ACK_IDLE_REAP_S]
+                        for c in stale:
+                            sessions.pop(c, None)
                 if msg is None:
                     continue
                 handle(msg, payload)

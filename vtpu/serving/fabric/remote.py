@@ -51,6 +51,11 @@ from vtpu.serving.fabric.wire import PROTO_VERSION, ProtocolError
 #: duplicated migrate_out could fork a stream) and never retry.
 _IDEMPOTENT_OPS = ("park", "stats")
 
+#: minimum spacing between cancel retransmits for one session — cancels
+#: re-send until the terminal arrives, so one swallowed by a partition
+#: is replayed after heal instead of leaving the host decoding forever
+_CANCEL_RESEND_S = 0.25
+
 
 class _Session:
     """Client-side mirror of one remote stream: the real ``Request`` the
@@ -58,7 +63,7 @@ class _Session:
     the in-order delivery cursor."""
 
     __slots__ = ("req", "eng", "cid", "rid", "prompt", "gen", "budget",
-                 "next_seq", "buf", "done", "cancel_sent",
+                 "next_seq", "buf", "done", "cancel_last",
                  "last_gap_req", "ack_floor")
 
     def __init__(self, req, eng, cid, prompt, budget):
@@ -72,7 +77,7 @@ class _Session:
         self.next_seq = 0     # next in-order seq expected from the host
         self.buf: dict = {}   # out-of-order arrivals awaiting the gap
         self.done = False
-        self.cancel_sent = False
+        self.cancel_last = 0.0  # monotonic stamp of the last SENT cancel
         self.last_gap_req = 0.0
         self.ack_floor = 0    # last cumulative ack piggybacked on a ping
 
@@ -203,6 +208,16 @@ class HostClient:
                 sess = self._sessions.get(int(msg["cid"]))
             if sess is not None:
                 self._ingest(sess, msg)
+            else:
+                # no mirror for this cid (submit-timeout race, a mirror
+                # dropped before the host settled): answer with a cancel
+                # so the host retires the orphan session instead of
+                # retaining its outbox for the channel's lifetime
+                try:
+                    self.chan.send({"kind": "cancel",
+                                    "cid": int(msg["cid"])})
+                except TransportError:
+                    self._broken = True
         elif kind == "pong":
             self._on_pong(msg)
         elif kind == "ask_reply":
@@ -339,6 +354,7 @@ class HostClient:
             time.sleep(self.ping_interval_s)
             with self._mu:
                 sessions = list(self._sessions.items())
+            now = time.monotonic()
             acks = {}
             cancels = []
             drop = []
@@ -349,17 +365,26 @@ class HostClient:
                 if sess.done and sess.next_seq <= sess.ack_floor:
                     drop.append(cid)
                 req = sess.req
-                if not sess.cancel_sent and not sess.done and (
-                        req.cancelled or sess.eng._stop.is_set()):
-                    cancels.append(cid)
-                    sess.cancel_sent = True
+                # re-send until the terminal arrives: a cancel can be
+                # swallowed by a partition without a send error, so a
+                # one-shot latch would leave the host decoding a
+                # cancelled/fenced stream forever
+                if not sess.done and (
+                        req.cancelled or sess.eng._stop.is_set()) \
+                        and now - sess.cancel_last >= _CANCEL_RESEND_S:
+                    cancels.append((cid, sess))
             if drop:
                 with self._mu:
                     for cid in drop:
                         self._sessions.pop(cid, None)
-            try:
-                for cid in cancels:
+            for cid, sess in cancels:
+                try:
                     self.chan.send({"kind": "cancel", "cid": cid})
+                    sess.cancel_last = now  # latched only once SENT
+                except TransportError:
+                    self._broken = True
+                    break  # link down: the rest retry next tick
+            try:
                 self.chan.send({"kind": "ping",
                                 "t": time.monotonic_ns(), "acks": acks})
             except TransportError:
@@ -398,8 +423,13 @@ class HostClient:
             with self._mu:
                 self._asks[tid] = pend
             wire = dict(msg)
+            # the host serves under a SHORTER budget than the client
+            # waits: a migrate_out completing near the deadline gets its
+            # typed reply back before the client abandons the ticket —
+            # an abandoned-but-served migrate_out would leave the stream
+            # pumpless with no terminal and no failover trigger
             wire.update({"kind": "ask", "op": op, "ticket": tid,
-                         "timeout": timeout})
+                         "timeout": max(timeout * 0.8, timeout - 5.0)})
             try:
                 if payload is not None:
                     t0 = time.monotonic()

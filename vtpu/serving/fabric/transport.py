@@ -17,7 +17,11 @@ heal, which is what makes a network blip token-lossless).
 **TCP** is length-prefixed stdlib framing (wire.py): one JSON control
 frame per message, binary chunk frames for payloads, per-send lock for
 atomicity, typed ``TransportError`` on a broken peer. Receive is a
-timed poll so owner threads can observe their stop events.
+timed poll so owner threads can observe their stop events — backed by a
+stateful buffer, so bytes already read when the poll window lapses are
+KEPT and the frame completes on a later poll; a frame straddling poll
+windows (large migrate-meta JSON on a congested link) can never desync
+the stream into parsing mid-frame bytes as headers.
 """
 
 from __future__ import annotations
@@ -31,6 +35,8 @@ from typing import Optional, Tuple
 from vtpu.serving.fabric.wire import (
     FRAME_BIN,
     FRAME_JSON,
+    HDR,
+    MAX_FRAME,
     ChecksumError,
     ProtocolError,
     TransportError,
@@ -38,7 +44,6 @@ from vtpu.serving.fabric.wire import (
     decode_payload,
     encode_msg,
     encode_payload,
-    recv_frame,
     send_frame,
 )
 
@@ -57,7 +62,7 @@ def new_counters() -> dict:
         "bytes_sent": 0, "bytes_recv": 0,
         "payload_bytes_sent": 0, "payload_bytes_recv": 0,
         "retries": 0, "timeouts": 0, "resends": 0,
-        "checksum_faults": 0, "reconnects": 0,
+        "checksum_faults": 0,
         "msgs_dropped": 0,  # loopback loss/partition drops (send side)
     }
 
@@ -209,17 +214,28 @@ def loopback_pair(faults=None, delay_s: float = 0.02
 # --------------------------------------------------------------------- tcp
 
 
+#: per-chunk budget for payload frames already in flight behind their
+#: JSON header; a stall this long mid-payload is a dead link, failed typed
+FRAME_BUDGET_S = 30.0
+
+
 class TcpChannel(Channel):
     """Length-prefixed stdlib TCP framing. One JSON frame per message;
     a message with a payload carries its descriptor inline
     (``_pchunks``) and is followed by that many binary chunk frames —
-    the send lock keeps the sequence atomic across sender threads."""
+    the send lock keeps the sequence atomic across sender threads.
+
+    Receive is stateful: partial frame bytes survive poll timeouts in
+    ``_rxbuf`` and nothing is consumed until a whole frame is buffered,
+    so the stream stays aligned on frame boundaries no matter how the
+    caller's poll windows land."""
 
     def __init__(self, sock: socket.socket):
         super().__init__()
         self._sock = sock
         self._send_mu = threading.Lock()
         self._recv_mu = threading.Lock()
+        self._rxbuf = bytearray()
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
 
     def send(self, msg: dict, payload: Optional[dict] = None) -> None:
@@ -241,38 +257,79 @@ class TcpChannel(Channel):
         if desc is not None:
             self.counters["payload_bytes_sent"] += desc["nbytes"]
 
+    def _fill(self, n: int, deadline: Optional[float]) -> bool:
+        """Grow the receive buffer to at least *n* bytes. Returns False
+        when the deadline lapses first — with every byte already read
+        KEPT in the buffer for the next poll — and raises a typed
+        TransportError on EOF or a broken socket."""
+        while len(self._rxbuf) < n:
+            if deadline is None:
+                self._sock.settimeout(None)
+            else:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._sock.settimeout(left)
+            try:
+                part = self._sock.recv(1 << 16)
+            except (socket.timeout, TimeoutError):
+                return False
+            except OSError as exc:
+                raise TransportError(f"recv failed: {exc}") from None
+            if not part:
+                raise TransportError("peer closed the connection")
+            self._rxbuf.extend(part)
+        return True
+
+    def _frame_at(self, off: int, deadline: Optional[float]):
+        """Buffer one whole frame at offset *off* without consuming it.
+        Returns ``(ftype, body_start, body_len)``, or None when the
+        deadline lapses (partial bytes stay buffered)."""
+        if not self._fill(off + HDR.size, deadline):
+            return None
+        length, ftype = HDR.unpack_from(self._rxbuf, off)
+        if length > MAX_FRAME:
+            raise ProtocolError(f"frame length {length} exceeds MAX_FRAME")
+        if not self._fill(off + HDR.size + length, deadline):
+            return None
+        return ftype, off + HDR.size, length
+
     def recv(self, timeout: Optional[float] = None):
         if self._closed:
             raise TransportError("channel closed")
         with self._recv_mu:
-            self._sock.settimeout(timeout)
-            try:
-                ftype, body = recv_frame(self._sock)
-            except (socket.timeout, TimeoutError):
-                return None, None
+            deadline = None if timeout is None \
+                else time.monotonic() + timeout
+            fr = self._frame_at(0, deadline)
+            if fr is None:
+                return None, None  # partial frame kept for the next poll
+            ftype, start, length = fr
             if ftype != FRAME_JSON:
                 raise ProtocolError(
                     f"expected a JSON frame, got type {ftype}")
-            msg = decode_msg(body)
-            n = len(body)
+            msg = decode_msg(bytes(self._rxbuf[start:start + length]))
+            n = HDR.size + length
             desc = msg.pop("_pdesc", None)
-            nchunks = msg.pop("_pchunks", 0)
+            nchunks = int(msg.pop("_pchunks", 0))
             chunks = []
+            off = start + length
             if desc is not None:
                 # the chunks are already in flight behind the header:
                 # a generous fixed budget per chunk, typed on timeout
-                self._sock.settimeout(30.0)
-                for _ in range(int(nchunks)):
-                    try:
-                        ft, c = recv_frame(self._sock)
-                    except (socket.timeout, TimeoutError):
+                for _ in range(nchunks):
+                    cfr = self._frame_at(
+                        off, time.monotonic() + FRAME_BUDGET_S)
+                    if cfr is None:
                         raise TransportError(
-                            "payload chunk timed out mid-stream") from None
+                            "payload chunk timed out mid-stream")
+                    ft, cstart, clen = cfr
                     if ft != FRAME_BIN:
                         raise ProtocolError(
                             f"expected a BIN frame, got type {ft}")
-                    chunks.append(c)
-                    n += len(c)
+                    chunks.append(bytes(self._rxbuf[cstart:cstart + clen]))
+                    off = cstart + clen
+                    n += HDR.size + clen
+            del self._rxbuf[:off]
         self.counters["msgs_recv"] += 1
         self.counters["bytes_recv"] += n
         payload = self._decode_payload(msg, desc, chunks)

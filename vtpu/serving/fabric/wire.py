@@ -37,7 +37,7 @@ import numpy as np
 PROTO_VERSION = 1
 
 # frame header: 4-byte big-endian body length + 1-byte frame type
-_HDR = struct.Struct(">IB")
+HDR = struct.Struct(">IB")
 FRAME_JSON = 1   # utf-8 JSON control message
 FRAME_BIN = 2    # raw payload chunk (descriptor rode the preceding JSON)
 
@@ -90,37 +90,12 @@ def send_frame(sock, ftype: int, data: bytes) -> int:
     """One frame onto a connected socket. Returns bytes written (header
     included). Raises TransportError on a broken pipe."""
     try:
-        sock.sendall(_HDR.pack(len(data), ftype))
+        sock.sendall(HDR.pack(len(data), ftype))
         if data:
             sock.sendall(data)
     except OSError as exc:
         raise TransportError(f"send failed: {exc}") from None
-    return _HDR.size + len(data)
-
-
-def recv_exact(sock, n: int) -> bytes:
-    """Read exactly *n* bytes. Raises TransportError on EOF (peer gone),
-    lets socket.timeout propagate (the caller's poll loop owns it)."""
-    buf = bytearray()
-    while len(buf) < n:
-        try:
-            part = sock.recv(n - len(buf))
-        except OSError as exc:
-            if isinstance(exc, TimeoutError):
-                raise
-            raise TransportError(f"recv failed: {exc}") from None
-        if not part:
-            raise TransportError("peer closed the connection")
-        buf.extend(part)
-    return bytes(buf)
-
-
-def recv_frame(sock) -> Tuple[int, bytes]:
-    hdr = recv_exact(sock, _HDR.size)
-    length, ftype = _HDR.unpack(hdr)
-    if length > MAX_FRAME:
-        raise ProtocolError(f"frame length {length} exceeds MAX_FRAME")
-    return ftype, (recv_exact(sock, length) if length else b"")
+    return HDR.size + len(data)
 
 
 # ----------------------------------------------------------------- payload

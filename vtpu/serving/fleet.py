@@ -1041,7 +1041,7 @@ class EngineFleet:
             "fabric_payload_bytes": 0,
             "fabric_retries": 0, "fabric_timeouts": 0,
             "fabric_resends": 0, "fabric_checksum_faults": 0,
-            "fabric_reconnects": 0, "fabric_links_down": 0,
+            "fabric_links_down": 0,
             "fabric_rtt_ms": 0.0, "fabric_gbps": 0.0,
         }
         clients = {}
@@ -1062,7 +1062,6 @@ class EngineFleet:
             out["fabric_timeouts"] += c["timeouts"]
             out["fabric_resends"] += c["resends"]
             out["fabric_checksum_faults"] += c["checksum_faults"]
-            out["fabric_reconnects"] += c["reconnects"]
             if not c["link_ok"]:
                 out["fabric_links_down"] += 1
             if c["rtt_ms"] is not None:
