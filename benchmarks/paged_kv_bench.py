@@ -52,9 +52,21 @@ exhibit, not a measurement (the routing table's perf basis is the
 standalone study, DECODE_ATTN_r05.json — 1.1-1.9x at every serving cell).
 Artifact: PAGED_ATTN_r12.json.
 
+``--attn-kernel --spec-chunk T`` (ISSUE 19 satellite) reruns the same
+kernel-vs-gather A/B with speculation enabled (spec_tokens = T-1), so
+every accepting tick dispatches a T-query verify chunk instead of a
+single-query decode step. The route counters then attribute MIXED-t
+traffic (t=1 decode ticks interleave with t=T verify chunks under one
+forced route), the HLO audit lowers spec_step at the [slots, T] draft
+shape, and an extra gate pins the per-T floor-table contract: a chunk
+depth with no PAGED_ATTN_T_FLOORS row never routes kernel on auto, even
+on TPU. This is the on-chip sweep vehicle for re-tightening T>1 floor
+rows per measured cell (every T=4 cell lost in DECODE_ATTN_r05.json, so
+none ship by default).
+
 Usage:  python benchmarks/paged_kv_bench.py [--quick] [--tp N]
-            [--attn-kernel] [--hbm-tokens N] [--page P] [--requests K]
-            [--prompt-len N] [--max-new N] [--out F]
+            [--attn-kernel [--spec-chunk T]] [--hbm-tokens N] [--page P]
+            [--requests K] [--prompt-len N] [--max-new N] [--out F]
 Emits:  full artifact JSON on stdout line 1, then the compact one-line
         headline summary (metric/value/verdict — the PR-3 driver-artifact
         convention) as the FINAL stdout line; human notes on stderr.
@@ -89,6 +101,12 @@ def main() -> None:
                          "instead (same paged engine, only the paged "
                          "decode-attention route differs) -> "
                          "PAGED_ATTN_r12.json")
+    ap.add_argument("--spec-chunk", type=int, default=1,
+                    help="with --attn-kernel: enable speculation "
+                         "(spec_tokens = T-1) so accepting ticks dispatch "
+                         "T-query verify chunks — the on-chip sweep "
+                         "vehicle for the per-T PAGED_ATTN_T_FLOORS rows "
+                         "(default 1: plain single-query decode)")
     ap.add_argument("--hbm-tokens", type=int, default=None,
                     help="simulated KV HBM budget, in cached tokens — "
                          "PER CHIP when --tp > 1. Default 512 // tp: the "
@@ -122,6 +140,14 @@ def main() -> None:
         a.requests = min(a.requests, 12)
         a.max_new = min(a.max_new, 24)
         a.prefix_requests = min(a.prefix_requests, 4)
+    if a.spec_chunk < 1:
+        print("--spec-chunk must be >= 1 (T = queries per verify "
+              "dispatch)", file=sys.stderr)
+        sys.exit(2)
+    if a.spec_chunk > 1 and not a.attn_kernel:
+        print("--spec-chunk only shapes the kernel-vs-gather A/B; pass "
+              "--attn-kernel with it", file=sys.stderr)
+        sys.exit(2)
     if a.attn_kernel:
         if a.tp > 1:
             # the A/B arms run single-chip; a silent single-chip run under
@@ -346,7 +372,8 @@ def run_attn_kernel(a) -> None:
     import jax.numpy as jnp
 
     from vtpu.models import ModelConfig, init_params
-    from vtpu.ops.decode_attn import count_pool_gathers, paged_attn_route
+    from vtpu.ops.decode_attn import (PAGED_ATTN_T_FLOORS,
+                                      count_pool_gathers, paged_attn_route)
     from vtpu.serving import ServingConfig, ServingEngine
     from vtpu.serving.adapters import TransformerSlotModel
 
@@ -354,6 +381,12 @@ def run_attn_kernel(a) -> None:
         a.max_seq = min(a.max_seq, 256)
         a.requests = min(a.requests, 6)
     backend = jax.default_backend()
+    # --spec-chunk T: speculation on (spec_tokens = T-1) turns accepting
+    # ticks into T-query verify chunks, so the route counters see mixed-t
+    # traffic and the HLO audit runs at the [slots, T] spec_step shape —
+    # the sweep vehicle for the per-T floor table
+    chunk_t = a.spec_chunk
+    spec = chunk_t - 1
     # one long-prompt ANCHOR pins every tick's read window at max_seq while
     # short requests stream beside it: window >> live pages for every slot
     # but the anchor's — the exact regime where the gather route's
@@ -384,7 +417,8 @@ def run_attn_kernel(a) -> None:
     def serving(route):
         return ServingConfig(
             slots=slots, prefill_buckets=(short_bucket, a.max_seq),
-            max_new_tokens=a.max_new, kv_page=a.page, paged_attn=route)
+            max_new_tokens=a.max_new, kv_page=a.page, paged_attn=route,
+            spec_tokens=spec)
 
     def run_arm(route: str) -> dict:
         eng = ServingEngine(params, cfg, serving(route))
@@ -427,6 +461,10 @@ def run_attn_kernel(a) -> None:
                 stats["kv_bucket_hist"].items())},
             "read_pages_ratio": stats["read_pages_ratio"],
         }
+        if spec:
+            out["spec_ticks"] = stats["spec_ticks"]
+            out["mean_emitted_per_spec_tick"] = \
+                stats["mean_emitted_per_spec_tick"]
         print(f"{route:>6}: {out['tokens_per_sec']:8.1f} tok/s "
               f"({out['decode_ticks']} ticks, wall {out['wall_s']:.2f}s, "
               f"kernel/gather ticks {out['paged_attn_kernel_ticks']}/"
@@ -444,6 +482,20 @@ def run_attn_kernel(a) -> None:
             jnp.ones((slots,), bool), window, unroll=True,
         ).compile().as_text()
 
+    def spec_hlo(route: str) -> str:
+        # the T-query analogue of decode_hlo: audit the verify-chunk
+        # executable at the [slots, T] draft shape the engine dispatches
+        model = TransformerSlotModel(params, cfg, kv_page=a.page,
+                                     paged_attn=route)
+        state = model.init_state(slots)
+        fn = jax.jit(model.spec_step,
+                     static_argnames=("kv_bucket", "unroll"))
+        return fn.lower(
+            model.params, state, jnp.zeros((slots, chunk_t), jnp.int32),
+            jnp.ones((slots,), bool),
+            jnp.full((slots,), chunk_t, jnp.int32), window, unroll=True,
+        ).compile().as_text()
+
     gather = run_arm("gather")
     kernel = run_arm("kernel")
     ratio = (kernel["tokens_per_sec"] / gather["tokens_per_sec"]
@@ -452,8 +504,9 @@ def run_attn_kernel(a) -> None:
     # decode step materializes [B, window, H, Dh] per value plane per
     # layer; the kernel arm's executable must carry NONE of them
     min_elems = slots * window * cfg.n_heads * cfg.head_dim
-    kernel_gathers = count_pool_gathers(decode_hlo("kernel"), min_elems)
-    gather_gathers = count_pool_gathers(decode_hlo("gather"), min_elems)
+    audit_hlo = spec_hlo if spec else decode_hlo
+    kernel_gathers = count_pool_gathers(audit_hlo("kernel"), min_elems)
+    gather_gathers = count_pool_gathers(audit_hlo("gather"), min_elems)
     gates = {
         "streams_token_equal": gather["streams"] == kernel["streams"],
         "route_counters_attributed": (
@@ -466,10 +519,25 @@ def run_attn_kernel(a) -> None:
         # per-shape routing never selects the kernel where it measured
         # slower: off-TPU that is everywhere (interpreted pallas)
         "auto_route_off_tpu_is_gather": (
-            backend == "tpu" or paged_attn_route(None, window) == "gather"),
+            backend == "tpu"
+            or paged_attn_route(None, window, t=chunk_t) == "gather"),
+        # a chunk depth with no floor-table row never routes kernel on
+        # auto, even on TPU — the forced routes above are the only way to
+        # exercise the kernel at an unmeasured T (add a
+        # PAGED_ATTN_T_FLOORS row per measured winning cell to change it)
+        "auto_route_unmeasured_t_is_gather": (
+            chunk_t == 1
+            or (chunk_t, False) in PAGED_ATTN_T_FLOORS
+            or paged_attn_route(None, window, backend="tpu",
+                                t=chunk_t) == "gather"),
         "device_gets_per_tick_contract": (
             gather["device_gets_per_tick"] == 1.0
             and kernel["device_gets_per_tick"] == 1.0),
+        # --spec-chunk runs are vacuous unless T-query verify chunks
+        # actually flowed through both routes
+        "spec_chunks_dispatched": (
+            not spec or (gather["spec_ticks"] > 0
+                         and kernel["spec_ticks"] > 0)),
     }
     for arm in (gather, kernel):
         del arm["streams"]  # equality gated above; keep the artifact lean
@@ -490,6 +558,7 @@ def run_attn_kernel(a) -> None:
         "backend": backend,
         "quick": a.quick,
         "window_tokens": window,
+        "spec_chunk": chunk_t,
         "page": a.page,
         "slots": slots,
         "anchor_prompt_len": anchor_len,
@@ -513,7 +582,9 @@ def run_attn_kernel(a) -> None:
                   "max_seq": cfg.max_seq},
         "arms": [gather, kernel],
     }
-    out_path = a.out or (None if a.quick else "PAGED_ATTN_r12.json")
+    # --spec-chunk sweep cells are per-T measurements, not the T=1
+    # headline artifact: they only write where --out points them
+    out_path = a.out or (None if a.quick or spec else "PAGED_ATTN_r12.json")
     if out_path:
         Path(out_path).write_text(json.dumps(artifact, indent=2) + "\n")
     print(json.dumps(artifact))

@@ -68,6 +68,9 @@ QUICK_RUNS = {
     "decode_loop_k": [str(ROOT / "benchmarks" / "decode_bench.py"),
                       "--loop-k", "--quick", "--loop-slots", "2",
                       "--ks", "1,2,4", "--repeats", "1"],
+    "fused_spec": [str(ROOT / "benchmarks" / "decode_bench.py"),
+                   "--fused-spec", "--quick", "--slots", "2",
+                   "--steps", "24", "--waves", "1", "--repeats", "1"],
     "prefill": [str(ROOT / "benchmarks" / "prefill_bench.py"), "--quick",
                 "--slots", "2", "--bg", "1", "--burst", "3",
                 "--bg-steps", "24", "--prompt-len", "12"],
@@ -96,7 +99,7 @@ QUICK_RUNS = {
 # oversubscribed past ~3 compile-heavy processes at once (full 9-way
 # launch measured no faster and thrashes small-core runners)
 QUICK_WAVES = (
-    ("paged_kv_tp2", "overcommit", "decode"),
+    ("paged_kv_tp2", "overcommit", "decode", "fused_spec"),
     ("disagg", "paged_kv", "obs"),
     # obs_fleet rides wave 3 rather than a wave of its own: a serial
     # fifth wave costs its whole wall (~60-90s) against the tier's 870s
@@ -147,6 +150,7 @@ TEST_TO_RUN = {
     "test_overcommit_bench_quick_small_iteration": "overcommit",
     "test_decode_bench_quick_two_slot_iteration": "decode",
     "test_decode_bench_loop_k_quick_iteration": "decode_loop_k",
+    "test_decode_bench_fused_spec_quick_iteration": "fused_spec",
     "test_prefill_bench_quick_two_slot_iteration": "prefill",
     "test_disagg_bench_quick_small_iteration": "disagg",
     "test_obs_bench_quick_small_iteration": "obs",
@@ -377,6 +381,39 @@ def test_decode_bench_loop_k_quick_iteration(quick):
     assert cells[1]["device_gets_per_token"] == 1.0
     assert cells[4]["device_gets_per_token"] == 0.25
     assert cells[4]["loop_flushes"] > 0
+    assert not artifact["perf_gated"]  # quick: contracts only
+    assert summary["summary"] and summary["verdict"] == "pass"
+    assert summary["deterministic_gates_ok"]
+
+
+def test_decode_bench_fused_spec_quick_iteration(quick):
+    """decode_bench --fused-spec --quick at smoke scale: the fused
+    draft+verify grid runs end to end with every deterministic gate
+    holding — each (k, K) cell's measured streams token-equal to the
+    plain k=1 no-spec arm, the one-fetch-per-flush accounting honest
+    against the acceptance telemetry, and staggered budgets truncating
+    at exactly their budget with a guaranteed mid-flush freeze. The
+    >= 1.8x tokens/sec bar and the fetch-per-token-below-1/k comparison
+    are full-run gates, never asserted here (noisy-CI discipline)."""
+    r = quick["fused_spec"]
+    assert r.returncode == 0, r.stderr
+    lines = [ln for ln in r.stdout.splitlines() if ln.strip()]
+    artifact = json.loads(lines[0])
+    summary = json.loads(lines[-1])
+    assert artifact["metric"] == \
+        "fused_spec_tokens_per_sec_speedup_vs_plain_k1"
+    det = artifact["deterministic_gates"]
+    assert det["streams_token_equal_plain"]
+    assert det["accounting_honest"]
+    assert det["early_exit_exact_budget"]
+    cells = {c["arm"]: c for c in artifact["sweep"]}
+    assert cells["plain"]["spec_ticks"] == 0
+    fused = [c for c in artifact["sweep"] if c["k"] > 1]
+    assert fused
+    for c in fused:
+        assert c["fused_flushes"] > 0
+        assert c["tick_fetches"] == c["loop_flushes"]
+        assert c["mean_accepted_per_verify_tick"] is not None
     assert not artifact["perf_gated"]  # quick: contracts only
     assert summary["summary"] and summary["verdict"] == "pass"
     assert summary["deterministic_gates_ok"]

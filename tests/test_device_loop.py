@@ -18,8 +18,9 @@ Fast (non-slow) tier. The contract under test, layered like the change:
   the host-replicated page-table/length state reconciles with the device
   at every boundary (the parked entry's seq_len equals the device length);
 - decode_loop_k=1 is bit-identical to None (resolved to the classic loop);
-- interaction guards raise precise errors for the two features that need
-  host logits every tick (custom sample=, active speculation).
+- interaction guards raise precise errors for the one feature that needs
+  host logits every tick (custom sample=); active speculation FUSES into
+  the loop instead (tests/test_fused_spec.py).
 
 conftest forces --xla_force_host_platform_device_count=8, so the tp=2 case
 runs on CPU CI exactly like the paged-TP suite.
@@ -393,10 +394,13 @@ def test_guard_custom_sampler_rejected(params):
                       sample=lambda logits: int(jnp.argmax(logits)))
 
 
-def test_guard_active_speculation_rejected(params):
-    with pytest.raises(ValueError, match="incompatible with active "
-                                         "speculation"):
-        ServingEngine(params, CFG, _serving(4, spec_tokens=3))
+def test_active_speculation_fuses_into_loop(params):
+    """Active speculation no longer conflicts with the device loop: the
+    draft moved on device, so spec_tokens + decode_loop_k construct the
+    FUSED engine (tests/test_fused_spec.py owns the behavior)."""
+    eng = ServingEngine(params, CFG, _serving(4, spec_tokens=3))
+    assert eng._fused_spec and eng._decode_fused is not None
+    assert eng._decode_loop is not None  # the cooloff fallback dispatch
 
 
 def test_guard_inactive_speculation_composes(params):

@@ -1,0 +1,530 @@
+"""Fused device-side speculation (ISSUE 19 tentpole).
+
+Fast (non-slow) tier. The contract under test, layered like the change:
+
+- the fused loop (decode_loop_k > 1 AND spec_tokens > 0) is TOKEN-EQUAL
+  to (a) the unfused host-drafted spec path and (b) the plain k-tick loop
+  with speculation inert, for dense exact, paged, paged int8 and a tp=2
+  pool — greedy verification emits the model's own argmax at every
+  accepted position, so the stream equals plain greedy decode for ANY
+  draft contents (transformer.multi_tick_spec_decode's by-construction
+  argument, pinned here empirically);
+- VARIABLE per-slot advance: a flush delivers sum(counts[b, :]) tokens
+  per slot, staggered budgets truncate at EXACTLY the budget (the device
+  counts each verify tick against the remaining budget), and the freezes
+  are counted as loop_early_exits;
+- the transfer contract: ONE [B, k, K+1] fetch per flush, so host
+  fetches per delivered token run strictly below the plain loop's 1/k
+  whenever anything verifies;
+- retire/admit mid-flush invalidation k*(K+1)-deep (the PR-1 identity
+  check applied to the token CUBE) and park deferring to the flush
+  boundary with host/device lengths reconciled;
+- the LoopPolicy program shape: instance / class / "module:attr" loading
+  (the shed-policy discipline), a deterministic k-schedule drives the
+  traced fori_loop bound with zero recompiles, and pick_k failures
+  degrade to the static k instead of killing the loop;
+- cooloff hysteresis still disengages speculation INSIDE the loop: an
+  underwater acceptance EMA swaps the flush to the plain _decode_loop
+  executable (token-equal by contract) and re-probes on schedule;
+- the device n-gram draft (transformer.ngram_draft) agrees with the
+  host-side lookup_draft on its continuation semantics;
+- the silent-ignore bugfix: dropped spec_tokens surfaces as
+  stats()["spec_disabled_reason"] + a one-time "spec_disabled" trace
+  event, and spec_mean_accepted rides EngineSignals for policies.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from vtpu.models import ModelConfig, init_params
+from vtpu.models.transformer import LOOP_PAD_TOKEN, ngram_draft
+from vtpu.serving import ServingConfig, ServingEngine
+from vtpu.serving.engine import lookup_draft
+from vtpu.serving.shed import (AdaptiveLoopPolicy, EngineSignals,
+                               FixedLoopPolicy, LoopPolicy, load_loop_policy)
+
+CFG = ModelConfig(
+    vocab=64, d_model=32, n_heads=4, n_layers=1, d_ff=64,
+    max_seq=64, head_dim=8, dtype=jnp.float32, use_pallas=False,
+)
+CFG_INT8 = ModelConfig(
+    vocab=64, d_model=32, n_heads=4, n_layers=1, d_ff=64,
+    max_seq=64, head_dim=8, dtype=jnp.float32, use_pallas=False,
+    kv_int8=True,
+)
+CFG_LONG = ModelConfig(
+    vocab=64, d_model=32, n_heads=4, n_layers=1, d_ff=64,
+    max_seq=512, head_dim=8, dtype=jnp.float32, use_pallas=False,
+)
+PAGE = 8
+needs_devices = pytest.mark.skipif(
+    len(jax.devices()) < 2, reason="needs 2 virtual devices")
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(jax.random.key(0), CFG)
+
+
+@pytest.fixture(scope="module")
+def params_int8():
+    return init_params(jax.random.key(0), CFG_INT8)
+
+
+def _prompt(seed, n, vocab=CFG.vocab):
+    return [int(t) % vocab for t in jax.random.randint(
+        jax.random.key(seed), (n,), 1, vocab, jnp.int32)]
+
+
+def _serving(**kw):
+    base = dict(slots=2, prefill_buckets=(16,), max_new_tokens=12)
+    base.update(kw)
+    return ServingConfig(**base)
+
+
+def _run(params, serving, prompts, budgets=None, mesh=None, cfg=CFG):
+    eng = ServingEngine(params, cfg, serving, mesh=mesh)
+    eng.start()
+    try:
+        reqs = [eng.submit(p, max_new_tokens=(budgets[i] if budgets else 0))
+                for i, p in enumerate(prompts)]
+        streams = [list(r.stream()) for r in reqs]
+        stats = eng.stats()
+    finally:
+        eng.stop()
+    return streams, stats
+
+
+def _three_arms(params, prompts, cfg=CFG, mesh=None, budgets=None, **kw):
+    """plain loop (spec inert) / unfused spec / fused — the equality
+    triangle every layout must close."""
+    plain, _ = _run(params, _serving(decode_loop_k=4, **kw), prompts,
+                    budgets=budgets, mesh=mesh, cfg=cfg)
+    spec, _ = _run(params, _serving(spec_tokens=3, **kw), prompts,
+                   budgets=budgets, mesh=mesh, cfg=cfg)
+    fused, stats = _run(
+        params, _serving(decode_loop_k=4, spec_tokens=3, **kw), prompts,
+        budgets=budgets, mesh=mesh, cfg=cfg)
+    return plain, spec, fused, stats
+
+
+# ------------------------------------------------- token-equality triangle
+
+
+def test_fused_token_equal_dense_exact(params):
+    prompts = [_prompt(1, 5), _prompt(2, 7)]
+    plain, spec, fused, stats = _three_arms(params, prompts)
+    assert fused == spec == plain
+    assert stats["fused_spec"] and stats["fused_flushes"] > 0
+    assert stats["spec_ticks"] > 0 and stats["decode_ticks"] == 0
+
+
+def test_fused_token_equal_paged(params):
+    prompts = [_prompt(3, 5), _prompt(4, 6)]
+    plain, spec, fused, stats = _three_arms(params, prompts, kv_page=PAGE)
+    assert fused == spec == plain
+    # every inner verify tick resolved a paged route (t=K+1 chunks route
+    # through paged_attn_route exactly like the sync spec path)
+    assert (stats["paged_attn_kernel_ticks"]
+            + stats["paged_attn_gather_ticks"]) > 0
+
+
+def test_fused_token_equal_paged_int8(params_int8):
+    prompts = [_prompt(5, 5), _prompt(6, 6)]
+    plain, spec, fused, _ = _three_arms(
+        params_int8, prompts, cfg=CFG_INT8, kv_page=PAGE)
+    assert fused == spec == plain
+
+
+@needs_devices
+def test_fused_token_equal_tp2(params):
+    from vtpu.parallel.mesh import make_axis_mesh
+
+    mesh = make_axis_mesh("tp", 2)
+    prompts = [_prompt(7, 5), _prompt(8, 6)]
+    plain, spec, fused, _ = _three_arms(
+        params, prompts, mesh=mesh, kv_page=PAGE)
+    assert fused == spec == plain
+
+
+# ------------------------------------- variable advance + transfer contract
+
+
+def test_variable_advance_staggered_budgets_truncate_exactly(params):
+    """Budgets chosen so accepted runs overshoot mid-tick: every stream
+    stops at EXACTLY its budget (the device counts each verify tick
+    against the remaining budget — min(accepted+1, bud)), the freezes
+    show as loop_early_exits, and the fetch contract holds: one fetch per
+    flush, fetches per DELIVERED token strictly below the plain loop's
+    1/k whenever anything verified."""
+    prompts = [_prompt(10, 5), _prompt(11, 6)]
+    # budget 3 < k guarantees a mid-flush freeze (every participating tick
+    # emits >= 1 token, so at most 3 of the 4 inner ticks can run); 11
+    # exercises a deep multi-flush run that stops off every edge
+    budgets = [3, 11]
+    streams, stats = _run(
+        params, _serving(decode_loop_k=4, spec_tokens=3, max_new_tokens=12),
+        prompts, budgets=budgets)
+    assert [len(s) for s in streams] == budgets
+    assert stats["loop_early_exits"] > 0
+    assert stats["tick_fetches"] == stats["loop_flushes"]
+    # inner-tick accounting: spec_ticks counts the dispatched window k per
+    # flush, one fetch amortizes over all of them
+    assert stats["device_gets_per_token"] == pytest.approx(
+        stats["tick_fetches"] / stats["spec_ticks"])
+    # the headline inequality: mean acceptance > 1 pushes fetches per
+    # delivered token strictly below the plain loop's 1/k
+    loop_tokens = stats["spec_emitted"]
+    assert stats["mean_emitted_per_spec_tick"] > 1.0
+    assert stats["tick_fetches"] / loop_tokens < 1 / 4
+    base, _ = _run(params, _serving(max_new_tokens=12), prompts,
+                   budgets=budgets)
+    assert streams == base
+
+
+def test_multi_tick_spec_decode_pads_and_counts():
+    """Function-level: the [B, k, K+1] cube carries LOOP_PAD_TOKEN past
+    each tick's accepted count, counts are zero after a lane freezes on
+    its budget, and the device length advances by exactly the summed
+    accepted counts."""
+    from vtpu.serving.adapters import (
+        TransformerSlotModel, fused_spec_decode_step)
+
+    params = init_params(jax.random.key(3), CFG)
+    model = TransformerSlotModel(params, CFG)
+    state = model.init_state(2)
+    lens = []
+    for slot, n in ((0, 4), (1, 5)):
+        padded = jnp.zeros((1, 8), jnp.int32).at[0, :n].set(
+            jnp.asarray(_prompt(30 + slot, n), jnp.int32))
+        _, state = model.prefill_into_slot(
+            model.params, state, padded, jnp.int32(slot), jnp.int32(n))
+        lens.append(n)
+    step = jax.jit(
+        fused_spec_decode_step(model, 4, 3, -1, 3),
+        static_argnames=("kv_bucket", "unroll"))
+    out, counts, carry, state = step(
+        model.params, state, jnp.zeros((2,), jnp.int32),
+        jnp.asarray([True, True]), jnp.asarray([3, 16], jnp.int32),
+        jnp.zeros((2, 32), jnp.int32), jnp.zeros((2,), jnp.int32),
+        jnp.int32(4), 0, unroll=True)
+    out, counts, carry = jax.device_get((out, counts, carry))
+    sums = counts.sum(axis=1).tolist()
+    # lane 0: budget 3 < k, so the wall ALWAYS lands (>= 1 token/tick
+    # guaranteed) and it stops at exactly 3; lane 1: an active lane with
+    # budget delivers at least one token every tick, at most K+1
+    assert sums[0] == 3
+    assert 4 <= sums[1] <= 16
+    assert (counts[1] >= 1).all()
+    for b in range(2):
+        for i in range(4):
+            c = int(counts[b, i])
+            assert (out[b, i, c:] == LOOP_PAD_TOKEN).all()
+            assert (out[b, i, :c] != LOOP_PAD_TOKEN).all()
+    # frozen lane: once the budget wall lands, later ticks count 0
+    assert int(counts[0, -1]) == 0
+    new_lens = jax.device_get(state["len"])
+    assert new_lens.tolist() == [lens[0] + sums[0], lens[1] + sums[1]]
+    # carry = each lane's last ACCEPTED token
+    last0 = out[0][counts[0] > 0][-1]
+    assert carry[0] == last0[int(counts[0][counts[0] > 0][-1]) - 1]
+
+
+# --------------------------------------- lifecycle at the flush boundary
+
+
+def test_retire_admit_mid_flush_invalidation(params):
+    """Slot recycling under the fused lookahead: staggered budgets force
+    retires and re-admissions between flushes — every stream matches the
+    classic run token for token (a recycled slot's orphaned k*(K+1) cube
+    column is dropped by the identity check, never delivered to the new
+    occupant)."""
+    prompts = [_prompt(40 + i, 4 + (i % 3)) for i in range(8)]
+    budgets = [3, 9, 5, 11, 4, 7, 6, 10]
+    base, _ = _run(params, _serving(max_new_tokens=12), prompts,
+                   budgets=budgets)
+    got, stats = _run(
+        params, _serving(decode_loop_k=4, spec_tokens=3, max_new_tokens=12),
+        prompts, budgets=budgets)
+    assert got == base
+    assert [len(s) for s in got] == budgets
+    assert stats["admissions"] == 8
+
+
+def test_park_during_fused_flush_defers_to_boundary():
+    """park() against the fused loop: the park settles at a flush
+    boundary with the host-side length mirror equal to the device cache
+    length (variable advance reconciled), and the resumed stream equals
+    the never-parked run."""
+    params = init_params(jax.random.key(0), CFG_LONG)
+    budget = 300
+    base, _ = _run(params, ServingConfig(
+        slots=2, prefill_buckets=(8,), max_new_tokens=budget, kv_page=PAGE,
+        kv_swap=16), [_prompt(50, 5)], budgets=[budget], cfg=CFG_LONG)
+    eng = ServingEngine(params, CFG_LONG, ServingConfig(
+        slots=2, prefill_buckets=(8,), max_new_tokens=budget, kv_page=PAGE,
+        kv_swap=16, decode_loop_k=4, spec_tokens=3))
+    eng.start()
+    try:
+        r = eng.submit(_prompt(50, 5), max_new_tokens=budget)
+        it = r.stream()
+        got = [next(it)]
+        eng.park(r)
+        deadline = time.time() + 30
+        while r not in eng._parked and time.time() < deadline:
+            time.sleep(0.005)
+        assert r in eng._parked, "park never settled at a flush boundary"
+        entry = eng._parked[r]
+        park_ev = [e for e in eng.trace.snapshot() if e[2] == "park"][-1]
+        slot = park_ev[4]
+        dev_len = int(jax.device_get(eng.state["len"])[slot])
+        assert entry["seq_len"] == dev_len
+        assert len(entry["tokens"]) == entry["seq_len"]
+        eng.resume(r)
+        got += list(it)
+        stats = eng.stats()
+    finally:
+        eng.stop()
+    assert got == base[0]
+    assert stats["parks"] == 1 and stats["resumes"] == 1
+
+
+# ------------------------------------------------- LoopPolicy program shape
+
+
+class ScheduledPolicy(LoopPolicy):
+    """Deterministic k-schedule for the pinned-schedule test (module-level
+    so "tests.test_fused_spec:ScheduledPolicy" loads)."""
+
+    SCHEDULE = (1, 2, 4, 3)
+
+    def __init__(self):
+        self.calls = 0
+        self.seen = []
+
+    def pick_k(self, k_max, signals=None):
+        self.seen.append(signals)
+        k = self.SCHEDULE[self.calls % len(self.SCHEDULE)]
+        self.calls += 1
+        return k
+
+
+def test_load_loop_policy_shapes():
+    assert isinstance(load_loop_policy(None), FixedLoopPolicy)
+    assert isinstance(load_loop_policy(AdaptiveLoopPolicy),
+                      AdaptiveLoopPolicy)                     # class
+    inst = ScheduledPolicy()
+    assert load_loop_policy(inst) is inst                     # instance
+    loaded = load_loop_policy("tests.test_fused_spec:ScheduledPolicy")
+    # pytest may import this file under a different module name, so the
+    # class object differs — pin by name + contract, not identity
+    assert type(loaded).__name__ == "ScheduledPolicy"         # module:attr
+    assert callable(loaded.pick_k)
+    with pytest.raises(ValueError, match="module:attr"):
+        load_loop_policy("nonsense")
+    with pytest.raises(ValueError, match="pick_k"):
+        load_loop_policy(object())
+
+
+def test_loop_policy_requires_fused(params):
+    with pytest.raises(ValueError, match="loop_policy requires"):
+        ServingEngine(params, CFG, _serving(
+            decode_loop_k=4, loop_policy=FixedLoopPolicy))
+    with pytest.raises(ValueError, match="loop_policy requires"):
+        ServingEngine(params, CFG, _serving(
+            spec_tokens=3, loop_policy=FixedLoopPolicy))
+
+
+def test_deterministic_k_schedule_pinned(params):
+    """An adaptive policy's picks drive the TRACED fori_loop bound: the
+    dispatched window follows the schedule exactly (fused_k_hist is the
+    pin), every flush shares one executable, and the stream stays
+    token-equal to the static-k run — the policy moves perf, never
+    tokens."""
+    prompts = [_prompt(60, 5), _prompt(61, 6)]
+    budgets = [20, 20]
+    base, _ = _run(
+        params, _serving(decode_loop_k=4, spec_tokens=3, max_new_tokens=24),
+        prompts, budgets=budgets)
+    pol = ScheduledPolicy()
+    got, stats = _run(
+        params, _serving(decode_loop_k=4, spec_tokens=3, max_new_tokens=24,
+                         loop_policy=pol),
+        prompts, budgets=budgets)
+    assert got == base
+    assert stats["loop_policy"] == "ScheduledPolicy"
+    assert pol.calls == stats["fused_flushes"] > 1
+    expect = [0] * 5
+    for i in range(pol.calls):
+        expect[ScheduledPolicy.SCHEDULE[i % 4]] += 1
+    assert stats["fused_k_hist"] == expect
+    # the policy saw real pressure snapshots with the acceptance signal
+    assert all(isinstance(s, EngineSignals) for s in pol.seen)
+    assert all(s.spec_mean_accepted is not None for s in pol.seen)
+
+
+def test_raising_policy_degrades_to_static_k(params):
+    class Boom(LoopPolicy):
+        def pick_k(self, k_max, signals=None):
+            raise RuntimeError("policy unavailable")
+
+    prompts = [_prompt(62, 5)]
+    base, _ = _run(
+        params, _serving(decode_loop_k=4, spec_tokens=3), prompts)
+    got, stats = _run(
+        params, _serving(decode_loop_k=4, spec_tokens=3, loop_policy=Boom),
+        prompts)
+    assert got == base
+    assert stats["fused_k_hist"][4] == stats["fused_flushes"] > 0
+
+
+# ----------------------------------------------------- cooloff in the loop
+
+
+def test_cooloff_disengages_speculation_inside_loop(params):
+    """spec_min_mean set above any achievable acceptance: the first fused
+    flush sinks the EMA below the bar, the next flushes dispatch the
+    PLAIN k-tick executable (decode_ticks grows, fused_flushes doesn't),
+    the re-probe fires after spec_cooloff_ticks flushes — and the stream
+    never moves (both executables are token-equal by contract)."""
+    prompts = [_prompt(70, 5), _prompt(71, 6)]
+    budgets = [40, 40]
+    base, _ = _run(params, ServingConfig(
+        slots=2, prefill_buckets=(16,), max_new_tokens=48), prompts,
+        budgets=budgets, cfg=CFG_LONG)
+    got, stats = _run(params, ServingConfig(
+        slots=2, prefill_buckets=(16,), max_new_tokens=48,
+        decode_loop_k=4, spec_tokens=3, spec_min_mean=20.0,
+        spec_cooloff_ticks=2), prompts, budgets=budgets, cfg=CFG_LONG)
+    assert got == base
+    assert stats["fused_flushes"] >= 1
+    assert stats["decode_ticks"] > 0           # plain fallback flushes ran
+    assert stats["loop_flushes"] > stats["fused_flushes"]
+    assert stats["spec_ticks"] > 0
+
+
+# --------------------------------------------- device draft vs host draft
+
+
+def test_ngram_draft_matches_host_lookup():
+    """The device proposal agrees with lookup_draft's continuation
+    semantics on matchable histories: most recent occurrence of the
+    longest suffix n-gram wins, continuation padded with zeros. (Token
+    equality never depends on this — it is the acceptance-rate contract.)"""
+    cases = [
+        [5, 6, 7, 5, 6, 7, 5, 6],        # periodic: deep ngram match
+        [1, 2, 3, 4, 1, 2],              # bigram match mid-history
+        [9, 9, 9, 9],                    # unigram self-match
+        [1, 2, 3, 4, 5, 6],              # no repeat at all
+    ]
+    k, ngram, w = 3, 3, 16
+    hist = np.zeros((len(cases), w), np.int32)
+    hlen = np.zeros((len(cases),), np.int32)
+    for i, h in enumerate(cases):
+        hist[i, w - len(h):] = h
+        hlen[i] = len(h)
+    got = jax.device_get(
+        ngram_draft(jnp.asarray(hist), jnp.asarray(hlen), k, ngram))
+    for i, h in enumerate(cases):
+        want = lookup_draft(h, k, ngram) or [0] * k
+        assert got[i].tolist() == want, f"case {i}: {h}"
+
+
+def test_ngram_draft_ignores_stale_window_prefix():
+    """Tokens left of hist_len are garbage from an earlier occupant: a
+    match that would need them must not fire."""
+    w = 8
+    hist = np.asarray([[7, 7, 7, 7, 7, 1, 2, 3]], np.int32)
+    got = jax.device_get(ngram_draft(
+        jnp.asarray(hist), jnp.asarray([3]), 2, 3))  # only [1, 2, 3] real
+    assert got[0].tolist() == [0, 0]
+
+
+# ------------------------------------------- observability + silent-ignore
+
+
+def test_spec_disabled_reason_surfaces(params):
+    """ISSUE 19 satellite: requested-but-dropped speculation names its
+    reason in stats() and records a one-time trace event — the silent
+    drop is diagnosable from a scrape."""
+    eng = ServingEngine(params, CFG, _serving(spec_tokens=3),
+                        sample=lambda logits: int(jnp.argmax(logits)))
+    try:
+        st = eng.stats()
+        assert st["spec_disabled_reason"] is not None
+        assert "sample" in st["spec_disabled_reason"]
+        evs = [e for e in eng.trace.snapshot() if e[2] == "spec_disabled"]
+        assert len(evs) == 1 and evs[0][5] == 3  # val = requested K
+    finally:
+        eng.stop()
+    eng2 = ServingEngine(params, CFG, _serving(
+        spec_tokens=3, temperature=0.7))
+    try:
+        assert "temperature" in eng2.stats()["spec_disabled_reason"]
+    finally:
+        eng2.stop()
+    eng3 = ServingEngine(params, CFG, _serving(spec_tokens=3))
+    try:
+        assert eng3.stats()["spec_disabled_reason"] is None
+        assert not [e for e in eng3.trace.snapshot()
+                    if e[2] == "spec_disabled"]
+    finally:
+        eng3.stop()
+
+
+def test_spec_mean_accepted_populates_engine_signals(params):
+    """ISSUE 19 satellite (the duty-supplier test's shape): the
+    acceptance EMA rides EngineSignals for every policy family — present
+    on a spec engine, None without speculation, and delivered to a
+    signals-aware shed policy at the overload seam."""
+    from vtpu.serving.shed import ShedPolicy
+
+    seen = []
+
+    class AcceptAware(ShedPolicy):
+        def select(self, waiters, need, signals=None):
+            seen.append(signals)
+            return sorted(waiters, key=lambda r: r.priority)[:need]
+
+    eng = ServingEngine(params, CFG, _serving(
+        slots=1, spec_tokens=3, shed_queue_depth=1,
+        shed_policy=AcceptAware))
+    try:
+        sig = eng.signals()
+        # pre-serving: the EMA sits at the probe value, already a float
+        assert sig.spec_mean_accepted is not None
+        assert sig.spec_mean_accepted == pytest.approx(
+            eng._spec_ema, abs=1e-3)
+        live = eng.submit(_prompt(96, 5), max_new_tokens=8)
+        eng._tick_head()
+        assert eng._slot_req[0] is live
+        eng.submit(_prompt(97, 5), max_new_tokens=2, priority=5)
+        eng.submit(_prompt(98, 5), max_new_tokens=2, priority=0)
+        eng._tick_head()  # line overflows depth 1: the policy sees signals
+        assert seen and seen[0].spec_mean_accepted is not None
+    finally:
+        eng.stop()
+    eng2 = ServingEngine(params, CFG, _serving())
+    try:
+        assert eng2.signals().spec_mean_accepted is None
+    finally:
+        eng2.stop()
+    # drift-tolerant wire round trip (the fabric ships signals as dicts)
+    sig = EngineSignals(spec_mean_accepted=1.75)
+    assert EngineSignals.from_dict(sig.to_dict()).spec_mean_accepted == 1.75
+
+
+def test_fused_stats_are_exported():
+    """Every new stats() key maps to a vtpu_serving_* family (or a named
+    allowlist entry) — pinned by name so they can't be quietly dropped."""
+    from vtpu.obs.export import ALLOWLIST, COUNTERS, GAUGES, HIST_COUNTERS
+
+    assert "fused_flushes" in COUNTERS
+    assert "fused_spec" in GAUGES
+    assert "fused_k_hist" in HIST_COUNTERS
+    assert "spec_disabled_reason" in ALLOWLIST
+    assert "loop_policy" in ALLOWLIST

@@ -321,6 +321,148 @@ def multi_tick_decode(
     return out, counts, tok, (lps if logprobs else None), state, keys
 
 
+def ngram_draft(hist: jax.Array, hist_len: jax.Array, k: int,
+                max_ngram: int) -> jax.Array:
+    """Device-side n-gram draft proposal over a right-aligned token window.
+
+    ``hist`` is [B, W] int32 with each slot's most recent tokens packed at
+    the RIGHT edge (``hist[:, W-1]`` is the pending token the next tick
+    conditions on) and ``hist_len`` [B] counts how many trailing entries
+    are real. For each slot, find the most recent earlier occurrence of
+    the longest matching suffix n-gram (n = max_ngram down to 1 — mirror
+    of the host-side ``lookup_draft``, including its preference for a
+    match with a FULL k-token continuation over a more recent one whose
+    continuation runs off the window edge: on a periodic stream the most
+    recent match always abuts the suffix and would propose one real token
+    plus zeros, capping acceptance at 2/tick) and propose the ``k`` tokens
+    that followed it; slots with no match propose zeros (exactly the host
+    helper's zero padding — under greedy verification draft CONTENTS only
+    move the acceptance rate, never the emitted stream, so the fallback is
+    a perf choice, not a correctness one).
+
+    Everything is fixed-shape masked arithmetic over [B, W] — no host, no
+    dynamic shapes — so it can live inside a compiled fori_loop body. The
+    n-loop is a Python loop over ``max_ngram`` (static, small): longer
+    n-grams overwrite shorter ones so the longest match wins, and within
+    one n the most recent candidate start wins via a masked max.
+    """
+    b, w = hist.shape
+    draft = jnp.zeros((b, k), jnp.int32)
+    for n in range(1, max_ngram + 1):
+        m = w - n  # candidate starts 0..m-1 (the suffix itself excluded)
+        if m < 1:
+            break
+        tail = hist[:, w - n:]
+        eq = jnp.ones((b, m), bool)
+        for j in range(n):
+            eq = eq & (hist[:, j:m + j] == tail[:, j:j + 1])
+        starts = jnp.arange(m)[None, :]
+        # a candidate window is only real if it sits inside the slot's
+        # valid tail, and matching the suffix needs >= n+1 real tokens
+        first_real = (w - jnp.minimum(hist_len, w))[:, None]
+        ok = eq & (starts >= first_real) & (hist_len >= n + 1)[:, None]
+        # two-tier pick within this n: the most recent start whose k-token
+        # continuation fits inside the window wins; only when no start
+        # does, fall back to the most recent partial (zero-padded) match
+        full = ok & (starts + n + k <= w)
+        wfull = jnp.max(jnp.where(full, starts, -1), axis=1)
+        wany = jnp.max(jnp.where(ok, starts, -1), axis=1)
+        wstar = jnp.where(wfull >= 0, wfull, wany)
+        has = wstar >= 0
+        idx = wstar[:, None] + n + jnp.arange(k)[None, :]
+        cont = jnp.where(
+            idx < w,
+            jnp.take_along_axis(hist, jnp.clip(idx, 0, w - 1), axis=1), 0)
+        draft = jnp.where(has[:, None], cont, draft)
+    return draft
+
+
+def multi_tick_spec_decode(
+    spec_fn,
+    k: int,
+    spec_tokens: int,
+    ngram: int,
+    eos_token: int,
+    state,
+    tokens: jax.Array,
+    active: jax.Array,
+    cap: jax.Array,
+    hist: jax.Array,
+    hist_len: jax.Array,
+    k_dyn: jax.Array,
+):
+    """Fused device-side speculation: draft + verify as the body of the
+    multi-tick loop, so the host tick tax is paid once per flush while
+    each inner tick emits UP TO ``spec_tokens + 1`` tokens instead of one.
+
+    Each inner tick (i) materializes a draft on device — the pending token
+    plus an ``ngram_draft`` continuation proposed from the slot's recent
+    token window carried IN the loop state — then (ii) runs one greedy
+    verify chunk through ``spec_fn(state, draft [B, T], active, budget) ->
+    (pred [B, T], count [B], state)`` (the ``batched_spec_step`` trunk:
+    T = spec_tokens + 1 positions through ``spec_verify_loop``, accepted
+    prefix + bonus counted against the remaining budget, per-slot KV
+    scatter with the paged ``t//page``/``t%page`` arithmetic, rejected
+    tails and inactive lanes masked off every mapped block). Accepted
+    tokens shift into the history window device-side (frozen lanes have
+    count 0, so their window is untouched), the last accepted token
+    becomes the next tick's pending feed, and a lane freezes — the
+    existing early-exit discipline — when its budget hits zero or an
+    ACCEPTED position equals ``eos_token``.
+
+    Token-equality is by construction: greedy verification emits the
+    model's own argmax at every accepted position and the bonus token is
+    the argmax continuation, so the stream equals plain greedy decode for
+    ANY draft contents — draft quality moves only the acceptance rate.
+
+    ``k_dyn`` (scalar int32, clamped to [0, k]) is the flush window this
+    dispatch actually runs: a TRACED fori_loop bound lowers to while_loop,
+    so one compiled executable serves every LoopPolicy-chosen k without a
+    per-k recompile. The output buffer stays shaped by the static maximum
+    ``k``; un-run inner ticks hold LOOP_PAD_TOKEN / zero counts.
+
+    Returns ``(out [B, k, spec_tokens+1] int32, counts [B, k] int32,
+    carry [B] int32, state)``: ``out[b, i, :counts[b, i]]`` are the tokens
+    slot b emitted at inner tick i (the host's ONE padded fetch per
+    flush), ``carry`` the device-resident pending feed for the next flush.
+    """
+    b = tokens.shape[0]
+    t = spec_tokens + 1
+    w = hist.shape[1]
+    out0 = jnp.full((b, k, t), LOOP_PAD_TOKEN, jnp.int32)
+    cnt0 = jnp.zeros((b, k), jnp.int32)
+    bud0 = jnp.where(active, jnp.maximum(cap, 0), 0)
+
+    def body(i, carry):
+        state, tok, act, bud, hist, hlen, out, cnts = carry
+        cont = ngram_draft(hist, hlen, spec_tokens, ngram)
+        draft = jnp.concatenate([tok[:, None], cont], axis=1)
+        pred, count, state = spec_fn(state, draft, act, bud)
+        accepted = jnp.arange(t)[None, :] < count[:, None]
+        out = out.at[:, i].set(jnp.where(accepted, pred, LOOP_PAD_TOKEN))
+        cnts = cnts.at[:, i].set(count)
+        bud = bud - count
+        # eos freezes the lane AFTER the tick that accepted it (the host
+        # truncates the delivered tail at the eos, spec-path convention)
+        hit = jnp.any(accepted & (pred == eos_token), axis=1)
+        # shift the accepted run into the right-aligned window: count is 0
+        # on frozen lanes, so their window (and feed) is a no-op shift
+        cat = jnp.concatenate([hist, pred], axis=1)
+        hist = jnp.take_along_axis(
+            cat, count[:, None] + jnp.arange(w)[None, :], axis=1)
+        hlen = jnp.minimum(hlen + count, w)
+        last = jnp.take_along_axis(
+            pred, jnp.clip(count - 1, 0, t - 1)[:, None], axis=1)[:, 0]
+        tok = jnp.where(act & (count > 0), last, tok)
+        act = act & (bud > 0) & ~hit
+        return (state, tok, act, bud, hist, hlen, out, cnts)
+
+    state, tok, _, _, _, _, out, counts = jax.lax.fori_loop(
+        0, jnp.clip(k_dyn, 0, k), body,
+        (state, tokens, active, bud0, hist, hist_len, out0, cnt0))
+    return out, counts, tok, state
+
+
 def _qkv(cfg, lp, x, cos, sin, positions):
     """Project to rotated q/k/v heads: [B, S, H, Dh] each."""
     b, s, _ = x.shape

@@ -54,6 +54,9 @@ COUNTERS = {
     "loop_early_exits": ("loop_early_exits",
                          "Slots frozen inside a device-loop flush "
                          "(budget wall or eos before tick k)"),
+    "fused_flushes": ("fused_spec_flushes",
+                      "Device-loop flushes that ran the fused "
+                      "draft+verify speculation body"),
     "pool_blocked_admissions": ("pool_blocked_admissions",
                                 "Admissions deferred by pool exhaustion"),
     "prefix_install_copies": ("prefix_install_copies",
@@ -179,6 +182,9 @@ GAUGES = {
     "spec_ema": ("spec_ema", "Adaptive-speculation acceptance EMA", 1),
     "spec_cooling_off": ("spec_cooling_off",
                          "1 while adaptive speculation is paused", 1),
+    "fused_spec": ("fused_spec",
+                   "1 when draft+verify run fused inside the device loop",
+                   1),
     "device_sampling": ("device_sampling", "1 when sampling runs on device", 1),
     "pipelined": ("pipelined", "1 when the decode loop is pipelined", 1),
     "batched_admission": ("batched_admission",
@@ -225,6 +231,9 @@ HIST_COUNTERS = {
     "spec_emitted_hist": ("spec_emitted_per_slot_tick",
                           "Spec slot-ticks by delivered-token count",
                           "emitted"),
+    "fused_k_hist": ("fused_spec_flush_depth",
+                     "Fused-speculation flushes by the LoopPolicy-picked "
+                     "window k", "k"),
     "prefill_batch_hist": ("prefill_dispatches",
                            "Bucketed prefill dispatches by batch size",
                            "batch_size"),
@@ -246,8 +255,12 @@ SPECIAL = {
     "tick_phase_ms",           # -> vtpu_serving_tick_phase_seconds{phase=...}
 }
 # Escape hatch for the coverage check: stats() keys that are DELIBERATELY
-# not exported go here, with a reason. Empty today — every key maps.
-ALLOWLIST: set = set()
+# not exported go here, with a reason.
+ALLOWLIST: set = {
+    "spec_disabled_reason",  # free-form string: diagnosable from stats()/
+                             # trace ("spec_disabled" event), not a metric
+    "loop_policy",           # policy class name (string) — config echo
+}
 
 # ------------------------------------------------------------------- fleet
 # EngineFleet.stats() keys -> vtpu_serving_fleet_* families, labelled by

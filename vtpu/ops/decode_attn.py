@@ -86,6 +86,20 @@ _NEG_INF = -1e30
 PAGED_ATTN_MIN_WINDOW = 1024       # bf16, T=1
 PAGED_ATTN_MIN_WINDOW_INT8 = 2048  # int8, T=1 (1024 measured 0.65-0.90x)
 
+# Per-T auto-routing floors: (t, quant) -> the smallest window (tokens) at
+# which the kernel engages for that chunk depth. A MISSING row means "never
+# on auto" — the measured T=4 verify cells all lost to XLA's gather, so no
+# T>1 row ships by default and the fused-speculation verify chunks (T=K+1)
+# ride gather off-chip exactly as before. The table exists so on-chip
+# sweeps of the IN-TRUNK kernel (`paged_kv_bench --attn-kernel
+# --spec-chunk T`) can add/tighten rows per measured cell without touching
+# the resolver; the T=1 rows alias the constants above so the historical
+# knobs keep working.
+PAGED_ATTN_T_FLOORS: dict = {
+    (1, False): PAGED_ATTN_MIN_WINDOW,
+    (1, True): PAGED_ATTN_MIN_WINDOW_INT8,
+}
+
 # ServingConfig.paged_attn / adapter ``paged_attn=`` override values.
 PAGED_ATTN_ROUTES = ("kernel", "gather")
 
@@ -100,9 +114,11 @@ def paged_attn_route(override: Optional[str], window: int,
     the measured auto route above, keyed on the full shape the study
     measured: ``window`` (the read window in tokens — the engine's
     kv_bucket, or max_seq unbounded), ``t`` (queries per dispatch: 1 for a
-    decode tick, K+1 for a spec verify chunk — every measured T>1 cell lost,
-    so auto routes them to gather), and ``quant`` (int8 KV pools carry a
-    higher floor). The resolution is a STATIC per-shape property — the
+    decode tick, K+1 for a spec verify chunk) and ``quant`` (int8 KV pools
+    carry a higher floor) through the PAGED_ATTN_T_FLOORS table — a chunk
+    shape with no table row never routes kernel on auto (every measured
+    T>1 cell lost; on-chip sweeps may add rows back per measured cell).
+    The resolution is a STATIC per-shape property — the
     engine counts it per dispatched tick
     (stats()['paged_attn_kernel_ticks'/'paged_attn_gather_ticks']) and the
     trunk resolves it at trace time, so the two can never disagree."""
@@ -114,10 +130,8 @@ def paged_attn_route(override: Optional[str], window: int,
         return override
     if (backend or jax.default_backend()) != "tpu":
         return "gather"
-    if t > 1:
-        return "gather"
-    floor = PAGED_ATTN_MIN_WINDOW_INT8 if quant else PAGED_ATTN_MIN_WINDOW
-    return "kernel" if window >= floor else "gather"
+    floor = PAGED_ATTN_T_FLOORS.get((t, bool(quant)))
+    return "kernel" if floor is not None and window >= floor else "gather"
 
 
 # --------------------------------------------------------------------------

@@ -106,6 +106,44 @@ def multi_tick_decode_step(model: Any, temperature: float, top_k: int,
     return step
 
 
+def fused_spec_decode_step(model: Any, k: int, spec_tokens: int,
+                           eos_token: int, ngram: int):
+    """Compose a slot model's spec_step (the batched_spec_step verify
+    trunk) with the device-side n-gram draft into a k-tick fused
+    speculation loop (models.transformer.multi_tick_spec_decode) — ONE
+    jit-able flush:
+
+        (params, state, tokens[B], active[B], cap[B], hist[B, W],
+         hist_len[B], k_dyn, kv_bucket, unroll)
+            -> (out[B, k, spec_tokens+1] int32, counts[B, k] int32,
+                carry[B] int32, state)
+
+    The inner body is the UNCHANGED per-family spec_step (draft through
+    the spec_verify_loop trunk — dense, paged, int8, MoE all route through
+    it), so a fused flush is token-equal to k host-driven verify ticks by
+    construction, and greedy verification makes both token-equal to plain
+    greedy decode. ``hist``/``hist_len`` carry each slot's recent token
+    window (right-aligned) for the on-device draft; ``cap`` is the
+    per-slot remaining budget (variable per-slot advance truncates against
+    it exactly); ``k_dyn`` is the LoopPolicy-chosen flush window for THIS
+    dispatch — traced, so every k <= the static maximum shares one
+    executable. Speculation requires greedy sampling, so there are no keys
+    and no logprobs on this path."""
+    from vtpu.models.transformer import multi_tick_spec_decode
+
+    def step(params, state, tokens, active, cap, hist, hist_len, k_dyn,
+             kv_bucket, unroll=False):
+        def spec(st, draft, act, bud):
+            return model.spec_step(params, st, draft, act, bud, kv_bucket,
+                                   unroll=unroll)
+
+        return multi_tick_spec_decode(
+            spec, k, spec_tokens, ngram, eos_token, state, tokens, active,
+            cap, hist, hist_len, k_dyn)
+
+    return step
+
+
 def batched_admission_step(model: Any, temperature: float, top_k: int,
                            top_p: float):
     """Compose a slot model's batched prefill (prefill_into_slots) with the

@@ -36,7 +36,8 @@ from vtpu.obs.tickprof import TickProfiler
 from vtpu.obs.trace import RequestTrace, TERMINAL_CODES, pct
 from vtpu.ops.decode_attn import paged_attn_route
 from vtpu.serving.faults import EngineDeath, FaultInjected, FaultPlan
-from vtpu.serving.shed import EngineSignals, accepts_signals, load_shed_policy
+from vtpu.serving.shed import (EngineSignals, accepts_signals,
+                               load_loop_policy, load_shed_policy)
 
 from vtpu.models.transformer import (
     ModelConfig,
@@ -75,9 +76,14 @@ class ServingConfig:
     # spec_ngram tokens — no draft model, pays off on repetitive/structured
     # text); the model verifies K+1 positions in ONE bandwidth-bound tick
     # (batched_spec_step), emitting 1..K+1 tokens. Greedy sampling only: the
-    # engine silently ignores spec_tokens when a custom sampler or a model
-    # without spec_step is configured. A tick where no slot found any match
-    # falls back to the plain decode step (same bytes, fewer FLOPs).
+    # engine DROPS spec_tokens when a custom sampler, logprobs, temperature,
+    # or a model without spec_step is configured — and says why, as the
+    # stats()["spec_disabled_reason"] gauge plus a one-time "spec_disabled"
+    # trace event (a misconfigured engine is diagnosable from a scrape, not
+    # just mysteriously slow). A tick where no slot found any match falls
+    # back to the plain decode step (same bytes, fewer FLOPs). Combined
+    # with decode_loop_k, draft+verify FUSE into the device-resident loop
+    # (see decode_loop_k below).
     spec_tokens: int = 0
     spec_ngram: int = 3
     # Adaptive speculation: a verify tick costs ~1.06-1.35x a decode tick
@@ -247,11 +253,24 @@ class ServingConfig:
     # where the Python tick tax (tick_phase_ms), not FLOPs, caps tokens/sec
     # at high slot counts. None (default) and 1 are bit-identical to the
     # classic one-tick loop. Requires device sampling (a custom sample=
-    # callable needs host logits every tick) and no active speculation (the
-    # verify step builds drafts from host history every tick) — an
-    # unsatisfiable k > 1 raises at construction, like pipeline_decode.
-    # Composes with paged pools, int8 KV, tp meshes, and disagg.
+    # callable needs host logits every tick) — an unsatisfiable k > 1
+    # raises at construction, like pipeline_decode. Composes with paged
+    # pools, int8 KV, tp meshes, and disagg. Combined with spec_tokens > 0
+    # the loop FUSES speculation: each inner tick drafts on device (an
+    # n-gram proposal from the slot's recent-token window carried in the
+    # loop state) and verifies through batched_spec_step, so one flush
+    # emits up to k*(spec_tokens+1) tokens against ONE host fetch; the
+    # fused stream stays token-equal to both the unfused spec path and
+    # plain greedy decode (greedy verification is deterministic).
     decode_loop_k: Optional[int] = None
+    # HOW DEEP each fused flush runs: None = the static decode_loop_k
+    # every flush (FixedLoopPolicy — bit-identical to the classic loop);
+    # otherwise a LoopPolicy (vtpu/serving/shed) picked per flush from the
+    # EngineSignals pressure snapshot — small k under latency SLOs or low
+    # speculation acceptance, large k under saturation. Loads like
+    # shed_policy: "module:attr" string, class, or instance. Requires
+    # decode_loop_k (the static k is the ceiling the policy picks within).
+    loop_policy: Optional[Any] = None
     # --- failure domains (deadlines, shedding, containment, faults) ------
     # Overload shedding: bound the waiting line at this depth. 0 = off
     # (unbounded queueing, the pre-PR-12 behavior). When the line
@@ -1044,9 +1063,15 @@ def pad_to_chunks(tokens: jax.Array, n: int, c: int) -> jax.Array:
 
 def lookup_draft(history: list, k: int, max_ngram: int) -> Optional[list]:
     """Prompt-lookup drafting: continue the most recent earlier occurrence
-    of the longest tail n-gram (<= max_ngram) found in the history. Returns
-    k tokens (zero-padded past the match) or None when nothing matches —
-    the caller's tick then has nothing to verify for this slot.
+    of the longest tail n-gram (<= max_ngram) found in the history. Within
+    one n, a match with a FULL k-token continuation beats a more recent
+    match whose continuation runs off the end of the history — on a
+    periodic stream the most recent occurrence always sits flush against
+    the suffix, and continuing it yields one real token plus zero padding,
+    silently capping acceptance at 2/tick no matter how deep K is. Returns
+    k tokens (zero-padded when only a partial match exists anywhere) or
+    None when nothing matches — the caller's tick then has nothing to
+    verify for this slot.
 
     Host-side linear scan per tick: fine at serving context lengths (the
     scan is over python ints while the device runs the previous tick); a
@@ -1055,11 +1080,16 @@ def lookup_draft(history: list, k: int, max_ngram: int) -> Optional[list]:
     """
     for n in range(min(max_ngram, len(history) - 1), 0, -1):
         tail = history[-n:]
+        partial = None
         for i in range(len(history) - n - 1, -1, -1):
             if history[i:i + n] == tail:
                 cont = history[i + n:i + n + k]
-                if cont:
-                    return cont + [0] * (k - len(cont))
+                if len(cont) == k:
+                    return cont
+                if cont and partial is None:
+                    partial = cont + [0] * (k - len(cont))
+        if partial is not None:
+            return partial
     return None
 
 
@@ -1198,6 +1228,24 @@ class ServingEngine:
             and not serving.logprobs and hasattr(model, "spec_step")
             else 0
         )
+        # requested but dropped: say WHY (stats gauge + one-time trace
+        # event below) — before this gauge the drop was silent and a
+        # misconfigured engine was just mysteriously slow
+        self._spec_disabled_reason: Optional[str] = None
+        if serving.spec_tokens and not self._spec_tokens:
+            if sample is not None:
+                self._spec_disabled_reason = (
+                    "custom sample= callable (verification is greedy-only)")
+            elif serving.temperature > 0.0:
+                self._spec_disabled_reason = (
+                    f"temperature={serving.temperature} "
+                    "(verification is greedy-only)")
+            elif serving.logprobs:
+                self._spec_disabled_reason = (
+                    "logprobs streaming (verify ticks return ids only)")
+            else:
+                self._spec_disabled_reason = (
+                    f"model adapter {type(model).__name__} has no spec_step")
         self.sample = sample or (lambda logits: int(jnp.argmax(logits)))
         b = serving.slots
         # paged KV pool: page size comes from the MODEL adapter (the single
@@ -1293,8 +1341,12 @@ class ServingEngine:
         # Validated HERE, next to the paged_attn/pipeline contradiction
         # checks: every rejection names the interaction precisely. k is
         # compatible with paged pools, int8 KV, tp meshes and disagg (the
-        # loop body is the unchanged shared trunk); it is rejected for the
-        # two features that structurally need host logits every tick.
+        # loop body is the unchanged shared trunk); it is rejected only
+        # for the one feature that structurally needs host logits every
+        # tick. Active speculation FUSES instead: the draft moves on
+        # device (the slot's recent-token window rides the loop state), so
+        # the old "verify needs host history every tick" objection no
+        # longer holds — draft+verify run as the fori_loop body.
         loop_k = serving.decode_loop_k
         if loop_k is not None and loop_k < 1:
             raise ValueError(
@@ -1307,13 +1359,6 @@ class ServingEngine:
                     "tick, which is exactly the per-token host round trip "
                     "the device loop removes — drop sample= or set "
                     "decode_loop_k=None")
-            if self._spec_tokens:
-                raise ValueError(
-                    f"decode_loop_k={loop_k} is incompatible with active "
-                    f"speculation (spec_tokens={serving.spec_tokens}): the "
-                    "verify step builds its draft from host-side token "
-                    "history every tick — disable spec_tokens or the "
-                    "device loop")
         # k = 1 resolves to the classic loop (bit-identical to None by
         # construction, pinned in tests); stats() still reports the
         # resolved decode_loop_k so dashboards see what was asked for
@@ -1331,15 +1376,59 @@ class ServingEngine:
             )
         else:
             self._decode_loop = None
+        # --- fused device-side speculation (loop_k x spec_tokens) --------
+        # Both knobs set: each inner tick of the device loop drafts from
+        # the slot's recent-token window (carried in the loop state) and
+        # verifies through batched_spec_step — ONE [B, k, K+1] fetch per
+        # flush, up to k*(K+1) tokens against it. The cooloff fallback
+        # (acceptance EMA below spec_min_mean) runs the PLAIN _decode_loop
+        # executable, so speculation disengages without leaving the fused
+        # loop's flush discipline.
+        self._fused_spec = bool(self._loop_k and self._spec_tokens)
+        if serving.loop_policy is not None and not self._fused_spec:
+            raise ValueError(
+                "loop_policy requires the fused device loop "
+                "(decode_loop_k > 1 AND active spec_tokens): the policy "
+                "sizes the fused flush window — got "
+                f"decode_loop_k={serving.decode_loop_k}, "
+                f"spec_tokens={serving.spec_tokens}"
+                + (f" (speculation disabled: {self._spec_disabled_reason})"
+                   if self._spec_disabled_reason else ""))
+        # resolved HERE like shed_policy: a bad "module:attr" string or a
+        # policy without pick_k fails the constructor, never the loop
+        self._loop_policy = (
+            load_loop_policy(serving.loop_policy)
+            if serving.loop_policy is not None else None)
+        if self._fused_spec:
+            from vtpu.serving.adapters import fused_spec_decode_step
+
+            # draft window: enough history for the deepest n-gram match
+            # plus the continuation it proposes; a fixed small width keeps
+            # the loop-state carry a few hundred bytes per slot
+            self._hist_window = max(
+                32, serving.spec_ngram * 2 + serving.spec_tokens + 2)
+            self._decode_fused = jax.jit(
+                fused_spec_decode_step(
+                    model, self._loop_k, self._spec_tokens,
+                    serving.eos_token, serving.spec_ngram),
+                static_argnames=("kv_bucket", "unroll"),
+                donate_argnums=(1,),  # state (greedy: no keys, no logprobs)
+            )
+        else:
+            self._hist_window = 0
+            self._decode_fused = None
         # monotonic_ns stamp of the last flush delivery: the floor of the
         # next flush's interpolated per-token timestamps, so a pipelined
         # flush (dispatched before the previous delivery) can never
         # synthesize token events earlier than tokens already delivered
         self._last_flush_ns = 0
+        # the single-tick verify executable serves the HOST-drafted sync
+        # path only; a fused engine never dispatches it (its verify trunk
+        # lives inside _decode_fused), so don't build or warm it there
         self._spec = jax.jit(
             model.spec_step, static_argnames=("kv_bucket", "unroll"),
             donate_argnums=(1,),
-        ) if self._spec_tokens else None
+        ) if self._spec_tokens and not self._fused_spec else None
         self._prefill = jax.jit(model.prefill_into_slot, donate_argnums=(1,))
         # batched async admission: device sampling supplies the fused first-
         # token sampler, and speculation needs the first token ON THE HOST
@@ -1664,6 +1753,13 @@ class ServingEngine:
                        # honest); loop_early_exits counts slots that froze
                        # inside a flush (budget wall or eos) before tick k
                        "loop_flushes": 0, "loop_early_exits": 0,
+                       # fused-speculation flushes (subset of loop_flushes
+                       # when the draft+verify body dispatched instead of
+                       # the plain loop — cooloff fallbacks are the
+                       # difference) and the per-flush k the LoopPolicy
+                       # actually picked, as a histogram index k
+                       "fused_flushes": 0,
+                       "fused_k_hist": [0] * ((self._loop_k or 0) + 1),
                        # KV-memory data plane. kv_bucket_hist: read-window
                        # bucket -> dispatched ticks — on the DENSE path
                        # this is the global longest-live-sequence read tax
@@ -1761,6 +1857,10 @@ class ServingEngine:
         # dispatch, fetch, deliver, swap drain). Host-only by
         # construction: nothing here can add a device sync.
         self.trace = RequestTrace(capacity=serving.trace_events)
+        if self._spec_disabled_reason is not None:
+            # one-time event (val = the requested draft length): the trace
+            # dump shows WHY the configured speculation never ran
+            self.trace.record("spec_disabled", -1, -1, serving.spec_tokens)
         self._prof = TickProfiler()
         self._req_ctr = itertools.count()
         # registered prompt prefixes: id -> {tokens, buffers, len, pad,
@@ -2282,7 +2382,7 @@ class ServingEngine:
             if hasattr(self.model, "paged_attn"):
                 self.model.paged_attn = "gather"
             for fn in (self._decode_loop, self._decode_sampled,
-                       self._decode, self._spec):
+                       self._decode, self._spec, self._decode_fused):
                 if fn is not None:
                     try:
                         fn.clear_cache()
@@ -2320,7 +2420,7 @@ class ServingEngine:
             if hasattr(self.model, "paged_attn"):
                 self.model.paged_attn = self._paged_attn_orig
             for fn in (self._decode_loop, self._decode_sampled,
-                       self._decode, self._spec):
+                       self._decode, self._spec, self._decode_fused):
                 if fn is not None:
                     try:
                         fn.clear_cache()
@@ -3811,6 +3911,10 @@ class ServingEngine:
             pool_blocks=(self._n_blocks - 1) if self._paged else None,
             draining=self._draining,
             duty=duty,
+            # the cooloff EMA, policy-visible: LoopPolicy sizes the fused
+            # flush window on it, Route/ShedPolicy can score with it
+            spec_mean_accepted=(round(self._spec_ema, 3)
+                                if self._spec_tokens else None),
         )
 
     def stats(self) -> dict:
@@ -3828,6 +3932,13 @@ class ServingEngine:
         ) if s["spec_slot_ticks"] else None
         s["spec_ema"] = round(self._spec_ema, 3)
         s["spec_cooling_off"] = self._spec_cooloff > 0
+        # WHY configured speculation isn't running (None = not requested,
+        # or running fine) — the silent-drop diagnosable from a scrape
+        s["spec_disabled_reason"] = self._spec_disabled_reason
+        s["fused_spec"] = self._fused_spec
+        s["fused_k_hist"] = list(s["fused_k_hist"])
+        s["loop_policy"] = (type(self._loop_policy).__name__
+                            if self._loop_policy is not None else None)
         s["active_slots"] = sum(r is not None for r in self._slot_req)
         s["admitting_slots"] = len(self._admitting)
         s["queued"] = self._pending.qsize() + len(self._waiting)
@@ -4085,6 +4196,18 @@ class ServingEngine:
                     inactive, jnp.zeros((b,), jnp.int32), bucket,
                     unroll=self._unroll,
                 )
+            if self._fused_spec:
+                # the fused draft+verify flush; the traced k_dyn bound
+                # means this ONE executable serves every policy-picked
+                # k <= loop_k (the plain _decode_loop above stays warm
+                # too — it is the cooloff fallback dispatch)
+                _, _, _, self.state = self._decode_fused(
+                    self.params, self.state, tokens, inactive,
+                    jnp.zeros((b,), jnp.int32),
+                    jnp.zeros((b, self._hist_window), jnp.int32),
+                    jnp.zeros((b,), jnp.int32),
+                    jnp.int32(self._loop_k), bucket, unroll=self._unroll,
+                )
         if self._async_admission:
             # one executable per (batch size, bucket): the batched admission
             # step (prefill N rows + KV scatter + on-device first-token
@@ -4183,7 +4306,9 @@ class ServingEngine:
             self._warm_executables()
             if self._disagg is not None:
                 self._disagg.started.set()
-            if self._loop_k:
+            if self._fused_spec:
+                self._loop_fused()
+            elif self._loop_k:
                 self._loop_device()
             elif self._pipeline:
                 self._loop_pipelined()
@@ -4878,6 +5003,233 @@ class ServingEngine:
                 # stream keep going (the PR-1 identity-check discipline
                 # applied to failures instead of recycles)
                 self._contain_fault(slot)
+        self._last_flush_ns = now_ns
+        self._prof.note("deliver", time.perf_counter() - t0, ticks=k)
+        self._note_host_ms(extra_host_s + time.perf_counter() - t0)
+
+    def _loop_fused(self) -> None:
+        """Fused speculation flush loop: draft + verify run INSIDE the
+        device loop, so each flush is up to k spec ticks of up to K+1
+        tokens each against ONE [B, k, K+1] fetch. Synchronous by
+        construction — the device drafts from the recent-token window the
+        HOST re-uploads at each flush head (built from _history, which
+        needs the previous flush delivered), so dispatch and delivery
+        alternate like _loop_sync while the host tax still amortizes over
+        k*(K+1) tokens.
+
+        Per flush head: (1) lifecycle at the boundary (_tick_head,
+        unchanged); (2) the LoopPolicy picks this flush's window k from
+        the EngineSignals snapshot, clamped to [1, watchdog-capped
+        loop_k] — the traced fori_loop bound means every k shares one
+        executable, zero recompiles; (3) the cooloff hysteresis gates
+        HERE: while the acceptance EMA sits below spec_min_mean the flush
+        dispatches the PLAIN _decode_loop executable instead (speculation
+        disengages without leaving the flush discipline), re-probing
+        exactly like the sync spec path."""
+        b = self.serving.slots
+        kmax = self._loop_k
+        chunk = self._spec_tokens + 1
+        w = self._hist_window
+        while not self._stop.is_set():
+            admitted = self._tick_head()
+            firsts = self._pending_firsts
+            self._pending_firsts = []
+            active_slots = [
+                i for i in range(b) if self._slot_req[i] is not None]
+            if not active_slots:
+                if firsts:
+                    self._deliver_firsts(firsts)
+                else:
+                    self._idle_wait(admitted)
+                continue
+            t_disp = time.perf_counter()
+            tokens = jnp.asarray(self._tokens, jnp.int32)
+            active = jnp.asarray(
+                [self._slot_req[i] is not None for i in range(b)], bool)
+            # watchdog-capped ceiling, then the policy's pick within it
+            k_cap = min(self._loop_cap or 1, kmax)
+            k = k_cap
+            if self._loop_policy is not None:
+                try:
+                    k = int(self._loop_policy.pick_k(k_cap, self.signals()))
+                except Exception:
+                    log.exception(
+                        "loop_policy.pick_k raised; using k=%d", k_cap)
+                    k = k_cap
+                k = max(1, min(k, k_cap))
+            if not self._spec_allowed():
+                # cooloff: speculation is underwater — run this flush
+                # through the plain k-tick executable (token-equal by
+                # contract, same flush boundary), keep re-probing
+                pred = [min(self._slot_budget[i], k_cap)
+                        if i in active_slots else 0 for i in range(b)]
+                cap = jnp.asarray(pred, jnp.int32)
+                if self._use_kv_buckets:
+                    need = kmax + max(
+                        self._slot_len[i] for i in active_slots)
+                    kv_bucket = next(
+                        (bkt for bkt in self._kv_buckets if bkt >= need),
+                        self.model.max_context,
+                    )
+                else:
+                    kv_bucket = 0
+                self._note_kv_window(
+                    kv_bucket,
+                    [self._slot_len[i] for i in active_slots],
+                    ticks=kmax)
+                out_d, cnt_d, carry_d, lp_d, self.state, self._rng = \
+                    self._decode_loop(
+                        self.params, self.state, tokens, active,
+                        self._rng, cap, kv_bucket, unroll=self._unroll)
+                self._stats["decode_ticks"] += kmax
+                self._stats["loop_flushes"] += 1
+                disp_s = time.perf_counter() - t_disp
+                self._prof.note("dispatch", disp_s, ticks=kmax)
+                self._deliver_flush({
+                    "tokens": out_d, "counts": cnt_d, "carry": carry_d,
+                    "logprobs": lp_d, "pred": pred,
+                    "t_disp_ns": time.monotonic_ns(),
+                    "reqs": [self._slot_req[i] if i in active_slots else None
+                             for i in range(b)],
+                }, extra_host_s=disp_s, firsts=firsts)
+                continue
+            # the draft window: each live slot's recent tokens,
+            # right-aligned into [B, W] (the device shifts accepted runs
+            # in as the flush progresses — the host only seeds it)
+            hist = np.zeros((b, w), np.int32)
+            hlen = np.zeros((b,), np.int32)
+            for i in active_slots:
+                h = self._history[i][-w:]
+                if h:
+                    hist[i, w - len(h):] = h
+                    hlen[i] = len(h)
+            cap = jnp.asarray(
+                [max(self._slot_budget[i], 0) if i in active_slots else 0
+                 for i in range(b)], jnp.int32)
+            if self._use_kv_buckets:
+                # the read window must cover the deepest possible advance:
+                # k inner ticks of a full K+1-token chunk each
+                need = k * chunk + max(
+                    self._slot_len[i] for i in active_slots)
+                kv_bucket = next(
+                    (bkt for bkt in self._kv_buckets if bkt >= need),
+                    self.model.max_context,
+                )
+            else:
+                kv_bucket = 0
+            self._note_kv_window(
+                kv_bucket,
+                [self._slot_len[i] + k * chunk - 1 for i in active_slots],
+                t=chunk, ticks=k)
+            out_d, cnt_d, _carry_d, self.state = self._decode_fused(
+                self.params, self.state, tokens, active, cap,
+                jnp.asarray(hist), jnp.asarray(hlen), jnp.int32(k),
+                kv_bucket, unroll=self._unroll)
+            self._stats["spec_ticks"] += k
+            self._stats["loop_flushes"] += 1
+            self._stats["fused_flushes"] += 1
+            self._stats["fused_k_hist"][k] += 1
+            disp_s = time.perf_counter() - t_disp
+            self._prof.note("dispatch", disp_s, ticks=k)
+            self._deliver_fused_flush({
+                "tokens": out_d, "counts": cnt_d, "k": k,
+                "t_disp_ns": time.monotonic_ns(),
+                "reqs": [self._slot_req[i] if i in active_slots else None
+                         for i in range(b)],
+            }, extra_host_s=disp_s, firsts=firsts)
+
+    def _deliver_fused_flush(self, flush: dict, extra_host_s: float = 0.0,
+                             firsts: Optional[list] = None) -> None:
+        """Deliver one fused-speculation flush: ONE batched fetch for the
+        [B, k, K+1] token cube + [B, k] per-tick counts, then the spec
+        path's budget/eos/retire bookkeeping with VARIABLE per-slot
+        advance — slot b emitted sum(counts[b, :]) tokens this flush, not
+        a fixed k. The host length mirror advances by exactly the
+        device's summed count BEFORE eos truncation (the sync spec
+        convention, applied k-deep), the request-identity check drops a
+        retired/recycled slot's whole k*(K+1) in-flight column, and
+        acceptance accounting (spec_emitted_hist, the cooloff EMA) counts
+        DELIVERED tokens per (slot, inner tick) exactly as the sync spec
+        path does per tick."""
+        k = flush["k"]
+        extra = tuple(f["tokens"] for f in firsts) if firsts else ()
+        toks, counts, *first_arrs = self._fetch(
+            (flush["tokens"], flush["counts"]) + extra, ticks=k)
+        if self._died:
+            return  # fleet fencing, post-fetch (see _deliver)
+        t0 = time.perf_counter()
+        if firsts:
+            self._deliver_firsts(firsts, fetched=first_arrs)
+        now = time.perf_counter()
+        now_ns = time.monotonic_ns()
+        start_ns = max(flush["t_disp_ns"], self._last_flush_ns)
+        self.trace.record("loop_flush", -1, -1, k)
+        eos = self.serving.eos_token
+        hist_stats = self._stats["spec_emitted_hist"]
+        emitted_total = 0
+        participations = 0
+        for slot, req in enumerate(flush["reqs"]):
+            if req is None or req is not self._slot_req[slot]:
+                continue
+            try:
+                self._maybe_inject_dispatch()
+                per_tick = [
+                    [int(x) for x in toks[slot, i, :int(c)]]
+                    for i, c in enumerate(counts[slot]) if int(c) > 0
+                ]
+                if len(per_tick) < k:
+                    # froze inside the loop: budget wall or eos (or the
+                    # lane never ran — cap was already 0)
+                    self._stats["loop_early_exits"] += 1
+                if not per_tick:
+                    continue
+                emitted = [t for run in per_tick for t in run]
+                # mirror the device's length advance BEFORE eos
+                # truncation so host and device lengths never diverge
+                self._slot_len[slot] += len(emitted)
+                if eos in emitted:
+                    emitted = emitted[: emitted.index(eos) + 1]
+                # acceptance accounting per (slot, inner tick), DELIVERED
+                # tokens only — the device's raw counts include the
+                # post-eos tail nobody receives
+                left = len(emitted)
+                for run in per_tick:
+                    d = min(len(run), max(left, 0))
+                    hist_stats[min(d, len(hist_stats) - 1)] += 1
+                    left -= d
+                participations += len(per_tick)
+                emitted_total += len(emitted)
+                span = max(now_ns - start_ns, 0)
+                cnt = len(emitted)
+                for j, tok in enumerate(emitted):
+                    ts = start_ns + ((j + 1) * span) // cnt
+                    self.trace.record_at(ts, "token", req.rid, slot, 1)
+                    req.delivered += 1
+                    req.out.put(tok)
+                self._stats["generated_tokens"] += cnt
+                self._slot_budget[slot] -= cnt
+                self._history[slot].extend(emitted)
+                self._tokens[slot] = emitted[-1]
+                # one ITL gap per (slot, flush): the spec-tick burst
+                # convention, k-deep
+                self._note_itl(slot, now)
+                if self._slot_budget[slot] <= 0 or emitted[-1] == eos:
+                    self._retire(slot)
+            except Exception:
+                # crash containment, k*(K+1)-deep: one request's whole
+                # flush column dies with its slot, the rest keep going
+                self._contain_fault(slot)
+        self._stats["spec_slot_ticks"] += participations
+        self._stats["spec_emitted"] += emitted_total
+        if participations:
+            # the cooloff EMA moves once per flush toward this flush's
+            # mean delivered-per-slot-tick — the same gate, same
+            # threshold, evaluated at the flush cadence
+            self._spec_ema = (
+                0.9 * self._spec_ema + 0.1 * emitted_total / participations)
+            if (self.serving.spec_min_mean
+                    and self._spec_ema < self.serving.spec_min_mean):
+                self._spec_cooloff = self.serving.spec_cooloff_ticks
         self._last_flush_ns = now_ns
         self._prof.note("deliver", time.perf_counter() - t0, ticks=k)
         self._note_host_ms(extra_host_s + time.perf_counter() - t0)

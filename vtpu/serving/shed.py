@@ -66,6 +66,13 @@ class EngineSignals:
     # the dcnprobe measurement surfaced at the routing seam.
     fabric_rtt_ms: Optional[float] = None
     fabric_gbps: Optional[float] = None
+    # speculation acceptance: the engine's mean-accepted-per-verify-tick
+    # EMA (the same number the cooloff hysteresis gates on), None when
+    # speculation isn't configured. Route/shed policies can prefer engines
+    # whose speculation is paying off, and the fused LoopPolicy scores it
+    # to size the flush window (low acceptance -> small k: a deep flush of
+    # rejected drafts is pure latency).
+    spec_mean_accepted: Optional[float] = None
 
     def to_dict(self) -> dict:
         """JSON-safe form — the shape that crosses the fabric wire so a
@@ -140,6 +147,82 @@ def accepts_signals(policy) -> bool:
                   if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)]
     # bound method: (waiters, need, signals) -> 3 positionals
     return len(positional) >= 3
+
+
+class LoopPolicy:
+    """How deep the fused decode loop's next flush runs. The engine asks
+    at every flush head: ``pick_k(k_max, signals)`` sees the watchdog-
+    clamped ceiling for this flush and the EngineSignals pressure snapshot
+    and returns the flush window to dispatch (clamped by the engine to
+    [1, k_max]). Implementations must be pure decisions over the snapshot
+    — the engine owns dispatch, accounting and the clamp. The same policy
+    PROGRAM loading shape as ShedPolicy: deployments load their own
+    without forking the engine."""
+
+    def pick_k(self, k_max: int,
+               signals: Optional[EngineSignals] = None) -> int:
+        raise NotImplementedError
+
+
+class FixedLoopPolicy(LoopPolicy):
+    """The static ``decode_loop_k`` behavior as a policy: always the
+    ceiling. This is what an engine without a ``loop_policy`` runs —
+    configuring ``FixedLoopPolicy()`` explicitly is byte-identical."""
+
+    def pick_k(self, k_max: int,
+               signals: Optional[EngineSignals] = None) -> int:
+        return k_max
+
+
+class AdaptiveLoopPolicy(LoopPolicy):
+    """The default adaptive window: deep flushes only when the engine is
+    saturated AND speculation is paying. A deep flush amortizes the host
+    tick tax but lengthens the lifecycle blackout (admission, park,
+    cancel all wait for the flush boundary), so: a waiting line or idle
+    slots with queued work -> full depth (throughput mode); an engine
+    with spare slots and no queue -> shallow flushes (latency mode, the
+    flush boundary is where new work can join); low speculation
+    acceptance additionally halves the window (rejected drafts make deep
+    flushes pure tax)."""
+
+    def __init__(self, accept_floor: float = 1.5):
+        self.accept_floor = accept_floor
+
+    def pick_k(self, k_max: int,
+               signals: Optional[EngineSignals] = None) -> int:
+        if signals is None:
+            return k_max
+        k = k_max
+        saturated = signals.queue_depth > 0 or signals.prefill_backlog > 0
+        if not saturated:
+            k = max(1, k_max // 2)
+        acc = signals.spec_mean_accepted
+        if acc is not None and acc < self.accept_floor:
+            k = max(1, k // 2)
+        return k
+
+
+def load_loop_policy(spec) -> LoopPolicy:
+    """Resolve ``ServingConfig.loop_policy``: None -> the fixed default;
+    a ``"module:attr"`` string -> imported (class or instance); a class ->
+    instantiated; anything else is used as-is (must quack like
+    LoopPolicy). The load_shed_policy shape, applied to the flush-window
+    knob."""
+    if spec is None:
+        return FixedLoopPolicy()
+    if isinstance(spec, str):
+        mod, sep, attr = spec.partition(":")
+        if not sep or not attr:
+            raise ValueError(
+                f"loop_policy string must be 'module:attr', got {spec!r}")
+        obj = getattr(importlib.import_module(mod), attr)
+        spec = obj
+    if isinstance(spec, type):
+        spec = spec()
+    if not callable(getattr(spec, "pick_k", None)):
+        raise ValueError(
+            f"loop_policy {spec!r} does not implement pick_k(k_max, signals)")
+    return spec
 
 
 def load_shed_policy(spec) -> ShedPolicy:
