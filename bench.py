@@ -318,6 +318,10 @@ def tenant_main(a: argparse.Namespace) -> None:
                 # vs gather-then-dense) — the measured-routing audit trail
                 "paged_attn_kernel_ticks", "paged_attn_gather_ticks",
                 "prefix_blocks_shared", "prefix_install_copies",
+                # prefix gravity: per-tenant attach hits/misses and the
+                # blocks currently pinned read-only by registrations —
+                # the fleet directory's engine-side ledger
+                "prefix_hits", "prefix_misses", "prefix_shared_blocks",
                 # KV overcommit: pool high-water vs capacity, parked
                 # population, host-tier swap traffic, and the faults the
                 # recompute path absorbed — the counters the ROADMAP's

@@ -89,6 +89,8 @@ QUICK_RUNS = {
                 "--sessions", "2", "--max-new", "8"],
     "fleet": [str(ROOT / "benchmarks" / "fleet_bench.py"), "--quick",
               "--max-new", "8"],
+    "prefix": [str(ROOT / "benchmarks" / "prefix_bench.py"), "--quick",
+               "--requests", "12", "--decode", "4"],
     "fleet_remote": [str(ROOT / "benchmarks" / "fleet_bench.py"),
                      "--remote", "--quick", "--max-new", "8"],
 }
@@ -107,7 +109,7 @@ QUICK_WAVES = (
     # fleet arm's deterministic gates are load-immune (its perf bar
     # gates full runs only)
     ("paged_attn", "prefill", "decode_loop_k", "obs_fleet"),
-    ("chaos", "migrate", "fleet"),
+    ("chaos", "migrate", "fleet", "prefix"),
     # fleet_remote runs LAST and ALONE: it is four processes (a local
     # reference engine plus three spawned engine hosts), which starved
     # wave-mates when it shared a wave (overcommit's park stalled), and
@@ -159,6 +161,7 @@ TEST_TO_RUN = {
     "test_migrate_bench_quick_small_iteration": "migrate",
     "test_fleet_bench_quick_small_iteration": "fleet",
     "test_fleet_bench_remote_quick_iteration": "fleet_remote",
+    "test_prefix_bench_quick_iteration": "prefix",
 }
 
 
@@ -672,6 +675,53 @@ def test_fleet_bench_quick_small_iteration(quick):
     assert bl["p99"] <= bl["bound"] and bl["pass"]
     assert summary["summary"] and summary["verdict"] == "pass"
     assert summary["unit"] == "failover_blackout_p99_ms"
+
+
+def test_prefix_bench_help_parses():
+    r = _run([str(ROOT / "benchmarks" / "prefix_bench.py"), "--help"])
+    assert r.returncode == 0
+    assert "--quick" in r.stdout and "--speedup" in r.stdout
+    assert "--kill-new" in r.stdout
+
+
+def test_prefix_bench_quick_iteration(quick):
+    """prefix_bench --quick at smoke scale (ISSUE 20 acceptance): the
+    zipfian ON-vs-OFF A/B finishes token-equal with every prefix-aware
+    submit accounted as exactly one directory hit or miss, the routed-
+    to-resident fraction above the pressure baseline, the zipf-head
+    prefix replicated by rebuild with zero staged installs and zero
+    per-admission copies anywhere, the kill scenario's survivor
+    rebuilding every session AROUND its registered prefix
+    (failover_prefix_reuses, shared blocks), and every engine of every
+    arm — the reaped corpse included — leak-clean. Perf (speedup/TTFT)
+    gates full runs only; quick reports it."""
+    r = quick["prefix"]
+    assert r.returncode == 0, r.stderr
+    lines = [ln for ln in r.stdout.splitlines() if ln.strip()]
+    artifact = json.loads(lines[0])
+    summary = json.loads(lines[-1])
+    assert artifact["metric"] == "prefix_gravity_gates"
+    assert artifact["pass"] is True
+    scenarios = {s["name"]: s for s in artifact["scenarios"]}
+    assert set(scenarios) == {"zipf_routing[on_vs_off]",
+                              "kill_prefix_reuse"}
+    for sc in scenarios.values():
+        assert sc["pass"], sc
+        assert all(sc["gates"].values()), sc["gates"]
+    zr = scenarios["zipf_routing[on_vs_off]"]
+    assert zr["gates"]["token_equal"]
+    assert zr["gates"]["zero_install_copies"]
+    assert zr["gates"]["accounting_exact"]
+    d = zr["directory"]
+    assert d["hits"] + d["misses"] == artifact["requests"]
+    assert d["routed_frac"] > d["pressure_baseline"]
+    assert zr["replications"] >= 1
+    kr = scenarios["kill_prefix_reuse"]
+    assert kr["failover_prefix_reuses"] >= 1
+    assert kr["prefix_blocks_shared"] >= 1
+    assert kr["gates"]["zero_leaks_all_engines"]
+    assert summary["summary"] and summary["verdict"] == "pass"
+    assert summary["unit"] == "tokens_per_sec_speedup"
 
 
 def test_fleet_bench_remote_quick_iteration(quick):

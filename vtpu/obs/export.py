@@ -65,6 +65,19 @@ COUNTERS = {
                              "Pool blocks mapped read-only at admission"),
     "prefix_cow_copies": ("prefix_cow_copies",
                           "Prefix boundary-block copy-on-writes"),
+    "prefix_hits": ("prefix_hits",
+                    "Admissions that attached a registered prefix"),
+    "prefix_misses": ("prefix_misses",
+                      "Prefix submits whose registration was gone"),
+    "prefix_exports": ("prefix_exports",
+                       "Prefix KV exports through the staged D2H gather"),
+    "prefix_tier_installs": ("prefix_tier_installs",
+                             "Prefixes installed from a serialized payload "
+                             "(host tier or cross-engine copy)"),
+    "failover_prefix_reuses": ("failover_prefix_reuses",
+                               "Failover recomputes that shared resident "
+                               "prefix blocks and replayed only the "
+                               "private tail"),
     "read_pages_live": ("read_pages_live",
                         "Live pages gathered by decode reads"),
     "read_pages_window": ("read_pages_window",
@@ -139,6 +152,10 @@ GAUGES = {
     "queued": ("queued_requests", "Requests waiting for a slot", 1),
     "registered_prefixes": ("registered_prefixes",
                             "Live shared-prefix registrations", 1),
+    "prefix_shared_blocks": ("prefix_shared_blocks",
+                             "Pool blocks currently mapped read-only from "
+                             "prefix registrations (live slots + parked)",
+                             1),
     "parked_sessions": ("parked_sessions", "Sessions in the parked set", 1),
     "device_gets_per_tick": ("device_gets_per_tick",
                              "Tick fetches / ticks (contract: 1.0)", 1),
@@ -328,6 +345,24 @@ FLEET_COUNTERS = {
     "fabric_checksum_faults": ("fleet_fabric_checksum_faults",
                                "Payload chunks that failed their CRC32 "
                                "(converted to recompute-on-fault)"),
+    # prefix gravity (vtpu/serving/prefixdir): the fleet-owned directory
+    "prefix_routes": ("fleet_prefix_routes",
+                      "Prefix submits placed on (or installed onto) a "
+                      "resident engine"),
+    "prefix_replications": ("fleet_prefix_replications",
+                            "Hot prefixes replicated to another engine by "
+                            "the gravity pass"),
+    "prefix_spills": ("fleet_prefix_spills",
+                      "Cold prefixes spilled to the shared host tier"),
+    "prefix_installs": ("fleet_prefix_installs",
+                        "Prefix installs served from the host tier or a "
+                        "donor engine"),
+    "prefix_directory_hits": ("fleet_prefix_directory_hits",
+                              "Directory-recorded prefix attach hits "
+                              "across the fleet"),
+    "prefix_directory_misses": ("fleet_prefix_directory_misses",
+                                "Prefix submits the directory could not "
+                                "place anywhere (full-prompt fallback)"),
 }
 # key -> (family suffix, help, scale) — same convention as engine GAUGES
 FLEET_GAUGES = {
@@ -380,6 +415,19 @@ FLEET_GAUGES = {
     "fabric_gbps": ("fleet_fabric_gbps",
                     "Mean measured fabric payload bandwidth (Gbit/s) "
                     "over connected hosts", 1),
+    "prefix_pids": ("fleet_prefix_pids",
+                    "Distinct content prefixes the directory tracks", 1),
+    "prefix_resident_replicas": ("fleet_prefix_resident_replicas",
+                                 "Engine-resident prefix replicas summed "
+                                 "over pids", 1),
+    "prefix_host_tier": ("fleet_prefix_host_tier",
+                         "Prefixes held in the shared host tier", 1),
+    "prefix_live_refs": ("fleet_prefix_live_refs",
+                         "Live sessions currently attached to a directory "
+                         "prefix", 1),
+    "prefix_ms_per_token": ("fleet_prefix_seconds_per_token",
+                            "Measured per-token prefix build cost EMA "
+                            "(the route-bonus denominator)", 1e-3),
 }
 # handled specially (engine_states -> the per-engine health gauge below;
 # engines -> each engine's snapshot joins the ordinary vtpu_serving_*

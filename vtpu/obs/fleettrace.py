@@ -74,6 +74,12 @@ FLEET_EVENT_KINDS = (
                          # destination; score: the occupancy gap)
     "drain_start",       # router-driven evacuation began
     "drain_end",         # evacuation finished (val: sessions migrated)
+    "prefix_install",    # a host-tier/donor prefix was installed on an
+                         # engine (engine: destination; val: prefix tokens)
+    "prefix_replicate",  # gravity replicated a hot prefix (engine:
+                         # destination; val: prefix tokens)
+    "prefix_spill",      # gravity spilled a cold prefix to the host tier
+                         # (engine: the ex-resident; val: 1 if host-tiered)
 )
 
 # Hop kinds a journey records (the "why did the stream move" vocabulary).
@@ -176,11 +182,15 @@ class FleetTrace:
     # ------------------------------------------------------- control events
 
     def control(self, event: str, engine: str = "", jid: int = -1,
-                val: int = 0, signals=None, score=None) -> None:
+                val: int = 0, signals=None, score=None,
+                bonus=None) -> None:
         """Record one fleet control event. ``signals`` (an EngineSignals)
         and ``score`` ride along as the decision's audited inputs; both
         default absent so the hot route path pays one dict + one deque
-        append. Host-only, lock-held only for the append."""
+        append. ``bonus`` is the prefix-gravity additive a route event
+        records NEXT TO the winning score (the PR-14 auditability
+        contract extended: score already includes it, bonus shows the
+        directory's share). Host-only, lock-held only for the append."""
         if not self.enabled:
             return
         rec = {
@@ -193,6 +203,8 @@ class FleetTrace:
         }
         if score is not None:
             rec["score"] = float(score)
+        if bonus is not None:
+            rec["bonus"] = float(bonus)
         if signals is not None:
             rec["signals"] = dataclasses.asdict(signals)
         with self._mu:
@@ -218,17 +230,21 @@ class FleetTrace:
     # -------------------------------------------------------------- journeys
 
     def begin_journey(self, engine: str, rid: int,
-                      host: str = "local") -> int:
+                      host: str = "local", prefix: bool = False) -> int:
         """Open a journey at its first placement; returns the jid the
         fleet stamps on the Request (stable across every later hop).
         ``host`` is the placement's EngineHost label ('local' for an
-        in-proc member) — cross-host hops stitch into ONE journey."""
+        in-proc member) — cross-host hops stitch into ONE journey.
+        ``prefix`` marks a prefix-GRAVITATIONAL placement: the route
+        bonus (not pressure alone) chose this engine, the annotation a
+        stitched journey surfaces per hop."""
         if not self.enabled:
             return -1
         jid = next(self._jid_ctr)
         j = {"jid": jid,
              "hops": [{"engine": engine, "rid": rid, "kind": "route",
-                       "host": host, "t_ns": time.monotonic_ns()}],
+                       "host": host, "prefix": bool(prefix),
+                       "t_ns": time.monotonic_ns()}],
              "ended": False, "delivered": None, "terminal": None}
         with self._mu:
             self._journeys[jid] = j
@@ -362,6 +378,7 @@ class FleetTrace:
             hop = {"engine": h["engine"], "rid": h["rid"],
                    "kind": h["kind"], "t_ns": h["t_ns"],
                    "host": h.get("host", "local"),
+                   "prefix": bool(h.get("prefix", False)),
                    "tokens": span["tokens"] if span else 0,
                    "first_tok_ns": span["first_tok_ns"] if span else None,
                    "last_tok_ns": span["last_tok_ns"] if span else None,
@@ -523,6 +540,8 @@ class FleetTrace:
             args = {"engine": e["engine"], "jid": e["jid"], "val": e["val"]}
             if "score" in e:
                 args["score"] = e["score"]
+            if "bonus" in e:
+                args["bonus"] = e["bonus"]
             if "signals" in e:
                 args["signals"] = e["signals"]
             out.append({"ph": "i", "pid": 1, "tid": 0, "s": "p",

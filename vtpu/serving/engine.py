@@ -1593,6 +1593,11 @@ class ServingEngine:
         # mappings (refcounts held elsewhere too) — the split the overcommit
         # eviction policy needs: only a slot's private tail is ever swapped
         self._slot_shared = [0] * b
+        # which prefix those shares came from, as (content pid, prefix
+        # length) — follows the blocks through park/resume so a fleet
+        # directory's refcounts and a failover rebuild's prefix-reuse can
+        # name the prefix a session rides (vtpu/serving/prefixdir)
+        self._slot_pid: list[Optional[tuple[str, int]]] = [None] * b
         # --- KV overcommit: eviction + host swap tier + park/resume ------
         self._swap_enabled = serving.kv_swap is not None
         if self._swap_enabled and not self._paged:
@@ -1791,6 +1796,20 @@ class ServingEngine:
                        "prefix_install_copies": 0,
                        "prefix_blocks_shared": 0,
                        "prefix_cow_copies": 0,
+                       # prefix-cache outcome counters (the fleet
+                       # directory's ground truth): a hit is an admission
+                       # that reused registered prefix KV (share on paged,
+                       # install on dense); a miss is a prefix-referencing
+                       # admission whose registration vanished mid-flight.
+                       # prefix_exports/prefix_tier_installs count the
+                       # staged D2H/H2D movement of whole prefixes between
+                       # engines and the fleet host tier;
+                       # failover_prefix_reuses counts rebuilds that
+                       # shared a resident prefix instead of recomputing
+                       # its positions (vtpu/serving/prefixdir).
+                       "prefix_hits": 0, "prefix_misses": 0,
+                       "prefix_exports": 0, "prefix_tier_installs": 0,
+                       "failover_prefix_reuses": 0,
                        "read_pages_live": 0, "read_pages_window": 0,
                        "read_pages_hist": {},
                        # KV overcommit: parks/resumes are lifecycle events;
@@ -1869,6 +1888,14 @@ class ServingEngine:
         self._prefixes: dict[int, dict] = {}
         self._prefix_lock = threading.Lock()
         self._next_prefix_id = 0
+        # content-addressed index over the registry: prefix_id(tokens) ->
+        # local id, so a fleet-tier install is idempotent and a failover
+        # rebuild can find "the same prompt" without the dead engine's ids
+        self._pid_index: dict[str, int] = {}
+        # fleet seam (vtpu/serving/prefixdir): when set, register/
+        # unregister/hit/release events report to the owning fleet's
+        # PrefixDirectory; unset (the default) costs one None check
+        self._prefix_listener = None
         # per padded-prefix-length COMPILED install executables, built at
         # register_prefix time on the caller's thread — a first-use compile
         # inside the serving loop would stall every live stream (the
@@ -2007,6 +2034,12 @@ class ServingEngine:
             raise ValueError(f"prefix length {n} leaves no room for a suffix")
         padded = pad_to_chunks(tokens, n, c)
         pad = padded.shape[1]
+        # content address (vtpu/serving/prefixdir): the cross-engine name
+        # this registration reports under — identical tokens registered
+        # anywhere in a fleet collapse to one directory entry
+        from vtpu.serving.prefixdir import prefix_id
+
+        cpid = prefix_id(tokens)
         if self._paged:
             # Paged: the prefix prefills into POOL BLOCKS once — the
             # registration is the only time its KV is ever computed or
@@ -2036,11 +2069,18 @@ class ServingEngine:
                 entry = item["entry"]
             else:
                 entry = self._build_prefix_paged(tokens, padded, n, pad)
+            entry["pid"] = cpid
             with self._prefix_lock:
                 pid = self._next_prefix_id
                 self._next_prefix_id += 1
                 self._prefixes[pid] = entry
+                self._pid_index[cpid] = pid
+            if self._prefix_listener is not None:
+                self._prefix_listener(
+                    "register", cpid, lid=pid, tokens=entry["tokens"],
+                    length=n, build_ms=entry.get("build_ms"))
             return pid
+        t0 = time.perf_counter()
         scratch = self.model.init_state(1)
         for i in range(pad // c):
             off = i * c
@@ -2056,6 +2096,8 @@ class ServingEngine:
             else ("k", "v"))
         buffers = {key: scratch[key][:, 0, :pad] for key in kv_keys}
         last_logits = logits[0, (n - 1) - (pad - c)]
+        jax.block_until_ready(last_logits)
+        build_ms = (time.perf_counter() - t0) * 1e3
         self._compile_install(pad, buffers)
         with self._prefix_lock:
             pid = self._next_prefix_id
@@ -2063,8 +2105,15 @@ class ServingEngine:
             self._prefixes[pid] = {
                 "tokens": [int(x) for x in tokens.tolist()],
                 "buffers": buffers, "len": n, "pad": pad,
-                "last_logits": last_logits,
+                "last_logits": last_logits, "pid": cpid,
+                "build_ms": build_ms,
             }
+            self._pid_index[cpid] = pid
+        if self._prefix_listener is not None:
+            self._prefix_listener(
+                "register", cpid, lid=pid,
+                tokens=[int(x) for x in tokens.tolist()], length=n,
+                build_ms=build_ms)
         return pid
 
     def _build_prefix_paged(self, tokens, padded, n: int, pad: int) -> dict:
@@ -2087,6 +2136,7 @@ class ServingEngine:
                 f"{self._alloc.free_blocks} free")
         ctx = self.model.max_context
         logits = None
+        t0 = time.perf_counter()
         try:
             for i in range(pad // c):
                 off = i * c
@@ -2111,9 +2161,13 @@ class ServingEngine:
             self._alloc.release(blocks)
             raise
         last_logits = logits[0, (n - 1) - (pad - c)]
+        jax.block_until_ready(last_logits)
+        # measured build wall-time: the per-token prefill cost the fleet
+        # directory's route bonus is priced from (avoided-prefill ms)
+        build_ms = (time.perf_counter() - t0) * 1e3
         return {"tokens": [int(x) for x in tokens.tolist()],
                 "blocks": blocks, "len": n, "pad": pad,
-                "last_logits": last_logits}
+                "last_logits": last_logits, "build_ms": build_ms}
 
     def _drain_prefix_work(self) -> None:
         """Execute queued paged prefix builds on the loop thread (the pool
@@ -2151,6 +2205,9 @@ class ServingEngine:
             entry = self._prefixes.pop(pid, None)
             if entry is None:
                 raise ValueError(f"unknown prefix id {pid}")
+            cpid = entry.get("pid")
+            if cpid is not None and self._pid_index.get(cpid) == pid:
+                del self._pid_index[cpid]
             if self._paged:
                 # drop the registry's refcount hold; blocks mapped
                 # read-only into live slots survive until those slots
@@ -2158,6 +2215,8 @@ class ServingEngine:
                 # before). UNDER the lock: _reserve_paged's get+share on
                 # the loop thread must never interleave with this release.
                 self._alloc.release(entry["blocks"])
+        if cpid is not None and self._prefix_listener is not None:
+            self._prefix_listener("unregister", cpid, lid=pid)
 
     def _compile_install(self, pad: int, buffers: dict) -> None:
         """AOT-compile the per-padded-length install executable HERE, on the
@@ -2548,7 +2607,8 @@ class ServingEngine:
                 kind, item = self._lifecycle_q.get_nowait()
             except queue.Empty:
                 break
-            if kind in ("migrate_out", "migrate_in"):
+            if kind in ("migrate_out", "migrate_in",
+                        "prefix_out", "prefix_in"):
                 item.fail(RuntimeError("engine stopped mid-migration"))
 
     # ----------------------------------------------------------------- loop
@@ -2577,6 +2637,12 @@ class ServingEngine:
         if self._paged and self._slot_blocks[slot]:
             self._alloc.release(self._slot_blocks[slot])
             self._slot_blocks[slot] = []
+        if self._slot_pid[slot] is not None:
+            if self._slot_shared[slot] and self._prefix_listener is not None:
+                # the slot's prefix shares just released: the fleet
+                # directory's live refcount follows the allocator's
+                self._prefix_listener("release", self._slot_pid[slot][0])
+            self._slot_pid[slot] = None
         self._slot_shared[slot] = 0
 
     def _reserve_paged(self, slot: int, req: Request) -> bool:
@@ -2602,7 +2668,21 @@ class ServingEngine:
                 entry = self._prefixes.get(req.prefix)
                 if entry is None:
                     return True  # unregistered: _admit retires it, no pages
-                return self._reserve_paged_locked(slot, req, entry)
+                ok = self._reserve_paged_locked(slot, req, entry)
+            if ok:
+                # a paged prefix hit is THE share itself (zero-copy
+                # reuse); counted only on success so a backpressured
+                # admission retried next tick never double-counts
+                self._stats["prefix_hits"] += 1
+                if entry.get("pid") is not None:
+                    self._slot_pid[slot] = (entry["pid"], entry["len"])
+                    # refcount events pair with the allocator's holds:
+                    # a sub-page prefix shares no blocks, so it stamps
+                    # no ref the release side would never drop
+                    if (self._slot_shared[slot]
+                            and self._prefix_listener is not None):
+                        self._prefix_listener("hit", entry["pid"])
+            return ok
         return self._reserve_paged_locked(slot, req, None)
 
     def _reserve_plan(self, req: Request,
@@ -2813,6 +2893,9 @@ class ServingEngine:
         if e["shared"]:
             self._alloc.release(e["shared"])
             e["shared"] = []
+            if (e.get("pid") is not None
+                    and self._prefix_listener is not None):
+                self._prefix_listener("release", e["pid"])
         if e["priv"]:
             self._alloc.release(e["priv"])
             e["priv"] = []
@@ -2857,6 +2940,7 @@ class ServingEngine:
         req = self._slot_req[slot]
         nshared = self._slot_shared[slot]
         blocks = self._slot_blocks[slot]
+        spid = self._slot_pid[slot]
         self._parked[req] = {
             "req": req,
             # cache contents by construction: history minus the pending
@@ -2876,6 +2960,12 @@ class ServingEngine:
             "hist_exact": self._slot_hist_exact[slot],
             "priority": req.priority,
             "seq": self._park_seq,
+            # the prefix identity rides the park: its shares transfer to
+            # the entry (holds MOVE — no release event), and a payload-
+            # less rebuild on another engine can re-share the same
+            # content pid instead of recomputing the prefix positions
+            "pid": spid[0] if spid is not None else None,
+            "prefix_len": spid[1] if spid is not None else 0,
         }
         self._park_seq += 1
         # free the slot WITHOUT releasing blocks (the entry owns them now);
@@ -2886,6 +2976,7 @@ class ServingEngine:
         self._slot_len[slot] = 0
         self._slot_blocks[slot] = []
         self._slot_shared[slot] = 0
+        self._slot_pid[slot] = None
         self._history[slot] = []
         self._slot_hist_exact[slot] = True
         self._itl_last[slot] = None
@@ -2913,6 +3004,15 @@ class ServingEngine:
                 from vtpu.serving.migrate import handle_migrate_command
 
                 handle_migrate_command(self, kind, req)
+                continue
+            if kind in ("prefix_out", "prefix_in"):
+                # whole-prefix export/install tickets (vtpu/serving/
+                # prefixdir): same loop-thread ownership rules as a
+                # migration — the staging pair and the registry lock
+                # both live here
+                from vtpu.serving.prefixdir import handle_prefix_command
+
+                handle_prefix_command(self, kind, req)
                 continue
             if kind == "park":
                 if req in self._parked and req in self._want_resume:
@@ -3106,6 +3206,9 @@ class ServingEngine:
         row_blocks = e["shared"] + e["priv"]
         self._slot_blocks[slot] = row_blocks
         self._slot_shared[slot] = len(e["shared"])
+        if e.get("pid") is not None and e["shared"]:
+            # the entry's prefix holds move back onto the slot
+            self._slot_pid[slot] = (e["pid"], e["prefix_len"])
         e["shared"] = []
         e["priv"] = []
         trow = np.zeros((self._max_pages,), np.int32)
@@ -3128,6 +3231,89 @@ class ServingEngine:
             del self._parked[req]
             self._stats["resumes"] += 1
 
+    def _try_prefix_reuse(self, slot: int, e: dict) -> Optional[bool]:
+        """Rebuild a payload-less entry AROUND a locally registered
+        prefix: share the registry's blocks for the session's content
+        pid (COW the boundary like any admission) and chunk-prefill only
+        the private tail — the failover path that makes a survivor serve
+        a hot system prompt with ZERO recomputed prefix tokens. Returns
+        None to fall through to the whole-sequence recompute (pid not
+        resident, tokens diverged, inexact history), False when the pool
+        cannot cover the tail yet (entry stays parked, retried next
+        tick), True on success."""
+        pid = e.get("pid")
+        plen = int(e.get("prefix_len") or 0)
+        if (pid is None or plen <= 0 or not self._chunk
+                or not e.get("hist_exact", True)):
+            return None
+        req, n, need = e["req"], e["seq_len"], e["n_pages"]
+        page = self._page
+        full = plen // page
+        if plen > n or full == 0 or need <= full:
+            return None
+        toks = e["tokens"]
+        with self._prefix_lock:
+            lid = self._pid_index.get(pid)
+            entry = self._prefixes.get(lid) if lid is not None else None
+            if (entry is None or entry["len"] != plen
+                    or entry["tokens"] != list(toks[:plen])):
+                return None
+            priv = self._alloc_reclaim(need - full, exclude=req)
+            if priv is None:
+                self._stats["pool_blocked_resumes"] += 1
+                return False
+            shared = list(entry["blocks"][:full])
+            self._alloc.share(shared)
+            self._stats["prefix_blocks_shared"] += len(shared)
+            if plen % page:
+                # the partial boundary block COWs exactly as at admission
+                # (priv[0] sits at table index `full`)
+                self.state = self._copy_block(
+                    self.state, jnp.int32(entry["blocks"][full]),
+                    jnp.int32(priv[0]))
+                self._stats["prefix_cow_copies"] += 1
+        row_blocks = shared + priv
+        self._slot_blocks[slot] = row_blocks
+        self._slot_shared[slot] = len(shared)
+        self._slot_pid[slot] = (pid, plen)
+        trow = np.zeros((self._max_pages,), np.int32)
+        trow[:len(row_blocks)] = row_blocks
+        self.state = self._set_table_row(
+            self.state, jnp.int32(slot), trow, jnp.int32(plen))
+        if e["host"] is not None:
+            if e["pend"] is not None:
+                e["pend"] = None
+                self._swap_pending.remove(e)
+            self._host_free.extend(e["host"])
+            e["host"] = None
+        ns = n - plen
+        self._stats["swap_faults"] += 1
+        self._stats["fault_recomputes"] += 1
+        self._stats["failover_prefix_reuses"] += 1
+        self._stats["prefix_hits"] += 1
+        if self._prefix_listener is not None:
+            self._prefix_listener("hit", pid)
+        # val = the TAIL length: the white-box contract that the prefix
+        # positions were shared, never re-prefilled
+        self.trace.record("fault_recompute", req.rid, slot, ns)
+        if ns == 0:
+            # the whole cache WAS the prefix (empty-suffix session parked
+            # right after its first token): nothing to rebuild
+            self._restore_slot(slot, e)
+            return True
+        self._admitting[slot] = {
+            "req": req,
+            "padded": pad_to_chunks(
+                jnp.asarray(toks[plen:], jnp.int32), ns, self._chunk),
+            "n": n, "off": 0, "base": plen,
+            "resume": {"req": req, "pending": e["pending"],
+                       "budget": e["budget"], "seq_len": n,
+                       "tokens": toks},
+        }
+        del self._parked[req]
+        self._stats["resumes"] += 1
+        return True
+
     def _begin_recompute(self, slot: int, e: dict) -> bool:
         """Rebuild a faulted (or crossover-short) session's KV through the
         prefill path. The whole sequence goes PRIVATE — held prefix shares
@@ -3149,6 +3335,15 @@ class ServingEngine:
             self._alloc.release(e["priv"])
             e["priv"] = []
             e["dropped"] = True
+        if not e["shared"]:
+            # failover-rebuild fast path: a payload-less entry whose
+            # content pid is registered HERE shares the prefix blocks and
+            # recomputes only its private tail (an entry still HOLDING
+            # shares — a local eviction park — keeps the established
+            # release-and-recompute route below)
+            got = self._try_prefix_reuse(slot, e)
+            if got is not None:
+                return got
         priv = self._alloc_reclaim(need, exclude=req)
         if priv is None:
             self._stats["pool_blocked_resumes"] += 1
@@ -3156,6 +3351,9 @@ class ServingEngine:
         if e["shared"]:
             self._alloc.release(e["shared"])
             e["shared"] = []
+            if (e.get("pid") is not None
+                    and self._prefix_listener is not None):
+                self._prefix_listener("release", e["pid"])
         if e["host"] is not None:
             if e["pend"] is not None:
                 e["pend"] = None
@@ -3226,6 +3424,7 @@ class ServingEngine:
                 log.warning("request references unregistered prefix %s; "
                             "retiring it unserved", req.prefix)
                 self._free_slot_blocks(slot)
+                self._stats["prefix_misses"] += 1
                 self._stats["faulted_requests"] += 1
                 self.trace.record("fault", req.rid, slot)
                 self._end_stream(req, Status.FAULTED, slot)
@@ -3238,6 +3437,11 @@ class ServingEngine:
             else:
                 self._install_prefix(slot, entry)
                 self._stats["prefix_install_copies"] += 1
+                # dense hits count at the install (the paged ones counted
+                # at _reserve_paged's share — each mode's reuse moment);
+                # no listener event: dense installs hold no block refs
+                # for a release to ever pair with
+                self._stats["prefix_hits"] += 1
             base = entry["len"]
             if n == 0:
                 # no suffix: the first token comes straight from the
@@ -3943,6 +4147,19 @@ class ServingEngine:
         s["admitting_slots"] = len(self._admitting)
         s["queued"] = self._pending.qsize() + len(self._waiting)
         s["registered_prefixes"] = len(self._prefixes)
+        # pool blocks currently mapped as SHARED prefix leads (live slots
+        # + parked entries' held shares): a gauge computed from the
+        # bookkeeping itself, so it can never drift from the allocator.
+        # Snapshot-tolerant of a racing park/resume on the loop thread —
+        # the two lists conserve the holds between them.
+        try:
+            s["prefix_shared_blocks"] = (
+                sum(self._slot_shared)
+                + sum(len(e["shared"]) for e in list(self._parked.values())))
+        except RuntimeError:  # dict mutated mid-iteration: retry once
+            s["prefix_shared_blocks"] = (
+                sum(self._slot_shared)
+                + sum(len(e["shared"]) for e in list(self._parked.values())))
         # per-tick transfer + host-overhead telemetry (the decode data-plane
         # contract: ONE batched device_get per tick delivery — admission
         # first tokens piggyback on it; an idle engine's admission wave

@@ -113,7 +113,8 @@ def reap_corpse(eng) -> None:
             kind, item = eng._lifecycle_q.get_nowait()
         except queue.Empty:
             break
-        if kind in ("migrate_out", "migrate_in"):
+        if kind in ("migrate_out", "migrate_in",
+                    "prefix_out", "prefix_in"):
             item.fail(RuntimeError("engine died before serving the ticket"))
 
 
@@ -257,6 +258,30 @@ class EngineHost:
                         req.out.put(_PUMP_STOP)
                         raise
                     result = {"path": res["path"], "rid": int(req.rid)}
+                elif op == "register_prefix":
+                    # prefix-gravity build: the engine computes the KV on
+                    # its loop thread (chunked prefill) and reports the
+                    # content pid + build cost back for the directory
+                    lid = eng.register_prefix(msg["tokens"])
+                    ent = eng._prefixes[lid]
+                    result = {"lid": int(lid), "pid": ent.get("pid"),
+                              "len": int(ent["len"]),
+                              "build_ms": ent.get("build_ms")}
+                elif op == "unregister_prefix":
+                    eng.unregister_prefix(int(msg["lid"]))
+                    result = {"ok": True}
+                elif op == "prefix_out":
+                    res = _ask(eng, "prefix_out",
+                               _Ticket(None, meta={"lid": int(msg["lid"])}),
+                               timeout)
+                    out_payload = res["payload"]
+                    result = {"meta": res["meta"]}
+                elif op == "prefix_in":
+                    res = _ask(eng, "prefix_in",
+                               _Ticket(None, meta=dict(msg["meta"]),
+                                       payload=payload), timeout)
+                    result = {"lid": int(res["lid"]), "pid": res["pid"],
+                              "installed": bool(res.get("installed", True))}
                 else:
                     raise MigrationError(f"unknown ask op {op!r}")
             except Exception as exc:  # typed reply, never a hang
@@ -323,6 +348,7 @@ class EngineHost:
                     req = eng.submit(
                         msg["tokens"],
                         max_new_tokens=int(msg.get("max_new", 0)),
+                        prefix=msg.get("prefix"),
                         priority=int(msg.get("priority", 0)),
                         deadline_ms=msg.get("deadline_ms"))
                 except (RuntimeError, ValueError) as exc:

@@ -64,7 +64,7 @@ class _Session:
 
     __slots__ = ("req", "eng", "cid", "rid", "prompt", "gen", "budget",
                  "next_seq", "buf", "done", "cancel_last",
-                 "last_gap_req", "ack_floor")
+                 "last_gap_req", "ack_floor", "pid", "prefix_len")
 
     def __init__(self, req, eng, cid, prompt, budget):
         self.req = req
@@ -80,6 +80,8 @@ class _Session:
         self.cancel_last = 0.0  # monotonic stamp of the last SENT cancel
         self.last_gap_req = 0.0
         self.ack_floor = 0    # last cumulative ack piggybacked on a ping
+        self.pid: Optional[str] = None  # content pid of a shared prefix
+        self.prefix_len = 0   # its token length (rides the ledger meta)
 
 
 class _PendingAsk:
@@ -542,6 +544,12 @@ class RemoteEngine:
         self._sig_ns = 0
         self._stats_cache: dict = {}
         self._parked: Dict[object, dict] = {}
+        # client-side mirror of prefixes registered on the REMOTE engine:
+        # {lid: {"pid","tokens","len","build_ms"}} — what a prefix submit
+        # resolves (the suffix crosses the wire, the full prompt seeds
+        # the session mirror) and what the fleet's directory reports for
+        # a remote member (its host-side listener reports elsewhere)
+        self._prefix_meta: Dict[int, dict] = {}
 
     # ------------------------------------------------------------- routing
 
@@ -610,13 +618,22 @@ class RemoteEngine:
         if not self._client.link_ok:
             raise RuntimeError(
                 f"fabric link to {self.host} is down")
+        pm = None
         if prefix is not None:
-            raise ValueError(
-                "prefix-cache submits are not routed over the fabric")
-        prompt = [int(t) for t in list(tokens)] \
+            pm = self._prefix_meta.get(int(prefix))
+            if pm is None:
+                raise ValueError(
+                    f"unknown prefix id {prefix!r} on remote engine "
+                    f"{self.name}")
+        suffix = [int(t) for t in list(tokens)] \
             if not hasattr(tokens, "tolist") else \
             [int(t) for t in tokens.tolist()]
-        req = Request(tokens=jnp.asarray(prompt, jnp.int32),
+        # the mirror's prompt is the FULL history (prefix + suffix): the
+        # ledger rebuild must replay the whole sequence on a survivor
+        # even though only the suffix crosses the wire here
+        prompt = (list(pm["tokens"]) + suffix) if pm is not None \
+            else suffix
+        req = Request(tokens=jnp.asarray(suffix, jnp.int32),
                       max_new_tokens=int(max_new_tokens),
                       priority=int(priority))
         req.t_submit_ns = time.monotonic_ns()
@@ -626,10 +643,14 @@ class RemoteEngine:
         req._fabric_err = None
         sess = self._client.open_session(req, self, prompt,
                                          max_new_tokens)
+        if pm is not None:
+            sess.pid = pm["pid"]
+            sess.prefix_len = int(pm["len"])
         try:
             self._client.chan.send({
                 "kind": "submit", "cid": sess.cid, "eng": self.name,
-                "tokens": prompt, "max_new": int(max_new_tokens),
+                "tokens": suffix, "max_new": int(max_new_tokens),
+                "prefix": int(prefix) if prefix is not None else None,
                 "priority": int(priority), "deadline_ms": deadline_ms})
         except TransportError as exc:
             self._client.drop_session(sess.cid)
@@ -653,6 +674,30 @@ class RemoteEngine:
         req.rid = sess.rid
         self.trace.record("submit", sess.rid, -1, len(prompt))
         return req
+
+    # -------------------------------------------------------------- prefixes
+
+    def register_prefix(self, prefix_tokens) -> int:
+        """Build a shared prefix on the remote engine (its loop thread
+        runs the chunked prefill) and mirror the registration client-
+        side so prefix submits and the fleet directory can resolve it."""
+        toks = [int(t) for t in (prefix_tokens.tolist()
+                                 if hasattr(prefix_tokens, "tolist")
+                                 else list(prefix_tokens))]
+        result, _ = self._client.ask(
+            "register_prefix", {"eng": self.name, "tokens": toks},
+            timeout=120.0)
+        lid = int(result["lid"])
+        self._prefix_meta[lid] = {"pid": result["pid"], "tokens": toks,
+                                  "len": int(result["len"]),
+                                  "build_ms": result.get("build_ms")}
+        return lid
+
+    def unregister_prefix(self, lid: int) -> None:
+        self._prefix_meta.pop(int(lid), None)
+        self._client.ask("unregister_prefix",
+                         {"eng": self.name, "lid": int(lid)},
+                         timeout=30.0)
 
     # ------------------------------------------------- lifecycle / tickets
 
@@ -713,6 +758,25 @@ class RemoteEngine:
             sess.rid = int(result["rid"])
             req.rid = sess.rid
             return {"path": result["path"]}
+        if kind == "prefix_out":
+            # payload-carrying export: the staged D2H gather runs on the
+            # host; the KV pages + logits plane ride back CRC-chunked
+            result, payload = self._client.ask(
+                "prefix_out",
+                {"eng": self.name, "lid": int(ticket.meta["lid"])},
+                timeout)
+            return {"meta": result["meta"], "payload": payload}
+        if kind == "prefix_in":
+            meta = ticket.meta
+            result, _ = self._client.ask(
+                "prefix_in", {"eng": self.name, "meta": dict(meta)},
+                timeout, payload=ticket.payload)
+            lid = int(result["lid"])
+            self._prefix_meta[lid] = {
+                "pid": result["pid"], "tokens": list(meta["tokens"]),
+                "len": int(meta["len"]), "build_ms": None}
+            return {"lid": lid, "pid": result["pid"],
+                    "installed": bool(result.get("installed", True))}
         raise MigrationError(
             f"unsupported remote lifecycle ticket {kind!r}")
 
@@ -766,6 +830,7 @@ class RemoteEngine:
                 "pending": int(sess.gen[-1]), "budget": budget,
                 "seq_len": seq_len, "n_pages": n_pages,
                 "hist_exact": True, "priority": int(req.priority),
+                "pid": sess.pid, "prefix_len": int(sess.prefix_len),
             }
         return out
 

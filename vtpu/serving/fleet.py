@@ -73,6 +73,8 @@ import threading
 import time
 from typing import Any, Dict, Iterable, List, Optional
 
+import numpy as np
+
 from vtpu.obs.fleettrace import FleetTrace
 from vtpu.serving.engine import Request, ServingEngine, Status
 from vtpu.serving.faults import FaultPlan
@@ -83,6 +85,12 @@ from vtpu.serving.migrate import (
     _snaplist,
     drain_engine,
     migrate,
+)
+from vtpu.serving.prefixdir import (
+    PrefixDirectory,
+    export_prefix,
+    install_prefix,
+    prefix_id,
 )
 from vtpu.serving.shed import EngineSignals
 
@@ -203,6 +211,25 @@ class FleetConfig:
     # bounded journey registry / post-mortem bundle set sizes
     trace_journeys: int = 4096
     trace_bundles: int = 8
+    # --- prefix gravity (vtpu/serving/prefixdir) ---------------------
+    # hot replication: once a content pid's total hits reach this, the
+    # monitor replicates it (one per probe round, through the ordinary
+    # chunk-prefill registration — prefix_install_copies stays 0) to the
+    # least-pressured routable engine not yet holding it, up to
+    # prefix_max_replicas residents. None = replication off.
+    prefix_replicate_hits: Optional[int] = None
+    prefix_max_replicas: int = 2
+    # cold spill: a pid with ZERO live refs whose last hit is older than
+    # this many seconds is exported to the fleet host tier (the staged
+    # D2H any spill pays) and its resident copy unregistered — one per
+    # probe round. Any engine re-installs from the tier on demand.
+    # None = spill off.
+    prefix_spill_idle_s: Optional[float] = None
+    # route-bonus denominator: milliseconds of avoided prefill that
+    # "weigh" the same as one queue slot of pressure in the
+    # LeastPressure score (the 0.25/slot weight) — smaller values make
+    # resident engines win from further behind.
+    prefix_queue_slot_ms: float = 100.0
 
 
 def _ledger_entries(eng: ServingEngine) -> Dict[Request, dict]:
@@ -234,6 +261,12 @@ def _ledger_entries(eng: ServingEngine) -> Dict[Request, dict]:
             "n_pages": len(eng._slot_blocks[slot]),
             "hist_exact": bool(eng._slot_hist_exact[slot]),
             "priority": req.priority,
+            # prefix identity: a survivor holding the same content pid
+            # resident re-shares it at rebuild instead of recomputing
+            "pid": (eng._slot_pid[slot][0]
+                    if eng._slot_pid[slot] is not None else None),
+            "prefix_len": (eng._slot_pid[slot][1]
+                           if eng._slot_pid[slot] is not None else 0),
         }
     for req, e in eng._parked.items():
         if req.status is not None or req.cancelled or e.get("unstarted"):
@@ -247,6 +280,8 @@ def _ledger_entries(eng: ServingEngine) -> Dict[Request, dict]:
             "n_pages": e["n_pages"],
             "hist_exact": bool(e.get("hist_exact", True)),
             "priority": e["priority"],
+            "pid": e.get("pid"),
+            "prefix_len": int(e.get("prefix_len") or 0),
         }
     return entries
 
@@ -353,6 +388,11 @@ class EngineFleet:
             "probe_misses": 0,        # probes counted as missed (ladder fuel)
             "probes": 0,              # monitor rounds completed
             "suspects": 0,            # HEALTHY->SUSPECT transitions
+            # prefix gravity (vtpu/serving/prefixdir):
+            "prefix_routes": 0,       # submits routed onto a resident
+            "prefix_replications": 0,  # hot prefixes copied to a peer
+            "prefix_spills": 0,       # cold prefixes moved to host tier
+            "prefix_installs": 0,     # host-tier installs back into pools
         }
         self._stop_ev = threading.Event()
         self._mon: Optional[threading.Thread] = None
@@ -364,6 +404,11 @@ class EngineFleet:
                                 max_bundles=fleet.trace_bundles)
         for name in sorted(self._engines):
             self.trace.attach(name, self._engines[name].trace)
+        # the fleet-owned prefix directory: WHERE each content-addressed
+        # prefix lives (resident engines with live refcounts, host-tier
+        # payloads), fed by per-engine listeners installed at start()
+        self.prefixdir = PrefixDirectory(
+            queue_slot_ms=fleet.prefix_queue_slot_ms)
 
     # ------------------------------------------------------------- lifecycle
 
@@ -377,6 +422,12 @@ class EngineFleet:
         for name in sorted(self._engines):
             eng = self._engines[name]
             eng._ledger_hook = self._make_hook(name)
+            if not getattr(eng, "is_remote", False):
+                # local members report prefix register/hit/release events
+                # straight into the directory; remote members' events stay
+                # on their host — the fleet updates the directory from the
+                # ask results and route bookkeeping instead
+                eng._prefix_listener = self._make_prefix_listener(name)
             if eng._thread is None:
                 eng.start()
         self._mon = threading.Thread(target=self._monitor, daemon=True)
@@ -406,6 +457,11 @@ class EngineFleet:
             with self._mu:
                 self._ledger[_name] = entries
         return hook
+
+    def _make_prefix_listener(self, name: str):
+        def listener(event, pid, _name=name, **kw):
+            self.prefixdir.on_event(_name, event, pid, **kw)
+        return listener
 
     # --------------------------------------------------------------- routing
 
@@ -456,14 +512,25 @@ class EngineFleet:
         return getattr(self._engines[name], "host", "local")
 
     def submit(self, tokens, max_new_tokens: int = 0, priority: int = 0,
-               deadline_ms: Optional[float] = None) -> Request:
+               deadline_ms: Optional[float] = None, prefix_tokens=None,
+               pid: Optional[str] = None) -> Request:
         """The fleet's front door: route to the best-scored engine and
         return its Request. A door that turns out closed (draining or
         stopping — the drain/submit race) re-routes to the next candidate
         (``reroutes`` counts it); a submit that lands in the flip gap on
         a now-draining engine is rescued by migrating it straight off.
-        Prefix-backed submits are rejected — prefix registrations are
-        engine-local; register on a specific engine and submit there."""
+
+        ``prefix_tokens`` (the shared prompt's token list) or ``pid`` (a
+        content pid from ``register_prefix``) makes the route PREFIX-
+        AWARE: the directory is consulted before scoring, a resident
+        engine's score gets the avoided-prefill bonus, and the winning
+        submit ships only the suffix (falling back to the full prompt
+        when the prefix lives nowhere). Engine-LOCAL prefix ids never
+        cross this door — they only mean something to the engine that
+        minted them."""
+        if prefix_tokens is not None or pid is not None:
+            return self._submit_prefix(tokens, max_new_tokens, priority,
+                                       deadline_ms, prefix_tokens, pid)
         last: Optional[BaseException] = None
         for name, score in self._route_ranked():
             eng = self._engines[name]
@@ -492,35 +559,235 @@ class EngineFleet:
             with self._mu:
                 self._assigned[req] = name
                 swept = self._health.get(name) == DEAD
-            if swept and req.status is None:
-                # the narrowest corner: the engine died between scoring
-                # and enqueue AND its failover already swept the
-                # assignment set — nobody else will ever see this
-                # request, so re-place it ourselves (it never started:
-                # an unstarted re-queue is token-equal by construction)
-                if not self._rebuild(req, _unstarted_meta(req),
-                                     exclude=name):
-                    req.finish(Status.FAULTED)
-                    with self._mu:
-                        self._fstats["failover_faulted"] += 1
-                return req
-            if eng._draining and not eng._died:
-                # the OTHER half of the race: drain flipped between the
-                # engine's own admission check and the enqueue, so the
-                # request landed on a draining engine — migrate it off
-                # (the drain loop would also catch it; whichever runs
-                # first wins, the loser observes 'gone'). A DIED engine
-                # is deliberately NOT rescued here: migrate() needs the
-                # source's loop thread, which is gone — the request is
-                # already in _assigned, and the failover rebuild is the
-                # path that recovers it.
-                with self._mu:
-                    self._fstats["reroutes"] += 1
-                self._rescue(req, name)
-            return req
+            return self._settle_placement(req, name, eng, swept)
         raise RuntimeError(
             f"no routable engine in the fleet ({last!r})" if last is not None
             else "no routable engine in the fleet")
+
+    def _settle_placement(self, req: Request, name: str,
+                          eng: ServingEngine, swept: bool) -> Request:
+        """The two submit/death races every placement path closes after
+        the enqueue landed and the assignment published."""
+        if swept and req.status is None:
+            # the narrowest corner: the engine died between scoring
+            # and enqueue AND its failover already swept the
+            # assignment set — nobody else will ever see this
+            # request, so re-place it ourselves (it never started:
+            # an unstarted re-queue is token-equal by construction)
+            if not self._rebuild(req, _unstarted_meta(req),
+                                 exclude=name):
+                req.finish(Status.FAULTED)
+                with self._mu:
+                    self._fstats["failover_faulted"] += 1
+            return req
+        if eng._draining and not eng._died:
+            # the OTHER half of the race: drain flipped between the
+            # engine's own admission check and the enqueue, so the
+            # request landed on a draining engine — migrate it off
+            # (the drain loop would also catch it; whichever runs
+            # first wins, the loser observes 'gone'). A DIED engine
+            # is deliberately NOT rescued here: migrate() needs the
+            # source's loop thread, which is gone — the request is
+            # already in _assigned, and the failover rebuild is the
+            # path that recovers it.
+            with self._mu:
+                self._fstats["reroutes"] += 1
+            self._rescue(req, name)
+        return req
+
+    # ------------------------------------------------------- prefix gravity
+
+    def register_prefix(self, prefix_tokens, engine=None) -> str:
+        """Register a shared prompt prefix ONCE somewhere in the fleet
+        and return its content pid — the fleet-level name
+        ``submit(pid=...)`` routes by. ``engine`` pins the build to one
+        member; by default the best-scored routable engine builds it
+        (and a pid already resident anywhere returns immediately — the
+        registration is content-addressed, so it is idempotent across
+        the fleet)."""
+        toks = [int(x) for x in np.asarray(prefix_tokens,
+                                           np.int32).tolist()]
+        cpid = prefix_id(toks)
+        if engine is None:
+            order = self._route_order()
+            if not order:
+                raise RuntimeError(
+                    "no routable engine to register the prefix on")
+            residents = self.prefixdir.residents(cpid)
+            if any(n in residents for n in order):
+                return cpid
+            name = order[0]
+        else:
+            name = self._resolve(engine)
+        eng = self._engines[name]
+        lid = eng.register_prefix(toks)
+        if getattr(eng, "is_remote", False):
+            # a remote build reported to ITS host, not to this directory:
+            # mirror the registration from the proxy's client-side record
+            meta = eng._prefix_meta[lid]
+            self.prefixdir.on_event(
+                name, "register", cpid, lid=lid, tokens=toks,
+                length=meta["len"], build_ms=meta.get("build_ms"))
+        return cpid
+
+    def _submit_prefix(self, tokens, max_new_tokens: int, priority: int,
+                       deadline_ms: Optional[float], prefix_tokens,
+                       pid: Optional[str]) -> Request:
+        """The prefix-aware route: rank every candidate on policy score
+        PLUS the directory bonus for residents (the policy itself stays
+        pure — residency rides ``signals.prefix_resident_tokens``), then
+        place on the winner: suffix-only onto a resident, tier-install-
+        then-suffix when only the host tier holds it, full prompt when
+        the prefix lives nowhere (a directory miss)."""
+        if prefix_tokens is not None:
+            ptoks = [int(x) for x in np.asarray(prefix_tokens,
+                                                np.int32).tolist()]
+            cpid = prefix_id(ptoks)
+            if pid is not None and pid != cpid:
+                raise ValueError(
+                    f"prefix_tokens hash to pid {cpid!r} but pid={pid!r} "
+                    "was passed — they name different prefixes")
+        else:
+            cpid = pid
+            ptoks = self.prefixdir.tokens_of(cpid)
+            if ptoks is None:
+                raise ValueError(
+                    f"unknown prefix pid {cpid!r}: pass prefix_tokens "
+                    "(or register_prefix first) so the fleet can fall "
+                    "back to a full-prompt submit")
+        plen = len(ptoks)
+        residents = self.prefixdir.residents(cpid)
+        bonus_val = self.prefixdir.route_bonus(plen)
+        with self._mu:
+            states = dict(self._health)
+        ranked = []
+        for name in self._routable():
+            eng = self._engines[name]
+            sig = eng.signals()
+            if name in residents:
+                # the policy sees exactly what the bonus priced: tokens
+                # of THIS request's prefix resident on this engine
+                sig = dataclasses.replace(sig, prefix_resident_tokens=plen)
+            score = self._policy.score(name, sig)
+            if score is None:
+                continue
+            b = bonus_val if name in residents else 0.0
+            ranked.append((states.get(name) == SUSPECT,
+                           -(float(score) + b), name, b))
+        ranked.sort()
+        last: Optional[BaseException] = None
+        for suspect, neg, name, b in ranked:
+            eng = self._engines[name]
+            total = -neg
+            lid = residents.get(name)
+            routed_resident = lid is not None
+            if lid is None and self.prefixdir.in_host_tier(cpid):
+                lid = self._install_from_tier(name, cpid)
+            try:
+                if lid is not None:
+                    try:
+                        req = eng.submit(tokens,
+                                         max_new_tokens=max_new_tokens,
+                                         prefix=lid, priority=priority,
+                                         deadline_ms=deadline_ms)
+                    except ValueError:
+                        # unregistered in the gap (a racing spill):
+                        # same engine, full prompt — still the winner
+                        lid = None
+                if lid is None:
+                    full = list(ptoks) + [
+                        int(x) for x in np.asarray(tokens,
+                                                   np.int32).tolist()]
+                    req = eng.submit(full, max_new_tokens=max_new_tokens,
+                                     priority=priority,
+                                     deadline_ms=deadline_ms)
+            except RuntimeError as exc:
+                last = exc
+                with self._mu:
+                    self._fstats["reroutes"] += 1
+                self.trace.control("reroute", engine=name)
+                continue
+            if lid is not None:
+                with self._mu:
+                    self._fstats["prefix_routes"] += 1
+                if getattr(eng, "is_remote", False):
+                    # local residents stamp the hit at the share (the
+                    # loop-thread listener); a remote's share happens on
+                    # another host, so the route stamps it here
+                    self.prefixdir.note_route_hit(cpid, name)
+            else:
+                self.prefixdir.note_miss()
+            req.jid = self.trace.begin_journey(
+                name, req.rid, host=self._host_of(name),
+                prefix=lid is not None and b > 0)
+            self.trace.control("route", engine=name, jid=req.jid,
+                               score=total, bonus=b)
+            with self._mu:
+                self._assigned[req] = name
+                swept = self._health.get(name) == DEAD
+            return self._settle_placement(req, name, eng, swept)
+        raise RuntimeError(
+            f"no routable engine in the fleet ({last!r})" if last is not None
+            else "no routable engine in the fleet")
+
+    def _install_from_tier(self, name: str, cpid: str) -> Optional[int]:
+        """Best-effort host-tier install of *cpid* into engine *name*
+        (the once-per-engine staged H2D); None when the tier has no
+        payload or the install fails — the caller falls back to a full-
+        prompt submit, never an error."""
+        got = self.prefixdir.get_host(cpid)
+        if got is None:
+            return None
+        meta, payload = got
+        eng = self._engines[name]
+        try:
+            res = install_prefix(eng, meta, payload,
+                                 timeout=self.fleet.failover_timeout)
+        except MigrationError as exc:
+            log.warning("host-tier prefix install of %s on %s failed: "
+                        "%s", cpid, name, exc)
+            return None
+        if getattr(eng, "is_remote", False):
+            self.prefixdir.on_event(
+                name, "register", cpid, lid=res["lid"],
+                tokens=meta["tokens"], length=meta["len"])
+        if res.get("installed", True):
+            with self._mu:
+                self._fstats["prefix_installs"] += 1
+            self.trace.control("prefix_install", engine=name,
+                               val=int(meta["len"]))
+        return res["lid"]
+
+    def _ensure_prefix_on(self, name: str, cpid: str) -> None:
+        """Make *cpid* resident on engine *name* from wherever it still
+        lives: already resident -> done; host tier -> staged install;
+        another live resident -> cross-engine copy over the prefix_out/
+        prefix_in pair (fabric asks for remote members). Raises only
+        MigrationError-shaped failures the caller treats as advisory."""
+        residents = self.prefixdir.residents(cpid)
+        if name in residents:
+            return
+        if self.prefixdir.in_host_tier(cpid):
+            self._install_from_tier(name, cpid)
+            return
+        donor = next((n for n in self._routable(exclude={name})
+                      if n in residents), None)
+        if donor is None:
+            return
+        meta, payload = export_prefix(self._engines[donor],
+                                      residents[donor],
+                                      timeout=self.fleet.failover_timeout)
+        res = install_prefix(self._engines[name], meta, payload,
+                             timeout=self.fleet.failover_timeout)
+        if getattr(self._engines[name], "is_remote", False):
+            self.prefixdir.on_event(
+                name, "register", cpid, lid=res["lid"],
+                tokens=meta["tokens"], length=meta["len"])
+        if res.get("installed", True):
+            with self._mu:
+                self._fstats["prefix_installs"] += 1
+            self.trace.control("prefix_install", engine=name,
+                               val=int(meta["len"]))
 
     def _rescue(self, req: Request, src_name: str) -> None:
         """Move a straggler off a draining engine. Best-effort by
@@ -691,6 +958,61 @@ class EngineFleet:
             self._fstats["probes"] += 1
         self._maybe_rebalance()
         self._prune_assigned()
+        try:
+            self._prefix_gravity()
+        except Exception:  # pragma: no cover - must not kill the monitor
+            log.exception("prefix gravity pass raised")
+
+    def _prefix_gravity(self) -> None:
+        """The directory's background actuators, one action of each kind
+        per probe round (the rebalance cadence): REPLICATE the hottest
+        under-replicated prefix onto the least-pressured non-resident
+        survivor (the chunked-prefill build path — zero staged copies,
+        counted by the bench's ``prefix_install_copies == 0`` gate), and
+        SPILL the coldest zero-ref prefix to the shared host tier so ANY
+        engine can install it later. Both are best-effort and opt-in via
+        FleetConfig (None disables each)."""
+        fc = self.fleet
+        if fc.prefix_replicate_hits is not None:
+            routable = self._routable()
+            got = self.prefixdir.hot_candidate(
+                fc.prefix_replicate_hits, fc.prefix_max_replicas, routable)
+            if got is not None:
+                pid, toks, _donor = got
+                residents = self.prefixdir.residents(pid)
+                target = next((n for n in self._route_order()
+                               if n not in residents), None)
+                if target is not None:
+                    dst = self._engines[target]
+                    lid = dst.register_prefix(toks)
+                    if getattr(dst, "is_remote", False):
+                        meta = dst._prefix_meta[lid]
+                        self.prefixdir.on_event(
+                            target, "register", pid, lid=lid, tokens=toks,
+                            length=meta["len"],
+                            build_ms=meta.get("build_ms"))
+                    with self._mu:
+                        self._fstats["prefix_replications"] += 1
+                    self.trace.control("prefix_replicate", engine=target,
+                                       val=len(toks))
+        if fc.prefix_spill_idle_s is not None:
+            got = self.prefixdir.cold_candidate(
+                fc.prefix_spill_idle_s, self._routable())
+            if got is not None:
+                pid, name, lid = got
+                eng = self._engines[name]
+                if not self.prefixdir.in_host_tier(pid):
+                    meta, payload = export_prefix(
+                        eng, lid, timeout=fc.failover_timeout)
+                    self.prefixdir.put_host(pid, meta, payload)
+                eng.unregister_prefix(lid)
+                if getattr(eng, "is_remote", False):
+                    self.prefixdir.on_event(name, "unregister", pid,
+                                            lid=lid)
+                with self._mu:
+                    self._fstats["prefix_spills"] += 1
+                self.trace.control("prefix_spill", engine=name,
+                                   val=int(self.prefixdir.in_host_tier(pid)))
 
     def _prune_assigned(self) -> None:
         with self._mu:
@@ -732,6 +1054,11 @@ class EngineFleet:
                             "late deliveries gated", name,
                             self.fleet.fence_timeout)
         self.trace.control("fence", engine=name)
+        # the corpse's prefix replicas are gone with it: drop its column
+        # from the directory NOW so the rebuilds below (and every racing
+        # route) only see surviving residents — replicas elsewhere and
+        # the host tier keep the pids alive
+        self.prefixdir.drop_engine(name)
         # FLIGHT RECORDER: snapshot the corpse's ring, stats, signals and
         # ledger census into the post-mortem bundle NOW — after the fence
         # (the state is quiescent) and before the rebuild/reap mutate the
@@ -812,6 +1139,18 @@ class EngineFleet:
         try:
             for dst_name in self._route_order(exclude={exclude}):
                 dst = self._engines[dst_name]
+                pid = meta.get("pid")
+                if pid is not None:
+                    # the session rode a shared prefix: make it resident
+                    # on the survivor BEFORE the install so the recompute
+                    # path shares those blocks and replays only the
+                    # private tail (failover_prefix_reuses). Best-effort:
+                    # a full recompute is correct, just slower.
+                    try:
+                        self._ensure_prefix_on(dst_name, pid)
+                    except Exception:  # pragma: no cover - never fatal
+                        log.exception("prefix %s pre-stage on %r failed",
+                                      pid, dst_name)
                 ticket = _Ticket(req, meta=dict(meta), payload=None)
                 try:
                     res = _ask(dst, "migrate_in", ticket,
@@ -925,7 +1264,8 @@ class EngineFleet:
                 kind, item = eng._lifecycle_q.get_nowait()
             except queue.Empty:
                 break
-            if kind in ("migrate_out", "migrate_in"):
+            if kind in ("migrate_out", "migrate_in",
+                        "prefix_out", "prefix_in"):
                 item.fail(RuntimeError(
                     "engine died before serving the ticket"))
 
@@ -1015,6 +1355,7 @@ class EngineFleet:
         # ring health, bundle census, stitched-SLO percentiles) — all
         # exporter-mapped, like every other fleet counter
         out.update(self.trace.stats())
+        out.update(self.prefixdir.stats())
         states = out["engine_states"]
         out["healthy_engines"] = sum(
             1 for v in states.values() if v == HEALTHY)

@@ -485,6 +485,11 @@ def _do_migrate_out(eng, ticket: _Ticket) -> None:
         "n_pages": e["n_pages"],
         "hist_exact": bool(e.get("hist_exact", True)),
         "priority": e["priority"],
+        # prefix identity (vtpu/serving/prefixdir): lets the destination
+        # re-share a resident replica of the same content pid instead of
+        # recomputing the prefix positions
+        "pid": e.get("pid"),
+        "prefix_len": int(e.get("prefix_len") or 0),
     }
     payload = None
     src_died = False
@@ -617,6 +622,8 @@ def _do_migrate_in(eng, ticket: _Ticket) -> None:
         "dropped": False, "recompute_ok": recompute_ok,
         "hist_exact": meta["hist_exact"], "priority": meta["priority"],
         "seq": eng._park_seq,
+        "pid": meta.get("pid"),
+        "prefix_len": int(meta.get("prefix_len") or 0),
     }
     if payload is None:
         if not recompute_ok:

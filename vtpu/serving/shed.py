@@ -73,6 +73,11 @@ class EngineSignals:
     # to size the flush window (low acceptance -> small k: a deep flush of
     # rejected drafts is pure latency).
     spec_mean_accepted: Optional[float] = None
+    # prefix gravity (vtpu/serving/prefixdir): tokens of THIS request's
+    # prefix resident on this engine — 0 in the engine's own snapshot,
+    # stamped per-candidate by the fleet's prefix-aware route so user
+    # RoutePolicies see exactly what the directory bonus priced.
+    prefix_resident_tokens: int = 0
 
     def to_dict(self) -> dict:
         """JSON-safe form — the shape that crosses the fabric wire so a
